@@ -1,0 +1,33 @@
+"""deeplearning_mpi_tpu — a TPU-native distributed training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the reference
+``unlikeghost/DeepLearning-MPI`` stack (PyTorch DDP over NCCL in NVIDIA Docker
+containers, launched with torchrun): a distributed communication smoke test,
+data-parallel ResNet classification, and data-parallel UNet segmentation with
+file logging, Dice evaluation and checkpoint/resume — rebuilt TPU-first:
+
+- ``jax.distributed`` multi-host bootstrap over ICI/DCN instead of the
+  torchrun/NCCL process-group rendezvous (reference:
+  ``pytorch/hello_world/hello_world.py:34``, ``pytorch/unet/train.py:255``).
+- SPMD ``jit`` over a ``jax.sharding.Mesh`` with ``NamedSharding`` and XLA
+  collectives instead of a ``DistributedDataParallel`` wrapper object
+  (reference: ``pytorch/resnet/main.py:44-46``).
+- Per-host sharded input pipelines with per-epoch reshuffling instead of
+  ``DistributedSampler`` (reference: ``pytorch/resnet/main.py:94``).
+- Orbax checkpointing of the full train state instead of rank-0
+  ``torch.save(state_dict)`` (reference: ``pytorch/resnet/main.py:136-139``).
+
+Subpackages
+-----------
+- ``runtime``  — process bootstrap, device mesh, collective wrappers.
+- ``parallel`` — data/tensor/sequence-parallel sharding rules.
+- ``ops``      — losses, metrics, normalization, Pallas kernels.
+- ``models``   — ResNet family, 2-D/3-D UNet, transformer LM.
+- ``data``     — per-host sharded input pipelines (CIFAR-10, segmentation).
+- ``train``    — train state, jitted step factories, trainer loop, checkpoints.
+- ``utils``    — run logging, metrics, config/flag system.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning_mpi_tpu.runtime import bootstrap, collectives, mesh  # noqa: F401

@@ -1,0 +1,6 @@
+"""Non-interactive CLI entrypoints.
+
+Replace the reference's interactive ``read -p`` bash launchers
+(``pytorch/hello_world/run.sh:4-10``, ``pytorch/unet/run.sh:25-79``) with
+flag-driven ``python -m`` entrypoints that work under any process launcher.
+"""

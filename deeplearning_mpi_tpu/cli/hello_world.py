@@ -1,0 +1,76 @@
+"""CLI for the distributed smoke test.
+
+Usage::
+
+    python -m deeplearning_mpi_tpu.cli.hello_world [--platform cpu|tpu]
+        [--n_virtual_devices N] [--coordinator ADDR --num_processes W --process_id R]
+
+Replaces the reference's interactive launcher + driver pair
+(``pytorch/hello_world/run.sh:1-19`` prompting for topology, then torchrun
+spawning ``hello_world.py``). ``--platform cpu`` is the Gloo-parity path
+(``pytorch/hello_world/hello_world.py:44``): with ``--n_virtual_devices N`` it
+fakes an N-device mesh on CPU, the hardware-free way to exercise the full
+SPMD transport stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--platform",
+        default=None,
+        choices=("cpu", "tpu"),
+        help="force JAX platform; cpu is the reference's gloo-style fallback "
+        "(hello_world.py:44)",
+    )
+    parser.add_argument(
+        "--n_virtual_devices",
+        type=int,
+        default=None,
+        help="with --platform cpu: fake this many CPU devices "
+        "(XLA_FLAGS=--xla_force_host_platform_device_count)",
+    )
+    parser.add_argument("--coordinator", default=None, help="coordinator addr:port (multi-host)")
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    # Deferred: platform/XLA flags must be set before backend init.
+    from deeplearning_mpi_tpu.runtime import bootstrap
+    from deeplearning_mpi_tpu.runtime.hello_world import run_hello_world
+
+    if args.n_virtual_devices:
+        bootstrap.set_virtual_cpu_devices(args.n_virtual_devices)
+        args.platform = "cpu"
+
+    topo = bootstrap.init(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        platform=args.platform,
+    )
+    print(
+        f"[process {topo.process_id}/{topo.num_processes}] platform={topo.platform} "
+        f"local_devices={topo.local_device_count} global_devices={topo.global_device_count}"
+    )
+    try:
+        result = run_hello_world()
+        status = "OK" if result.ok else "FAILED"
+        print(
+            f"hello_world {status}: n_devices={result.n_devices} "
+            f"broadcast={'ok' if result.broadcast_ok else 'FAIL'} "
+            f"ring={'ok' if result.ring_ok else 'FAIL'} "
+            f"psum={'ok' if result.psum_ok else 'FAIL'}"
+        )
+        return 0 if result.ok else 1
+    finally:
+        bootstrap.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+"""Runtime layer: bootstrap, device mesh, collectives, hello_world smoke test.
+
+TPU-native replacement for the reference's L1/L2 layers (torchrun rendezvous +
+``torch.distributed`` NCCL/Gloo process groups — see ``SURVEY.md`` §1).
+"""
+
+from deeplearning_mpi_tpu.runtime.bootstrap import (  # noqa: F401
+    Topology,
+    get_system_information,
+    init,
+    is_coordinator,
+    shutdown,
+)
+from deeplearning_mpi_tpu.runtime.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+    replicated_sharding,
+)

@@ -1,0 +1,106 @@
+"""Collective communication primitives over the device mesh.
+
+The reference's collective layer is ``torch.distributed`` backed by NCCL
+(GPU) or Gloo (CPU): explicit ``dist.send``/``dist.recv`` point-to-point
+(``pytorch/hello_world/hello_world.py:24-30``) plus the implicit gradient
+all-reduce inside DDP's backward hook (``pytorch/resnet/main.py:131``). Here
+the same capabilities are XLA collectives over ICI/DCN, expressed inside
+``shard_map``/``jit`` so the compiler owns scheduling, fusion, and transport:
+
+=============================  ============================================
+reference (torch.distributed)  this framework (XLA collective)
+=============================  ============================================
+all_reduce (DDP backward)      ``all_reduce_mean`` / ``psum`` on grads
+send/recv rank fan-out         ``broadcast_from`` (select + psum)
+ring neighbor exchange         ``ring_shift`` (``lax.ppermute``)
+all_gather                     ``all_gather``
+reduce_scatter                 ``reduce_scatter`` (``lax.psum_scatter``)
+barrier                        any collective (SPMD programs sync by data)
+=============================  ============================================
+
+These wrappers are meant to be called **inside** a ``shard_map``-decorated
+function whose mesh carries the named axis. The pjit/NamedSharding path used
+by the trainers doesn't call these at all — XLA inserts the AllReduce from the
+sharding annotations (the moral equivalent of DDP's bucketing + overlap being
+owned by the latency-hiding scheduler rather than a reducer object).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA
+
+PyTree = Any
+
+
+def axis_size(axis_name: str = AXIS_DATA) -> int:
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str = AXIS_DATA) -> jax.Array:
+    """This shard's coordinate along ``axis_name`` — the analog of the
+    reference's ``RANK`` env var (``pytorch/hello_world/hello_world.py:9``)."""
+    return lax.axis_index(axis_name)
+
+
+def all_reduce_sum(tree: PyTree, axis_name: str = AXIS_DATA) -> PyTree:
+    """Sum across the axis — NCCL all-reduce equivalent."""
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def all_reduce_mean(tree: PyTree, axis_name: str = AXIS_DATA) -> PyTree:
+    """Mean across the axis.
+
+    This is DDP's gradient semantics: gradients are *averaged* (not summed)
+    across replicas during backward (``pytorch/resnet/main.py:131``; see
+    ``SURVEY.md`` §7 "Matching DDP semantics").
+    """
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def all_gather(tree: PyTree, axis_name: str = AXIS_DATA, *, axis: int = 0) -> PyTree:
+    """Concatenate every shard's value along ``axis``."""
+    return jax.tree.map(
+        lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=True), tree
+    )
+
+
+def reduce_scatter(tree: PyTree, axis_name: str = AXIS_DATA, *, axis: int = 0) -> PyTree:
+    """Sum then scatter shards along ``axis`` — the memory-efficient half of a
+    ring all-reduce; the building block for ZeRO-style sharded optimizers."""
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True),
+        tree,
+    )
+
+
+def ring_shift(x: jax.Array, axis_name: str = AXIS_DATA, *, offset: int = 1) -> jax.Array:
+    """Send this shard's value to the neighbor ``offset`` steps around the
+    ring, receive from the opposite neighbor.
+
+    The point-to-point primitive: replaces ``dist.send``/``dist.recv``
+    (``pytorch/hello_world/hello_world.py:26,29``) with
+    ``lax.ppermute``, which XLA lowers to collective-permute riding ICI
+    neighbor links — also the inner step of ring attention.
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def broadcast_from(x: jax.Array, src: int = 0, axis_name: str = AXIS_DATA) -> jax.Array:
+    """Every shard receives shard ``src``'s value.
+
+    The reference's hello_world "rank 0 sends a tensor to every other rank"
+    fan-out (``pytorch/hello_world/hello_world.py:24-30``) is a broadcast;
+    SPMD-style it is select-then-psum, which XLA pattern-matches to an
+    efficient broadcast rather than N point-to-point sends.
+    """
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)

@@ -1,0 +1,41 @@
+"""Test harness: 8 virtual CPU devices, no TPU required.
+
+The reference tests multi-node behavior without a GPU cluster by running N
+Gloo processes on one machine (``pytorch/hello_world/hello_world.py:19-22,44``
+— SURVEY.md §4). The JAX equivalent is a single process with N fake CPU
+devices via ``--xla_force_host_platform_device_count``, giving every mesh /
+collective / sharding test a real 8-way SPMD execution on any machine.
+
+Must run before the first JAX backend initialization: the environment pins
+``JAX_PLATFORMS`` via a sitecustomize hook, so we both set the env vars and
+force the config, which wins as long as no array op has run yet.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_virtual_mesh():
+    assert jax.device_count() == 8, (
+        "tests require 8 virtual CPU devices; got "
+        f"{jax.device_count()} on {jax.devices()[0].platform}"
+    )
+    yield
+
+
+@pytest.fixture()
+def mesh():
+    from deeplearning_mpi_tpu.runtime.mesh import create_mesh
+
+    return create_mesh()

@@ -1,0 +1,157 @@
+"""Tests for the runtime layer: bootstrap, mesh, collectives, hello_world."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deeplearning_mpi_tpu.runtime import bootstrap, collectives
+from deeplearning_mpi_tpu.runtime.hello_world import run_hello_world
+from deeplearning_mpi_tpu.runtime.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    MESH_AXES,
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+    local_batch_size,
+    replicated_sharding,
+)
+
+
+class TestBootstrap:
+    def test_single_process_init(self):
+        topo = bootstrap.init()
+        assert topo.process_id == 0
+        assert topo.num_processes == 1
+        assert topo.global_device_count == 8
+        assert topo.is_coordinator
+
+    def test_is_coordinator(self):
+        assert bootstrap.is_coordinator()
+
+    def test_system_information(self):
+        info = bootstrap.get_system_information()
+        assert info["global_device_count"] == 8
+        assert info["platform"] == "cpu"
+        assert "jax_version" in info
+
+    def test_shutdown_noop_single_process(self):
+        bootstrap.shutdown()  # must not raise
+
+
+class TestMesh:
+    def test_default_mesh_all_data(self):
+        mesh = create_mesh()
+        assert mesh.axis_names == MESH_AXES
+        assert mesh.shape[AXIS_DATA] == 8
+        assert all(mesh.shape[a] == 1 for a in MESH_AXES if a != AXIS_DATA)
+
+    def test_data_by_model_mesh(self):
+        mesh = create_mesh(MeshSpec(data=4, model=2))
+        assert mesh.shape[AXIS_DATA] == 4
+        assert mesh.shape[AXIS_MODEL] == 2
+
+    def test_infer_data_degree(self):
+        mesh = create_mesh(MeshSpec(model=2))
+        assert mesh.shape[AXIS_DATA] == 4
+
+    def test_bad_shape_raises(self):
+        with pytest.raises(ValueError):
+            create_mesh(MeshSpec(data=3, model=2))
+        with pytest.raises(ValueError):
+            create_mesh(MeshSpec(model=3))
+
+    def test_batch_sharding_places_shards(self, mesh):
+        x = jnp.zeros((16, 4, 4, 3))
+        sharded = jax.device_put(x, batch_sharding(mesh))
+        assert sharded.sharding.is_equivalent_to(batch_sharding(mesh), 4)
+        # each device holds 16/8 = 2 rows of the batch
+        assert sharded.addressable_shards[0].data.shape == (2, 4, 4, 3)
+
+    def test_replicated_sharding(self, mesh):
+        x = jnp.zeros((5, 5))
+        sharded = jax.device_put(x, replicated_sharding(mesh))
+        assert sharded.addressable_shards[0].data.shape == (5, 5)
+
+    def test_local_batch_size(self, mesh):
+        assert local_batch_size(64, mesh) == 64  # single process: all local
+        with pytest.raises(ValueError):
+            local_batch_size(12, mesh)  # not divisible by dp=8
+
+    def test_local_batch_size_model_parallel_mesh(self):
+        # dp=4, tp=2: batch of 4 is valid (one row per data coordinate) and the
+        # single process supplies all 4 distinct rows, not 4/len(devices).
+        mesh = create_mesh(MeshSpec(data=4, model=2))
+        assert local_batch_size(4, mesh) == 4
+        assert local_batch_size(8, mesh) == 8
+
+
+class TestCollectives:
+    def _run(self, fn, out_specs, mesh):
+        wrapped = shard_map(fn, mesh=mesh, in_specs=P(AXIS_DATA), out_specs=out_specs)
+        return jax.jit(wrapped)(jnp.arange(8, dtype=jnp.float32))
+
+    def test_all_reduce_sum(self, mesh):
+        out = self._run(lambda x: collectives.all_reduce_sum(x), P(), mesh)
+        assert out == pytest.approx(28.0)
+
+    def test_all_reduce_mean(self, mesh):
+        out = self._run(lambda x: collectives.all_reduce_mean(x), P(), mesh)
+        assert out == pytest.approx(3.5)
+
+    def test_all_reduce_tree(self, mesh):
+        tree = {"a": jnp.ones((8,)), "b": jnp.arange(8, dtype=jnp.float32)}
+        fn = shard_map(
+            collectives.all_reduce_sum,
+            mesh=mesh,
+            in_specs=({"a": P(AXIS_DATA), "b": P(AXIS_DATA)},),
+            out_specs={"a": P(), "b": P()},
+        )
+        out = jax.jit(fn)(tree)
+        assert out["a"] == pytest.approx(8.0)
+        assert out["b"] == pytest.approx(28.0)
+
+    def test_ring_shift(self, mesh):
+        out = self._run(lambda x: collectives.ring_shift(x), P(AXIS_DATA), mesh)
+        # value i moves to slot (i+1) % 8
+        np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+    def test_ring_shift_negative_offset(self, mesh):
+        out = self._run(
+            lambda x: collectives.ring_shift(x, offset=-1), P(AXIS_DATA), mesh
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(8.0), -1))
+
+    def test_broadcast_from(self, mesh):
+        out = self._run(lambda x: collectives.broadcast_from(x, src=3), P(AXIS_DATA), mesh)
+        np.testing.assert_array_equal(np.asarray(out), np.full(8, 3.0))
+
+    def test_all_gather(self, mesh):
+        out = self._run(lambda x: collectives.all_gather(x), P(AXIS_DATA), mesh)
+        # every shard gathers the full vector; global result tiles it 8x
+        assert out.shape == (64,)
+        np.testing.assert_array_equal(np.asarray(out)[:8], np.arange(8.0))
+
+    def test_reduce_scatter(self, mesh):
+        # each shard contributes the full 8-vector of ones; scatter-sum gives 8s
+        fn = shard_map(
+            lambda x: collectives.reduce_scatter(jnp.ones((8,))),
+            mesh=mesh,
+            in_specs=P(AXIS_DATA),
+            out_specs=P(AXIS_DATA),
+        )
+        out = jax.jit(fn)(jnp.arange(8.0))
+        np.testing.assert_array_equal(np.asarray(out), np.full(8, 8.0))
+
+
+class TestHelloWorld:
+    def test_hello_world_passes(self, mesh):
+        result = run_hello_world(mesh)
+        assert result.n_devices == 8
+        assert result.broadcast_ok
+        assert result.ring_ok
+        assert result.psum_ok
+        assert result.ok
