@@ -1,0 +1,127 @@
+"""Data-parallel ResNet image classification on CIFAR-10.
+
+TPU-native rebuild of the reference trainer (``pytorch/resnet/main.py``):
+
+    python -m deeplearning_mpi_tpu.cli.train_resnet \
+        --num_epochs 100 --batch_size 128 --learning_rate 0.1
+
+Reference parity: ResNet-18 head swapped to 10 classes (``main.py:40-41``),
+SGD momentum 0.9 / weight decay 1e-5 + cross-entropy (``main.py:113-114``),
+per-epoch mean-loss logging (``main.py:134``), every-10-epoch eval +
+checkpoint (``main.py:136-142``), ``--resume`` (``main.py:48-52``). The
+``--arch`` flag extends the family to ResNet-50/152 (the BASELINE.md config
+ladder); ``--synthetic`` trains on the hermetic synthetic dataset when no
+CIFAR-10 directory is available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from deeplearning_mpi_tpu.utils import config
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    config.add_topology_flags(parser)
+    # ResNet defaults: epochs 100, batch 128, lr 0.1, seed 0 (main.py:162-176).
+    config.add_training_flags(
+        parser, num_epochs=100, batch_size=128, learning_rate=0.1, random_seed=0,
+        model_filename="resnet_distributed",
+    )
+    parser.add_argument("--arch", default="resnet18",
+                        choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152"])
+    parser.add_argument("--stem", default="imagenet", choices=["imagenet", "cifar"],
+                        help="imagenet = torchvision-parity 7x7/2 stem (main.py:40)")
+    parser.add_argument("--data_dir", default="data", help="dir containing cifar-10-batches-py")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="train on synthetic CIFAR-like data (no dataset needed)")
+    parser.add_argument("--train_samples", type=int, default=2048,
+                        help="synthetic dataset size")
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--weight_decay", type=float, default=1e-5)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from deeplearning_mpi_tpu.utils import config
+
+    topo, mesh = config.setup_runtime(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.data import CIFAR10, ShardedLoader, SyntheticCIFAR10
+    from deeplearning_mpi_tpu.data.cifar10 import eval_transform, train_transform
+    from deeplearning_mpi_tpu.models import get_model
+    from deeplearning_mpi_tpu.train import Checkpointer, Trainer, create_train_state
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+    from deeplearning_mpi_tpu.utils.logging import RunLogger
+
+    logger = RunLogger(args.log_dir)
+    logger.log_system_information()
+    logger.log_hyperparameters(vars(args))
+
+    if args.synthetic:
+        train_ds = SyntheticCIFAR10(args.train_samples, seed=args.random_seed)
+        eval_ds = SyntheticCIFAR10(
+            max(args.batch_size, args.train_samples // 8), seed=args.random_seed + 1
+        )
+    else:
+        train_ds = CIFAR10(args.data_dir, train=True)
+        eval_ds = CIFAR10(args.data_dir, train=False)
+
+    train_loader = ShardedLoader(
+        train_ds, args.batch_size, mesh,
+        shuffle=True, seed=args.random_seed, transform=train_transform,
+    )
+    eval_loader = ShardedLoader(
+        eval_ds, args.batch_size, mesh,
+        shuffle=False, drop_last=False, transform=eval_transform,
+    )
+
+    model = get_model(
+        args.arch, num_classes=10, stem=args.stem,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    tx = build_optimizer(
+        "sgd", args.learning_rate,
+        momentum=args.momentum, weight_decay=args.weight_decay,
+    )
+    state = create_train_state(
+        model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx
+    )
+
+    checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    start_epoch = 0
+    if args.resume:
+        latest = checkpointer.latest_epoch()
+        if latest is None:
+            logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
+        else:
+            state = checkpointer.restore(state)
+            start_epoch = latest + 1
+            logger.log(f"resumed from epoch {latest} (step {int(state.step)})")
+
+    trainer = Trainer(
+        state, "classification", mesh,
+        logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
+    )
+    trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
+    try:
+        trainer.fit(
+            train_loader, args.num_epochs,
+            eval_loader=eval_loader, start_epoch=start_epoch,
+        )
+    finally:
+        checkpointer.close()
+        from deeplearning_mpi_tpu.runtime import bootstrap
+        bootstrap.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+"""Per-host sharded input pipelines.
+
+TPU-native replacement for the reference's data stack: torch ``DataLoader`` +
+``DistributedSampler`` per rank (``pytorch/resnet/main.py:91-111``,
+``pytorch/unet/train.py:78-101``). Here each **host** process loads only its
+shard of the global batch and assembles a single global ``jax.Array`` with
+``jax.make_array_from_process_local_data``; XLA sees one logical batch sharded
+over the ``data`` axis.
+"""
+
+from deeplearning_mpi_tpu.data.loader import ShardedLoader  # noqa: F401
+from deeplearning_mpi_tpu.data.cifar10 import CIFAR10, SyntheticCIFAR10  # noqa: F401
+from deeplearning_mpi_tpu.data.segmentation import (  # noqa: F401
+    SegmentationFolderDataset,
+    SyntheticShapesDataset,
+)

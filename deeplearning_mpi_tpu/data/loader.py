@@ -1,0 +1,179 @@
+"""Sharded batch loader — ``DistributedSampler`` + ``DataLoader`` semantics,
+rebuilt for SPMD.
+
+The reference shards the *dataset* by rank with ``DistributedSampler``
+(``pytorch/resnet/main.py:94``, ``pytorch/unet/train.py:96``) and each rank
+iterates a private ``DataLoader``. Here the shard unit is the **process**
+(host), and each batch is materialized as one global device array sharded over
+the mesh's ``data`` axis. The loader asks the sharding itself which global
+rows this process's devices own (``devices_indices_map``), so it stays correct
+on any mesh layout — including model/seq axes spanning processes, where every
+process must supply the *same* (replicated) rows.
+
+Semantics carried over from ``DistributedSampler``:
+- shuffling permutes the *global* index space identically on every process
+  (same seed), then shards;
+- with ``drop_last=False`` the tail is padded by wrapping around to the front
+  (torch pads the same way).
+
+Deliberately fixed here: the reference never calls ``sampler.set_epoch()``, so
+its shuffle order is identical every epoch (SURVEY.md §2c "bugs to NOT
+replicate"). This loader folds the epoch into the shuffle key.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterator, Protocol
+
+import jax
+import numpy as np
+
+from deeplearning_mpi_tpu.runtime.mesh import batch_sharding, data_axes
+
+Batch = dict[str, jax.Array]
+
+
+class ArrayDataset(Protocol):
+    """Minimal dataset protocol: indexable collection of dict examples."""
+
+    def __len__(self) -> int: ...
+
+    def __getitem__(self, index: int) -> dict[str, np.ndarray]: ...
+
+
+class ShardedLoader:
+    """Iterates global batches sharded over the mesh for one process.
+
+    Args:
+      dataset: indexable dataset of ``dict[str, np.ndarray]`` examples.
+      global_batch_size: the *global* batch (the reference's ``--batch_size``
+        is per-process; ``pytorch/resnet/main.py:164``). Must divide by the
+        mesh's data-parallel degree.
+      mesh: the device mesh; batches are sharded over its ``data`` axis.
+      shuffle: permute the global index space each epoch.
+      seed: base shuffle seed — combined with the epoch, replacing the
+        reference's missing ``set_epoch`` call.
+      drop_last: drop the trailing partial batch (default True: SPMD needs
+        static shapes). ``False`` wrap-pads the tail to a full batch — use for
+        eval so small validation sets still produce one full batch.
+      transform: optional per-batch transform applied to the stacked
+        process-local numpy batch (augmentations live here). Seeded by
+        (seed, epoch) identically on every process so replicated shards stay
+        bit-identical.
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        global_batch_size: int,
+        mesh: jax.sharding.Mesh,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        transform: Callable[[dict[str, np.ndarray], np.random.Generator], dict[str, np.ndarray]]
+        | None = None,
+    ) -> None:
+        dp_degree = math.prod(mesh.shape[a] for a in data_axes(mesh))
+        if global_batch_size % dp_degree != 0:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by the mesh's "
+                f"data-parallel degree {dp_degree}"
+            )
+        self.dataset = dataset
+        self.global_batch_size = global_batch_size
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.transform = transform
+        # Global row ranges this process must supply, from the sharding itself
+        # (sorted, de-duplicated): correct for pure DP (disjoint slices),
+        # replication across model/seq axes (full range), and anything mixed.
+        index_map = batch_sharding(mesh, ndim=1).devices_indices_map(
+            (global_batch_size,)
+        )
+        pid = jax.process_index()
+        self.local_row_ranges = sorted(
+            {
+                (sl[0].start or 0, sl[0].stop or global_batch_size)
+                for dev, sl in index_map.items()
+                if dev.process_index == pid
+            }
+        )
+        self.process_batch = sum(stop - start for start, stop in self.local_row_ranges)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        """Global index order for this epoch, sized to whole batches."""
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+            order = rng.permutation(n)
+        else:
+            order = np.arange(n)
+        b = self.global_batch_size
+        if self.drop_last:
+            return order[: (n // b) * b]
+        short = -n % b
+        if short:
+            order = np.resize(order, n + short)  # cyclic wrap-pad (sampler parity)
+        return order
+
+    def steps_per_epoch(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Yield this epoch's batches as globally-sharded device arrays."""
+        order = self._epoch_order(epoch)
+        if len(order) == 0:
+            raise ValueError(
+                f"dataset of {len(self.dataset)} examples yields no full batch of "
+                f"{self.global_batch_size}; lower the batch size or use drop_last=False"
+            )
+        shardings: dict[int, jax.sharding.NamedSharding] = {}
+        # Same stream on every process: replicated shards must stay identical.
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch, 1]))
+
+        for start in range(0, len(order), self.global_batch_size):
+            window = order[start : start + self.global_batch_size]
+            local_idx = np.concatenate(
+                [window[a:b] for a, b in self.local_row_ranges]
+            )
+            examples = [self.dataset[int(i)] for i in local_idx]
+            stacked = {k: np.stack([ex[k] for ex in examples]) for k in examples[0]}
+            if self.transform is not None:
+                stacked = self.transform(stacked, rng)
+            yield {
+                k: jax.make_array_from_process_local_data(
+                    shardings.setdefault(v.ndim, batch_sharding(self.mesh, ndim=v.ndim)),
+                    v,
+                )
+                for k, v in stacked.items()
+            }
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self.epoch(0)
+
+
+def prefetch(iterator: Iterator[Any], size: int = 2) -> Iterator[Any]:
+    """Software pipelining: assemble ``size`` batches ahead of the consumer.
+
+    The reference overlaps host data work with device compute via DataLoader
+    worker processes + ``pin_memory`` (``pytorch/resnet/main.py:100-110``).
+    With JAX's async dispatch the device runs ahead of the host already;
+    pulling the iterator ``size`` items ahead additionally hides host-side
+    batch assembly + H2D transfer behind the current step's compute.
+    """
+    import collections
+
+    queue: collections.deque[Any] = collections.deque()
+    for item in iterator:
+        queue.append(item)
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

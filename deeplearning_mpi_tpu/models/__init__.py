@@ -1,0 +1,39 @@
+"""Model zoo: ResNet family, 2-D UNet (3-D UNet and transformer LM to follow).
+
+All models are Flax linen modules in NHWC layout (TPU-native; XLA tiles NHWC
+convs onto the MXU without the transposes NCHW would need) with a ``dtype``
+knob for bfloat16 compute and float32 parameters.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+
+from deeplearning_mpi_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from deeplearning_mpi_tpu.models.unet import UNet  # noqa: F401
+
+_RESNETS = {
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "resnet152": resnet152,
+}
+
+
+def get_model(name: str, **kwargs: Any) -> nn.Module:
+    """Build a model by name — the registry behind the trainers' ``--arch``."""
+    if name in _RESNETS:
+        return _RESNETS[name](**kwargs)
+    if name == "unet":
+        return UNet(**kwargs)
+    raise ValueError(f"unknown model '{name}'; choose from {sorted(_RESNETS) + ['unet']}")

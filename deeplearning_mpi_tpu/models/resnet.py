@@ -1,0 +1,165 @@
+"""ResNet family (18/34/50/101/152) in Flax linen, NHWC, bf16-ready.
+
+The reference builds torchvision's ``resnet18(weights=None)`` and swaps the
+head for 10 classes (``pytorch/resnet/main.py:40-41``); the torchvision
+architecture itself lives in the reference's *dependencies*, so this is a
+from-scratch TPU-native implementation of the same family, matching the
+torchvision v1.5 topology (stride on the 3×3 conv in bottleneck blocks) so
+parameter counts line up exactly (ResNet-18/10-class: 11,181,642 params).
+
+TPU-first choices:
+- NHWC layout end-to-end (MXU-friendly; no layout transposes).
+- ``dtype=bfloat16`` computes convs/matmuls on the MXU at 2× f32 throughput
+  while keeping parameters and BN statistics in float32.
+- BatchNorm uses **local** (per-replica) batch statistics by default —
+  exactly DDP's semantics, which never sync BN stats
+  (``pytorch/unet/model.py:10,13``; SURVEY.md §2c) — and cross-replica sync
+  BN via ``bn_cross_replica_axis='data'`` as an opt-in improvement.
+- The stem is switchable: ``stem='imagenet'`` is the torchvision-parity 7×7/2
+  + maxpool (what the reference runs on CIFAR-10, ``main.py:40``);
+  ``stem='cifar'`` is the standard 3×3/1 CIFAR variant, offered because on
+  32×32 inputs the imagenet stem throws away most of the image.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs + identity shortcut (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1×1 reduce → 3×3 (strided) → 1×1 expand ×4 (ResNet-50/101/152)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet. ``stage_sizes`` and ``block_cls`` select the variant."""
+
+    stage_sizes: Sequence[int]
+    block_cls: type
+    num_classes: int = 10
+    num_filters: int = 64
+    stem: str = "imagenet"
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    bn_momentum: float = 0.9  # = 1 - torch momentum 0.1
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_cross_replica_axis,
+        )
+
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), strides=(2, 2))(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3))(x)
+            x = norm()(x)
+            x = nn.relu(x)
+        else:
+            raise ValueError(f"unknown stem '{self.stem}'")
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**stage,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        # Head parity: fc replaced by Linear(·, num_classes) (main.py:41).
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)
+
+
+def resnet34(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock, **kw)
+
+
+def resnet50(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck, **kw)
+
+
+def resnet101(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck, **kw)
+
+
+def resnet152(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), block_cls=Bottleneck, **kw)

@@ -1,0 +1,115 @@
+"""2-D UNet in Flax linen, NHWC, bf16-ready.
+
+From-scratch TPU-native build of the reference's UNet
+(``pytorch/unet/model.py:5-81``): ``DoubleConv`` = 2×[Conv3×3 (SAME) + BN +
+ReLU] (``model.py:5-18``); four down blocks (DoubleConv then 2×2 max-pool,
+pre-pool output kept as skip, ``model.py:21-30``); 1024-channel bottleneck;
+four up blocks (2× upsample via transposed conv or bilinear, concat skip on
+the channel axis, DoubleConv, ``model.py:33-48``); 1×1 head to ``out_classes``
+(``model.py:68,80``). Channel schedule 3→64→128→256→512→1024→…→64
+(``model.py:56-68``).
+
+Deviations from the reference, on purpose:
+- NHWC instead of NCHW (TPU-native layout; concat axis is -1 not 1).
+- Convs before BatchNorm drop their bias (redundant with BN's shift; the
+  reference keeps torch's default bias=True).
+- BatchNorm uses local per-replica statistics by default — DDP parity
+  (SURVEY.md §2c) — with opt-in cross-replica sync via
+  ``bn_cross_replica_axis``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class DoubleConv(nn.Module):
+    """2×[Conv3×3 SAME + BN + ReLU] — ``pytorch/unet/model.py:5-18``."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for _ in range(2):
+            x = self.conv(self.filters, (3, 3))(x)
+            x = self.norm()(x)
+            x = nn.relu(x)
+        return x
+
+
+class UNet(nn.Module):
+    """Encoder/decoder UNet with skip connections.
+
+    ``features`` is the encoder channel schedule; the bottleneck doubles the
+    last entry (512→1024, ``pytorch/unet/model.py:61``). ``bilinear=False``
+    upsamples with a 2×2 stride-2 transposed conv (``model.py:37-38``);
+    ``bilinear=True`` uses resize + 1×1 conv (``model.py:40-43``).
+    """
+
+    out_classes: int = 1
+    features: Sequence[int] = (64, 128, 256, 512)
+    bilinear: bool = False
+    dtype: jnp.dtype = jnp.float32
+    bn_cross_replica_axis: str | None = None
+    bn_momentum: float = 0.9
+    bn_epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=self.bn_momentum,
+            epsilon=self.bn_epsilon,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.bn_cross_replica_axis,
+        )
+        double = functools.partial(DoubleConv, conv=conv, norm=norm)
+
+        x = x.astype(self.dtype)
+        skips = []
+        for f in self.features:
+            x = double(f)(x)  # pre-pool activation is the skip (model.py:27-30)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+
+        x = double(self.features[-1] * 2)(x)  # bottleneck (model.py:61)
+
+        for f, skip in zip(reversed(self.features), reversed(skips)):
+            if self.bilinear:
+                b, h, w, c = x.shape
+                x = jax.image.resize(x, (b, h * 2, w * 2, c), method="bilinear")
+                x = conv(f, (1, 1))(x)
+            else:
+                x = nn.ConvTranspose(
+                    f,
+                    (2, 2),
+                    strides=(2, 2),
+                    dtype=self.dtype,
+                    param_dtype=jnp.float32,
+                )(x)
+            x = jnp.concatenate([skip, x], axis=-1)  # concat on channels (model.py:46)
+            x = double(f)(x)
+
+        # 1×1 head, with bias (no BN follows) — model.py:68,80.
+        x = nn.Conv(
+            self.out_classes, (1, 1), dtype=self.dtype, param_dtype=jnp.float32
+        )(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,8 @@
+"""Numerical ops: losses, metrics, normalization helpers, Pallas kernels."""
+
+from deeplearning_mpi_tpu.ops.loss import (  # noqa: F401
+    dice_loss,
+    sigmoid_binary_cross_entropy,
+    softmax_cross_entropy,
+)
+from deeplearning_mpi_tpu.ops.metrics import dice_score, top1_accuracy  # noqa: F401

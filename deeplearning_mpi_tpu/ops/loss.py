@@ -1,0 +1,64 @@
+"""Loss functions.
+
+Parity targets: ``nn.CrossEntropyLoss()`` for the ResNet trainer
+(``pytorch/resnet/main.py:113``) and ``nn.BCEWithLogitsLoss()`` for the UNet
+trainer (``pytorch/unet/train.py:160-162``). Both are mean-reduced over all
+elements, matching the torch defaults. All losses are computed in float32
+regardless of input dtype — on TPU the model runs bfloat16 through the MXU but
+loss/softmax reductions need f32 accumulation for stability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels.
+
+    Equivalent of ``nn.CrossEntropyLoss()(outputs, labels)``
+    (``pytorch/resnet/main.py:113,129``): softmax over the last axis, mean
+    over the batch.
+    """
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean binary cross-entropy on logits.
+
+    Equivalent of ``nn.BCEWithLogitsLoss()(predictions, masks)``
+    (``pytorch/unet/train.py:160-162,183``): elementwise
+    ``max(x,0) - x*y + log(1+exp(-|x|))``, mean over all elements — the same
+    log-sum-exp-stable form torch uses.
+    """
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    per_elem = (
+        jnp.maximum(logits, 0.0)
+        - logits * targets
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+    return jnp.mean(per_elem)
+
+
+def dice_loss(
+    logits: jax.Array, targets: jax.Array, *, eps: float = 1e-8
+) -> jax.Array:
+    """Soft Dice loss (1 - soft Dice coefficient), averaged over the batch.
+
+    The reference only uses Dice as an eval metric
+    (``pytorch/unet/train.py:124-140``); offering it as a training loss is a
+    standard segmentation extension. Uses the same ``eps`` smoothing as the
+    reference's metric.
+    """
+    probs = jax.nn.sigmoid(logits.astype(jnp.float32))
+    targets = targets.astype(jnp.float32)
+    reduce_axes = tuple(range(1, logits.ndim))
+    intersection = jnp.sum(probs * targets, axis=reduce_axes)
+    union = jnp.sum(probs, axis=reduce_axes) + jnp.sum(targets, axis=reduce_axes)
+    dice = (2.0 * intersection + eps) / (union + eps)
+    return jnp.mean(1.0 - dice)

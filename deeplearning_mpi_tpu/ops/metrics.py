@@ -1,0 +1,48 @@
+"""Evaluation metrics.
+
+Parity targets: top-1 accuracy (``pytorch/resnet/main.py:57-73``) and the
+per-image Dice coefficient with its empty-mask convention
+(``pytorch/unet/train.py:104-140``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Fraction of argmax predictions matching integer labels.
+
+    Equivalent of the reference's ``torch.max(outputs,1)`` / correct-count
+    accumulation (``pytorch/resnet/main.py:64-71``). Returns a scalar in
+    [0, 1]; callers weight by batch size when accumulating across batches.
+    """
+    preds = jnp.argmax(logits, axis=-1)
+    return jnp.mean(jnp.asarray(preds == labels, jnp.float32))
+
+
+def dice_score(
+    pred_mask: jax.Array,
+    true_mask: jax.Array,
+    *,
+    eps: float = 1e-8,
+) -> jax.Array:
+    """Mean per-image Dice coefficient for binary masks.
+
+    Parity with ``pytorch/unet/train.py:124-140`` including its two
+    conventions: ``dice = (2·|∩| + eps) / (|pred| + |true| + eps)`` with
+    ``eps = 1e-8``, and **both-empty ⇒ 1.0** (a correctly predicted empty
+    mask counts as perfect, ``train.py:132-137``). Inputs are {0,1} masks of
+    shape [batch, ...spatial]; thresholding (sigmoid > 0.5,
+    ``train.py:119-122``) is the caller's job.
+    """
+    pred = pred_mask.astype(jnp.float32)
+    true = true_mask.astype(jnp.float32)
+    reduce_axes = tuple(range(1, pred.ndim))
+    intersection = jnp.sum(pred * true, axis=reduce_axes)
+    denom = jnp.sum(pred, axis=reduce_axes) + jnp.sum(true, axis=reduce_axes)
+    dice = (2.0 * intersection + eps) / (denom + eps)
+    both_empty = denom == 0
+    dice = jnp.where(both_empty, 1.0, dice)
+    return jnp.mean(dice)

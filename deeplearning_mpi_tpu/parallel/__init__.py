@@ -1,0 +1,14 @@
+"""Parallelism strategies: sharding rules over the 5-axis mesh.
+
+The reference implements exactly one strategy — data parallelism via DDP
+(SURVEY.md §2c). Here DP is a *sharding annotation* (batch over ``data``,
+params replicated), and the other strategies are additional annotations over
+the same mesh rather than new machinery: tensor parallelism shards weight
+matrices over ``model``, sequence parallelism shards the token axis over
+``seq`` (ring attention), expert parallelism shards experts over ``expert``.
+"""
+
+from deeplearning_mpi_tpu.parallel.tensor_parallel import (  # noqa: F401
+    infer_tp_param_sharding,
+    shard_state,
+)

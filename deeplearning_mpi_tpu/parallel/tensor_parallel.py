@@ -1,0 +1,71 @@
+"""Tensor parallelism: GSPMD sharding rules for parameter pytrees.
+
+Out of scope for reference parity (no megatron-style layers anywhere in the
+reference — SURVEY.md §2c), but first-class here: the mesh reserves a
+``model`` axis, and these rules shard weight kernels over it. XLA's GSPMD
+partitioner then splits the matmuls/convs across the axis and inserts the
+all-gather/reduce-scatter collectives — the TPU-native way to get
+megatron-style TP without hand-writing either the sharded layers or their
+collectives.
+
+Rules (shape-based, applied leaf-wise):
+- ``Dense``/conv kernels ``[..., in, out]`` → shard ``out`` (columns /
+  output channels) over ``model`` when divisible and big enough to matter;
+- 0/1-D leaves (biases, BN scale/shift/stats, step counters) replicated.
+
+Because the rule depends only on leaf shape, it applies uniformly to the
+whole train state: optimizer moments mirror their parameters' shapes and
+land on identical shardings — a free half of ZeRO (momentum memory splits
+across ``model`` wherever weights do).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_MODEL
+
+PyTree = Any
+
+
+def tp_spec(leaf: jax.Array, tp: int, *, axis: str = AXIS_MODEL, min_size: int = 1024) -> P:
+    """PartitionSpec for one leaf under the column-parallel rule."""
+    if tp > 1 and leaf.ndim >= 2 and leaf.size >= min_size and leaf.shape[-1] % tp == 0:
+        return P(*([None] * (leaf.ndim - 1)), axis)
+    return P()
+
+
+def infer_tp_param_sharding(
+    params: PyTree,
+    mesh: Mesh,
+    *,
+    axis: str = AXIS_MODEL,
+    min_size: int = 1024,
+) -> PyTree:
+    """NamedSharding pytree for ``params`` (or any params-shaped pytree)."""
+    tp = mesh.shape[axis]
+    return jax.tree.map(
+        lambda leaf: NamedSharding(
+            mesh, tp_spec(leaf, tp, axis=axis, min_size=min_size)
+        ),
+        params,
+    )
+
+
+def shard_state(state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL) -> PyTree:
+    """Place a whole TrainState on the mesh under the TP rule.
+
+    Kernels and their optimizer moments shard over ``model``; biases, BN
+    statistics, and the step counter replicate. With ``tp == 1`` this
+    degrades to full replication — exactly pure DP.
+    """
+    tp = mesh.shape[tp_axis]
+    return jax.tree.map(
+        lambda leaf: jax.device_put(
+            leaf, NamedSharding(mesh, tp_spec(leaf, tp, axis=tp_axis))
+        ),
+        state,
+    )

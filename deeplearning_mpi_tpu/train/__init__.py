@@ -1,0 +1,9 @@
+"""Training layer: train state, jitted step factories, trainer loop, checkpoints."""
+
+from deeplearning_mpi_tpu.train.state import TrainState, create_train_state  # noqa: F401
+from deeplearning_mpi_tpu.train.trainer import (  # noqa: F401
+    Trainer,
+    make_eval_step,
+    make_train_step,
+)
+from deeplearning_mpi_tpu.train.checkpoint import Checkpointer  # noqa: F401

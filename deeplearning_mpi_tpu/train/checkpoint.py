@@ -1,0 +1,75 @@
+"""Checkpoint/resume via Orbax.
+
+The reference checkpoints by overwriting one ``.pth`` with the DDP-prefixed
+``state_dict`` from rank 0, losing optimizer state and step count; resume
+reloads weights only and restarts at epoch 0 (``pytorch/resnet/main.py:48-52,
+136-139``, ``pytorch/unet/train.py:72-74,213-216``; SURVEY.md §5.4). This
+checkpointer saves the **full** train state (params + BN stats + optimizer
+state + step) with Orbax — sharded save/restore, every host participating,
+process 0 coordinating — and keeps a history of steps instead of overwriting.
+The ``cuda:0 → cuda:LOCAL_RANK`` map_location remap the reference needs
+(``resnet/main.py:49``) has no analog: Orbax restores arrays directly into
+their target shardings.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from deeplearning_mpi_tpu.train.state import TrainState
+
+
+class Checkpointer:
+    """Save/restore the full train state under ``directory``.
+
+    The epoch is stored as the checkpoint step label, so resume can continue
+    the epoch loop where it stopped — unlike the reference, which always
+    restarts at epoch 0 with a fresh optimizer.
+    """
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+        self.directory = Path(directory).absolute()
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: TrainState, *, epoch: int) -> None:
+        # Static fields (apply_fn, tx) are not data; persist arrays only.
+        self.manager.save(
+            epoch, args=ocp.args.StandardSave(_arrays_only(state))
+        )
+        self.manager.wait_until_finished()
+
+    def latest_epoch(self) -> int | None:
+        return self.manager.latest_step()
+
+    def restore(self, template: TrainState, *, epoch: int | None = None) -> TrainState:
+        """Restore into the shardings/dtypes of ``template`` (a freshly
+        created state — supplies apply_fn/tx, which are code, not data)."""
+        if epoch is None:
+            epoch = self.manager.latest_step()
+        if epoch is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        restored = self.manager.restore(
+            epoch, args=ocp.args.StandardRestore(_arrays_only(template))
+        )
+        return template.replace(**restored)
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def _arrays_only(state: TrainState) -> dict[str, Any]:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }

@@ -1,0 +1,301 @@
+"""Jitted train/eval step factories and the epoch-loop trainer.
+
+TPU-native replacement for the reference's training loops
+(``pytorch/resnet/main.py:76-144`` ``run()``;
+``pytorch/unet/train.py:143-244`` ``train_model()``). The DDP wrapper object
+disappears: the whole optimizer step is one jitted SPMD program over the mesh
+— batch sharded on the ``data`` axis, parameters replicated (or sharded over
+``model`` for tensor parallelism), and the gradient all-reduce that DDP's
+reducer performs bucket-by-bucket during backward
+(``pytorch/resnet/main.py:131``) is inserted by XLA from the sharding
+annotations and overlapped by its latency-hiding scheduler.
+
+Semantics carried over exactly (SURVEY.md §7 "Matching DDP semantics"):
+- loss is *averaged* over the global batch ⇒ gradients match DDP's
+  rank-averaged gradients;
+- BatchNorm uses local per-replica statistics (DDP never syncs BN);
+- non-finite loss skips the optimizer step but still counts the batch
+  (``pytorch/unet/train.py:186-188``);
+- gradient clipping by global norm (``pytorch/unet/train.py:194``).
+
+Deliberately fixed: evaluation is a collective jitted function over all
+devices instead of the reference's rank-0-only forward through a DDP model —
+a latent desync/deadlock (``pytorch/resnet/main.py:137-138``; SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from deeplearning_mpi_tpu.data.loader import prefetch
+from deeplearning_mpi_tpu.ops import (
+    dice_score,
+    sigmoid_binary_cross_entropy,
+    softmax_cross_entropy,
+    top1_accuracy,
+)
+from deeplearning_mpi_tpu.train.state import TrainState
+
+Batch = dict[str, jax.Array]
+LossFn = Callable[[jax.Array, Batch], jax.Array]
+
+#: batch key holding the target, per task.
+_TARGETS = {"classification": "label", "segmentation": "mask"}
+
+
+def _task_loss(task: str) -> LossFn:
+    if task == "classification":
+        return lambda logits, batch: softmax_cross_entropy(logits, batch["label"])
+    if task == "segmentation":
+        return lambda logits, batch: sigmoid_binary_cross_entropy(
+            logits[..., 0], batch["mask"]
+        )
+    raise ValueError(f"unknown task '{task}'")
+
+
+def make_train_step(
+    task: str,
+    *,
+    donate: bool = True,
+) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
+    """Build the jitted optimizer step for a task.
+
+    Grad clipping and the optimizer live in ``state.tx`` (optax chain), so one
+    step function serves every workload. ``donate=True`` donates the input
+    state's buffers — the update is in-place in HBM, halving peak parameter
+    memory versus the reference's retain-everything step.
+    """
+    loss_fn = _task_loss(task)
+
+    def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict[str, jax.Array]]:
+        def compute_loss(params):
+            outputs, mutated = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                batch["image"],
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return loss_fn(outputs, batch), mutated["batch_stats"]
+
+        (loss, new_batch_stats), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params)
+
+        updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # NaN/Inf guard: skip the whole update, keep the old state
+        # (parity: pytorch/unet/train.py:186-188 `continue`s the batch).
+        finite = jnp.isfinite(loss)
+        keep = lambda new, old: jax.tree.map(
+            lambda n, o: jnp.where(finite, n, o), new, old
+        )
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=keep(new_params, state.params),
+                batch_stats=keep(new_batch_stats, state.batch_stats),
+                opt_state=keep(new_opt_state, state.opt_state),
+            ),
+            {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)},
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(task: str) -> Callable[[TrainState, Batch], dict[str, jax.Array]]:
+    """Build the jitted eval step: loss + task metric on one batch.
+
+    Classification: top-1 accuracy (``pytorch/resnet/main.py:57-73``).
+    Segmentation: sigmoid > 0.5 threshold then per-image Dice
+    (``pytorch/unet/train.py:115-140``).
+    """
+    loss_fn = _task_loss(task)
+
+    def step(state: TrainState, batch: Batch) -> dict[str, jax.Array]:
+        outputs = state.apply_fn(state.variables(), batch["image"], train=False)
+        metrics = {"loss": loss_fn(outputs, batch)}
+        if task == "classification":
+            metrics["accuracy"] = top1_accuracy(outputs, batch["label"])
+        else:
+            pred = (jax.nn.sigmoid(outputs[..., 0]) > 0.5).astype(jnp.float32)
+            metrics["dice"] = dice_score(pred, batch["mask"])
+        return metrics
+
+    return jax.jit(step)
+
+
+def build_optimizer(
+    name: str,
+    learning_rate: float | optax.Schedule,
+    *,
+    momentum: float = 0.9,
+    weight_decay: float = 0.0,
+    clip_norm: float | None = None,
+) -> optax.GradientTransformation:
+    """Reference-parity optimizers as optax chains.
+
+    - ``sgd``: SGD + momentum 0.9 + weight decay 1e-5 for ResNet
+      (``pytorch/resnet/main.py:114``). torch couples weight decay into the
+      gradient (L2), so this uses ``optax.add_decayed_weights`` before
+      momentum — the same coupling.
+    - ``adam``: Adam for UNet (``pytorch/unet/train.py:160``), with the
+      trainer's grad-clip 1.0 (``train.py:194``) prepended when requested.
+    """
+    parts: list[optax.GradientTransformation] = []
+    if clip_norm is not None:
+        parts.append(optax.clip_by_global_norm(clip_norm))
+    if name == "sgd":
+        if weight_decay:
+            parts.append(optax.add_decayed_weights(weight_decay))
+        parts.append(optax.sgd(learning_rate, momentum=momentum))
+    elif name == "adam":
+        parts.append(optax.adam(learning_rate))
+    else:
+        raise ValueError(f"unknown optimizer '{name}'")
+    return optax.chain(*parts)
+
+
+class Trainer:
+    """Epoch-loop driver with the reference's cadence and instrumentation.
+
+    Mirrors ``run()`` / ``train_model()``: per-epoch mean loss, every-10-epoch
+    eval + checkpoint, final eval + save, per-epoch wall-clock — plus the
+    step-level timing the reference lacks (images/sec, SURVEY.md §6).
+    """
+
+    def __init__(
+        self,
+        state: TrainState,
+        task: str,
+        mesh: Mesh,
+        *,
+        logger: Any = None,
+        checkpointer: Any = None,
+        eval_every: int = 10,  # "every 10 epochs" (resnet/main.py:136, unet/train.py:213)
+    ) -> None:
+        self.state = state
+        self.task = task
+        self.mesh = mesh
+        self.logger = logger
+        self.checkpointer = checkpointer
+        self.eval_every = eval_every
+        self.train_step = make_train_step(task)
+        self.eval_step = make_eval_step(task)
+        self.history: list[dict[str, float]] = []
+
+    def _log(self, msg: str) -> None:
+        if self.logger is not None:
+            self.logger.log(msg)
+        elif jax.process_index() == 0:
+            print(msg)
+
+    def run_epoch(self, loader: Any, epoch: int) -> dict[str, float]:
+        """One training epoch; returns mean loss + timing stats."""
+        t0 = time.perf_counter()
+        losses: list[jax.Array] = []
+        n_batches = 0
+        images = 0
+        for batch in prefetch(loader.epoch(epoch)):
+            self.state, metrics = self.train_step(self.state, batch)
+            losses.append(metrics["loss"])
+            n_batches += 1
+            images += batch["image"].shape[0]
+        if not n_batches:
+            raise ValueError("empty epoch — dataset smaller than one global batch")
+        mean_loss = float(jnp.mean(jnp.stack(losses)))  # one host sync per epoch
+        duration = time.perf_counter() - t0
+        stats = {
+            "epoch": epoch,
+            "loss": mean_loss,
+            "duration_s": duration,
+            "images_per_s": images / duration,
+        }
+        # Parity: per-epoch loss print (resnet/main.py:134) + duration log
+        # (unet/train.py:207-211), with throughput added.
+        self._log(
+            f"Epoch {epoch}: loss {mean_loss:.4f}, {duration:.1f}s, "
+            f"{stats['images_per_s']:.1f} images/s"
+        )
+        return stats
+
+    def evaluate(self, loader: Any) -> dict[str, float]:
+        """Collective evaluation over the full loader (all processes/devices).
+
+        Accumulates on-device (one host sync at the end) so eval batches keep
+        JAX's async dispatch pipelined, like the train loop.
+        """
+        sums: dict[str, jax.Array] = {}
+        weight = 0
+        for batch in prefetch(loader.epoch(0)):
+            metrics = self.eval_step(self.state, batch)
+            bs = batch["image"].shape[0]
+            for k, v in metrics.items():
+                sums[k] = sums[k] + v * bs if k in sums else v * bs
+            weight += bs
+        if not weight:
+            raise ValueError("empty eval loader")
+        return {k: float(v) / weight for k, v in sums.items()}
+
+    def fit(
+        self,
+        train_loader: Any,
+        num_epochs: int,
+        *,
+        eval_loader: Any = None,
+        start_epoch: int = 0,
+    ) -> list[dict[str, float]]:
+        """Full training run with the reference's eval/checkpoint cadence."""
+        if start_epoch >= num_epochs:
+            self._log(
+                f"nothing to do: start epoch {start_epoch} >= num_epochs {num_epochs}"
+            )
+            return self.history
+        last_evaled = last_saved = -1
+        for epoch in range(start_epoch, num_epochs):
+            stats = self.run_epoch(train_loader, epoch)
+            if epoch % self.eval_every == 0:
+                if eval_loader is not None:
+                    eval_metrics = self.evaluate(eval_loader)
+                    last_evaled = epoch
+                    stats.update({f"eval_{k}": v for k, v in eval_metrics.items()})
+                    self._log(
+                        f"Epoch {epoch} eval: "
+                        + ", ".join(f"{k} {v:.4f}" for k, v in eval_metrics.items())
+                    )
+                if self.checkpointer is not None:
+                    self.checkpointer.save(self.state, epoch=epoch)
+                    last_saved = epoch
+            self.history.append(stats)
+        # Final eval + save (parity: unet/train.py:223-244) — skipped when the
+        # last epoch already hit the cadence (no duplicate eval/checkpoint).
+        final_epoch = num_epochs - 1
+        if eval_loader is not None and last_evaled != final_epoch:
+            final = self.evaluate(eval_loader)
+            self.history[-1].update({f"eval_{k}": v for k, v in final.items()})
+            self._log(
+                "Final eval: " + ", ".join(f"{k} {v:.4f}" for k, v in final.items())
+            )
+        if self.checkpointer is not None and last_saved != final_epoch:
+            self.checkpointer.save(self.state, epoch=final_epoch)
+        return self.history
+
+    def place_state(self) -> None:
+        """Place the state on the mesh under the TP sharding rule.
+
+        With a ``model`` axis of size 1 this is full replication — pure DP,
+        the DDP-parity configuration. With tp > 1, kernels and their optimizer
+        moments shard over ``model`` (megatron-style TP via GSPMD).
+        """
+        from deeplearning_mpi_tpu.parallel import shard_state
+
+        self.state = shard_state(self.state, self.mesh)
+
+    # Back-compat alias for the DP-only name.
+    replicate_state = place_state

@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: run logging, config/flag system."""
+
+from deeplearning_mpi_tpu.utils.logging import RunLogger  # noqa: F401

@@ -1,0 +1,78 @@
+"""Flag system: the reference's argparse contract + TPU topology flags.
+
+The reference configures runs through three tiers (SURVEY.md §5.6): torchrun
+env vars for topology, argparse for hyperparameters
+(``pytorch/resnet/main.py:167-182``, ``pytorch/unet/train.py:310-347``), and
+interactive bash prompts that assemble the command (``pytorch/unet/run.sh``).
+Here everything is flags (env vars still honored by ``bootstrap.init``), with
+the reference's exact flag names and defaults preserved so commands port 1:1.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def add_topology_flags(parser: argparse.ArgumentParser) -> None:
+    """Distributed/topology flags — replaces torchrun's CLI + the run.sh
+    prompts (``pytorch/unet/run.sh:100-104``)."""
+    group = parser.add_argument_group("topology")
+    group.add_argument("--coordinator", default=None, help="coordinator addr:port (multi-host; replaces MASTER_ADDR:MASTER_PORT)")
+    group.add_argument("--num_processes", type=int, default=None, help="number of host processes (replaces WORLD_SIZE)")
+    group.add_argument("--process_id", type=int, default=None, help="this process's id (replaces RANK)")
+    group.add_argument("--platform", default=None, choices=("cpu", "tpu"), help="force JAX platform; cpu is the gloo-parity fallback (hello_world.py:44)")
+    group.add_argument("--n_virtual_devices", type=int, default=None, help="fake N CPU devices for hardware-free multi-device runs")
+    group.add_argument("--dp", type=int, default=-1, help="data-parallel degree (-1: all remaining devices)")
+    group.add_argument("--tp", type=int, default=1, help="tensor-parallel degree (model axis)")
+
+
+def add_training_flags(
+    parser: argparse.ArgumentParser,
+    *,
+    num_epochs: int = 100,
+    batch_size: int = 128,
+    learning_rate: float = 0.1,
+    random_seed: int = 0,
+    model_dir: str = "saved_models",
+    model_filename: str = "model",
+) -> None:
+    """The reference's shared hyperparameter flags, names and defaults intact.
+
+    ResNet defaults: epochs 100, batch 128, lr 0.1, seed 0
+    (``pytorch/resnet/main.py:162-176``). UNet callers override to batch 16,
+    lr 1e-4, seed 42 (``pytorch/unet/train.py:314-335``). ``--batch_size``
+    here is the **global** batch (the reference's is per-process — documented
+    difference; one process per host changes the natural unit).
+    """
+    group = parser.add_argument_group("training")
+    group.add_argument("--num_epochs", type=int, default=num_epochs)
+    group.add_argument("--batch_size", type=int, default=batch_size, help="GLOBAL batch size")
+    group.add_argument("--learning_rate", type=float, default=learning_rate)
+    group.add_argument("--random_seed", type=int, default=random_seed)
+    group.add_argument("--model_dir", default=model_dir)
+    group.add_argument("--model_filename", default=model_filename)
+    group.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --model_dir (full state: step + optimizer too, unlike the reference's weights-only resume, train.py:342-345)")
+    group.add_argument("--log_dir", default="logs")
+    group.add_argument("--eval_every", type=int, default=10, help="epochs between evals/checkpoints (reference cadence: resnet/main.py:136)")
+    group.add_argument("--dtype", default="float32", choices=("float32", "bfloat16"), help="compute dtype (params stay float32)")
+
+
+def setup_runtime(args: argparse.Namespace):
+    """Apply topology flags and initialize the runtime. Returns (topology, mesh).
+
+    Import-deferred so flag parsing (--help) never initializes a backend.
+    """
+    from deeplearning_mpi_tpu.runtime import bootstrap
+    from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+    if args.n_virtual_devices:
+        bootstrap.set_virtual_cpu_devices(args.n_virtual_devices)
+        args.platform = "cpu"
+    topo = bootstrap.init(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        platform=args.platform,
+    )
+    mesh = create_mesh(MeshSpec(data=args.dp, model=args.tp))
+    return topo, mesh

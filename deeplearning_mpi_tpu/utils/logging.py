@@ -1,0 +1,60 @@
+"""Run logging: timestamped log files + stdout, process-0 gated.
+
+Parity with the reference's file logger (``create_log_file`` /
+``log_to_file``, ``pytorch/unet/train.py:44-57``): one
+``logs/training_log_%Y%m%d_%H%M%S.log`` per run, hyperparameters and system
+info recorded at startup (``train.py:356-360``), per-epoch metrics appended.
+Non-coordinator processes log nothing, like the reference's rank-0 gating
+(``train.py:208``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax
+
+from deeplearning_mpi_tpu.runtime.bootstrap import get_system_information
+
+
+class RunLogger:
+    """Print + append-to-file logger, active only on process 0."""
+
+    def __init__(
+        self,
+        log_dir: str | Path | None = None,
+        *,
+        echo: bool = True,
+        run_name: str | None = None,
+    ) -> None:
+        self.echo = echo
+        self.enabled = jax.process_index() == 0
+        self.path: Path | None = None
+        if self.enabled and log_dir is not None:
+            stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+            name = run_name or f"training_log_{stamp}"
+            log_dir = Path(log_dir)
+            log_dir.mkdir(parents=True, exist_ok=True)
+            self.path = log_dir / f"{name}.log"
+            self.path.touch()
+
+    def log(self, message: str) -> None:
+        if not self.enabled:
+            return
+        stamp = datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S")
+        line = f"[{stamp}] {message}"
+        if self.echo:
+            print(line, flush=True)
+        if self.path is not None:
+            with self.path.open("a") as f:
+                f.write(line + "\n")
+
+    def log_hyperparameters(self, params: Mapping[str, Any]) -> None:
+        """Startup block parity: hyperparams + world info (train.py:356-360)."""
+        self.log("hyperparameters: " + json.dumps(dict(params), default=str))
+
+    def log_system_information(self) -> None:
+        self.log("system: " + json.dumps(get_system_information()))

@@ -1,0 +1,168 @@
+"""Data pipeline tests: sharding semantics, reshuffle fix, transforms, datasets."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deeplearning_mpi_tpu.data import (
+    ShardedLoader,
+    SyntheticCIFAR10,
+    SyntheticShapesDataset,
+)
+from deeplearning_mpi_tpu.data.cifar10 import eval_transform, train_transform
+from deeplearning_mpi_tpu.data.segmentation import (
+    CarvanaDataset,
+    SegmentationFolderDataset,
+)
+
+
+class TestShardedLoader:
+    def test_batch_shapes_and_sharding(self, mesh):
+        ds = SyntheticCIFAR10(64)
+        loader = ShardedLoader(ds, 16, mesh, shuffle=False)
+        batch = next(iter(loader))
+        assert batch["image"].shape == (16, 32, 32, 3)
+        assert batch["label"].shape == (16,)
+        # sharded over the 8-device data axis: 2 examples per device
+        assert batch["image"].addressable_shards[0].data.shape[0] == 2
+
+    def test_steps_per_epoch_drop_last(self, mesh):
+        ds = SyntheticCIFAR10(70)
+        loader = ShardedLoader(ds, 16, mesh, shuffle=False)
+        assert loader.steps_per_epoch() == 4
+        assert len(list(loader.epoch(0))) == 4
+
+    def test_epoch_reshuffle_differs(self, mesh):
+        # The set_epoch fix: different epochs -> different batch order
+        # (the reference never reshuffles; SURVEY.md §2c).
+        ds = SyntheticCIFAR10(64)
+        loader = ShardedLoader(ds, 32, mesh, shuffle=True, seed=0)
+        e0 = np.asarray(next(iter(loader.epoch(0)))["label"])
+        e1 = np.asarray(next(iter(loader.epoch(1)))["label"])
+        assert not np.array_equal(e0, e1)
+
+    def test_same_epoch_deterministic(self, mesh):
+        ds = SyntheticCIFAR10(64)
+        loader = ShardedLoader(ds, 32, mesh, shuffle=True, seed=0)
+        a = np.asarray(next(iter(loader.epoch(3)))["label"])
+        b = np.asarray(next(iter(loader.epoch(3)))["label"])
+        np.testing.assert_array_equal(a, b)
+
+    def test_full_coverage_without_shuffle(self, mesh):
+        ds = SyntheticCIFAR10(64)
+        loader = ShardedLoader(ds, 16, mesh, shuffle=False)
+        seen = np.concatenate([np.asarray(b["label"]) for b in loader.epoch(0)])
+        assert len(seen) == 64
+        np.testing.assert_array_equal(np.sort(seen), np.sort(ds.labels))
+
+    def test_indivisible_batch_rejected_at_construction(self, mesh):
+        ds = SyntheticCIFAR10(64)
+        ShardedLoader(ds, 16, mesh)  # ok
+        with pytest.raises(ValueError, match="data-parallel degree"):
+            ShardedLoader(ds, 12, mesh)  # 12 rows cannot shard over 8 devices
+
+    def test_small_eval_set_wrap_pads(self, mesh):
+        # validation set smaller than one global batch: drop_last=False pads
+        # by wrapping so eval still sees one full, shardable batch.
+        ds = SyntheticCIFAR10(5)
+        loader = ShardedLoader(ds, 16, mesh, shuffle=False, drop_last=False)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 1
+        assert batches[0]["image"].shape == (16, 32, 32, 3)
+        labels = np.asarray(batches[0]["label"])
+        np.testing.assert_array_equal(labels[:5], ds.labels)
+        np.testing.assert_array_equal(labels[5:10], ds.labels)  # wrapped
+
+    def test_empty_epoch_raises_clearly(self, mesh):
+        ds = SyntheticCIFAR10(5)
+        loader = ShardedLoader(ds, 16, mesh, shuffle=False)  # drop_last=True
+        with pytest.raises(ValueError, match="no full batch"):
+            next(iter(loader.epoch(0)))
+
+
+class TestTransforms:
+    def test_train_transform_shapes_and_range(self):
+        batch = {
+            "image": np.random.default_rng(0).integers(0, 256, (8, 32, 32, 3)).astype(np.uint8),
+            "label": np.zeros(8, np.int32),
+        }
+        out = train_transform(batch, np.random.default_rng(0))
+        assert out["image"].shape == (8, 32, 32, 3)
+        assert out["image"].dtype == np.float32
+        assert abs(float(out["image"].mean())) < 3.0  # normalized scale
+
+    def test_eval_transform_deterministic(self):
+        batch = {
+            "image": np.full((2, 32, 32, 3), 128, np.uint8),
+            "label": np.zeros(2, np.int32),
+        }
+        a = eval_transform(batch)["image"]
+        b = eval_transform(batch)["image"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_crop_jitters_content(self):
+        rng_img = np.random.default_rng(1)
+        batch = {
+            "image": rng_img.integers(0, 256, (4, 32, 32, 3)).astype(np.uint8),
+            "label": np.zeros(4, np.int32),
+        }
+        out1 = train_transform(batch, np.random.default_rng(10))
+        out2 = train_transform(batch, np.random.default_rng(11))
+        assert not np.array_equal(out1["image"], out2["image"])
+
+
+class TestSegmentationFolder:
+    @pytest.fixture()
+    def folder(self, tmp_path):
+        images, masks = tmp_path / "images", tmp_path / "masks"
+        images.mkdir(), masks.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(4):
+            Image.fromarray(
+                rng.integers(0, 256, (40, 40, 3)).astype(np.uint8)
+            ).save(images / f"img{i}.png")
+            Image.fromarray(
+                (rng.random((40, 40)) > 0.5).astype(np.uint8) * 255
+            ).save(masks / f"img{i}_mask.png")
+        return tmp_path
+
+    def test_carvana_layout(self, folder):
+        ds = CarvanaDataset(folder / "images", folder / "masks", scale=0.5)
+        assert len(ds) == 4
+        ex = ds[0]
+        assert ex["image"].shape == (20, 20, 3)
+        assert ex["mask"].shape == (20, 20)
+        assert set(np.unique(ex["mask"])) <= {0.0, 1.0}
+        assert 0.0 <= ex["image"].min() and ex["image"].max() <= 1.0
+
+    def test_bad_scale_rejected(self, folder):
+        with pytest.raises(ValueError):
+            SegmentationFolderDataset(folder / "images", folder / "masks", scale=0.0)
+
+    def test_missing_mask_raises(self, folder):
+        (folder / "masks" / "img0_mask.png").unlink()
+        ds = CarvanaDataset(folder / "images", folder / "masks", scale=0.5)
+        with pytest.raises(AssertionError, match="exactly one"):
+            ds[0]
+
+    def test_empty_dir_raises(self, tmp_path):
+        (tmp_path / "images").mkdir(), (tmp_path / "masks").mkdir()
+        with pytest.raises(RuntimeError, match="no input images"):
+            SegmentationFolderDataset(tmp_path / "images", tmp_path / "masks")
+
+
+class TestSyntheticDatasets:
+    def test_cifar_deterministic(self):
+        a, b = SyntheticCIFAR10(16, seed=3), SyntheticCIFAR10(16, seed=3)
+        np.testing.assert_array_equal(a[5]["image"], b[5]["image"])
+
+    def test_shapes_learnable_structure(self):
+        ds = SyntheticShapesDataset(8, size=32)
+        ex = ds[0]
+        assert ex["image"].shape == (32, 32, 3)
+        assert ex["mask"].shape == (32, 32)
+        assert 0 < ex["mask"].mean() < 1  # mask nontrivial
+        # foreground visibly brighter than background
+        fg = ex["image"][ex["mask"] == 1].mean()
+        bg = ex["image"][ex["mask"] == 0].mean()
+        assert fg > bg + 0.1

@@ -1,0 +1,116 @@
+"""Model tests: shapes, parameter-count parity with the torchvision topology,
+bf16 paths, and the reference's own smoke-test configuration."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning_mpi_tpu.models import UNet, get_model, resnet18, resnet50
+
+
+def n_params(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+def init_model(model, shape, train=False):
+    variables = model.init(jax.random.key(0), jnp.zeros(shape), train=train)
+    return variables
+
+
+class TestResNet:
+    def test_resnet18_cifar_forward_shape(self):
+        model = resnet18(num_classes=10)
+        variables = init_model(model, (2, 32, 32, 3))
+        out = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+        assert out.shape == (2, 10)
+        assert out.dtype == jnp.float32
+
+    def test_resnet18_param_count_matches_torchvision(self):
+        # torchvision resnet18 with fc->10 (pytorch/resnet/main.py:40-41) has
+        # 11,689,512 - 513,000 + 5,130 = 11,181,642 parameters.
+        model = resnet18(num_classes=10)
+        variables = init_model(model, (1, 32, 32, 3))
+        assert n_params(variables["params"]) == 11_181_642
+
+    def test_resnet50_param_count_matches_torchvision(self):
+        # torchvision resnet50 (25,557,032 @1000 classes) with a 10-class head.
+        model = resnet50(num_classes=10)
+        variables = init_model(model, (1, 32, 32, 3))
+        assert n_params(variables["params"]) == 23_528_522
+
+    def test_cifar_stem_keeps_resolution(self):
+        model = resnet18(num_classes=10, stem="cifar")
+        variables = init_model(model, (1, 32, 32, 3))
+        out = model.apply(variables, jnp.zeros((1, 32, 32, 3)), train=False)
+        assert out.shape == (1, 10)
+
+    def test_bf16_compute_f32_params(self):
+        model = resnet18(num_classes=10, dtype=jnp.bfloat16)
+        variables = init_model(model, (1, 32, 32, 3))
+        leaf = jax.tree.leaves(variables["params"])[0]
+        assert leaf.dtype == jnp.float32
+        out = model.apply(variables, jnp.zeros((1, 32, 32, 3)), train=False)
+        assert out.dtype == jnp.float32  # logits promoted back for the loss
+
+    def test_train_mode_updates_batch_stats(self):
+        model = resnet18(num_classes=10)
+        variables = init_model(model, (2, 32, 32, 3), train=True)
+        _, mutated = model.apply(
+            variables,
+            jax.random.normal(jax.random.key(1), (2, 32, 32, 3)),
+            train=True,
+            mutable=["batch_stats"],
+        )
+        old = variables["batch_stats"]["BatchNorm_0"]["mean"]
+        new = mutated["batch_stats"]["BatchNorm_0"]["mean"]
+        assert not jnp.allclose(old, new)
+
+
+class TestUNet:
+    def test_reference_smoke_config(self):
+        # The reference's own smoke test: 1x3x512x512 -> 1 class
+        # (pytorch/unet/model.py:84-89). NHWC here; 128px to keep CPU tests fast,
+        # same architecture.
+        model = UNet(out_classes=1)
+        variables = init_model(model, (1, 128, 128, 3))
+        out = model.apply(variables, jnp.zeros((1, 128, 128, 3)), train=False)
+        assert out.shape == (1, 128, 128, 1)
+
+    def test_param_count_in_reference_class(self):
+        # SURVEY.md §6 calls the reference UNet "31M-param class" (1024-ch
+        # bottleneck). Bias-free convs shave <0.1%; assert the ballpark.
+        model = UNet(out_classes=1)
+        variables = init_model(model, (1, 64, 64, 3))
+        count = n_params(variables["params"])
+        assert 30_000_000 < count < 32_000_000
+
+    def test_bilinear_variant(self):
+        model = UNet(out_classes=1, bilinear=True)
+        variables = init_model(model, (1, 64, 64, 3))
+        out = model.apply(variables, jnp.zeros((1, 64, 64, 3)), train=False)
+        assert out.shape == (1, 64, 64, 1)
+
+    def test_multiclass_head(self):
+        model = UNet(out_classes=3)
+        variables = init_model(model, (1, 64, 64, 3))
+        out = model.apply(variables, jnp.zeros((1, 64, 64, 3)), train=False)
+        assert out.shape == (1, 64, 64, 3)
+
+    def test_odd_size_rejected_cleanly(self):
+        # 4 pooling levels need /16 divisibility; a 100px input breaks the
+        # concat. It should raise, not silently mis-shape.
+        model = UNet(out_classes=1)
+        with pytest.raises(Exception):
+            init_model(model, (1, 100, 100, 3))
+
+
+class TestRegistry:
+    def test_get_model_resnet(self):
+        assert get_model("resnet34", num_classes=7).num_classes == 7
+
+    def test_get_model_unet(self):
+        assert get_model("unet", out_classes=2).out_classes == 2
+
+    def test_unknown_model(self):
+        with pytest.raises(ValueError, match="unknown model"):
+            get_model("vgg16")

@@ -1,0 +1,91 @@
+"""Tests for losses and metrics, cross-checked against torch (CPU) where the
+reference semantics come from torch builtins."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from deeplearning_mpi_tpu.ops import (
+    dice_loss,
+    dice_score,
+    sigmoid_binary_cross_entropy,
+    softmax_cross_entropy,
+    top1_accuracy,
+)
+
+
+class TestSoftmaxCrossEntropy:
+    def test_matches_torch_cross_entropy(self):
+        # Parity target: nn.CrossEntropyLoss() (pytorch/resnet/main.py:113).
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(16, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, size=(16,))
+        ours = softmax_cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+        theirs = F.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+        assert float(ours) == pytest.approx(float(theirs), abs=1e-5)
+
+    def test_perfect_prediction_near_zero(self):
+        logits = jnp.asarray([[100.0, 0.0], [0.0, 100.0]])
+        labels = jnp.asarray([0, 1])
+        assert float(softmax_cross_entropy(logits, labels)) < 1e-5
+
+
+class TestSigmoidBCE:
+    def test_matches_torch_bce_with_logits(self):
+        # Parity target: nn.BCEWithLogitsLoss() (pytorch/unet/train.py:160-162).
+        rng = np.random.default_rng(1)
+        logits = rng.normal(size=(4, 8, 8)).astype(np.float32) * 5
+        targets = rng.integers(0, 2, size=(4, 8, 8)).astype(np.float32)
+        ours = sigmoid_binary_cross_entropy(jnp.asarray(logits), jnp.asarray(targets))
+        theirs = F.binary_cross_entropy_with_logits(
+            torch.tensor(logits), torch.tensor(targets)
+        )
+        assert float(ours) == pytest.approx(float(theirs), abs=1e-5)
+
+    def test_extreme_logits_stable(self):
+        logits = jnp.asarray([1000.0, -1000.0])
+        targets = jnp.asarray([1.0, 0.0])
+        assert float(sigmoid_binary_cross_entropy(logits, targets)) == pytest.approx(0.0)
+
+
+class TestTop1Accuracy:
+    def test_basic(self):
+        logits = jnp.asarray([[1.0, 2.0], [3.0, 0.0], [0.0, 1.0], [5.0, 0.0]])
+        labels = jnp.asarray([1, 0, 0, 0])
+        assert float(top1_accuracy(logits, labels)) == pytest.approx(0.75)
+
+
+class TestDice:
+    def test_perfect_overlap(self):
+        m = jnp.ones((2, 4, 4))
+        assert float(dice_score(m, m)) == pytest.approx(1.0)
+
+    def test_no_overlap(self):
+        a = jnp.zeros((1, 4, 4)).at[0, :2].set(1.0)
+        b = jnp.zeros((1, 4, 4)).at[0, 2:].set(1.0)
+        assert float(dice_score(a, b)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_both_empty_is_one(self):
+        # Reference convention: empty∧empty → 1.0 (pytorch/unet/train.py:132-137).
+        z = jnp.zeros((3, 4, 4))
+        assert float(dice_score(z, z)) == pytest.approx(1.0)
+
+    def test_half_overlap(self):
+        a = jnp.zeros((1, 4)).at[0, :2].set(1.0)  # {0,1}
+        b = jnp.zeros((1, 4)).at[0, 1:3].set(1.0)  # {1,2}
+        # dice = 2*1 / (2+2) = 0.5
+        assert float(dice_score(a, b)) == pytest.approx(0.5, abs=1e-6)
+
+    def test_per_image_then_mean(self):
+        # one perfect image + one empty-vs-full image: mean of 1.0 and ~0.
+        pred = jnp.stack([jnp.ones((4, 4)), jnp.zeros((4, 4))])
+        true = jnp.ones((2, 4, 4))
+        assert float(dice_score(pred, true)) == pytest.approx(0.5, abs=1e-4)
+
+    def test_dice_loss_decreases_with_agreement(self):
+        target = jnp.ones((1, 4, 4))
+        good = dice_loss(jnp.full((1, 4, 4), 10.0), target)
+        bad = dice_loss(jnp.full((1, 4, 4), -10.0), target)
+        assert float(good) < 0.01 < float(bad)
