@@ -1,4 +1,4 @@
-"""Model zoo: ResNet family, 2-D UNet (3-D UNet and transformer LM to follow).
+"""Model zoo: ResNet family, 2-D UNet, decoder-only Transformer LM.
 
 All models are Flax linen modules in NHWC layout (TPU-native; XLA tiles NHWC
 convs onto the MXU without the transposes NCHW would need) with a ``dtype``
@@ -19,6 +19,10 @@ from deeplearning_mpi_tpu.models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+from deeplearning_mpi_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+)
 from deeplearning_mpi_tpu.models.unet import UNet  # noqa: F401
 
 _RESNETS = {
@@ -36,4 +40,10 @@ def get_model(name: str, **kwargs: Any) -> nn.Module:
         return _RESNETS[name](**kwargs)
     if name == "unet":
         return UNet(**kwargs)
-    raise ValueError(f"unknown model '{name}'; choose from {sorted(_RESNETS) + ['unet']}")
+    if name == "transformer":
+        config = kwargs.pop("config", None) or TransformerConfig()
+        return TransformerLM(config=config, **kwargs)
+    raise ValueError(
+        f"unknown model '{name}'; choose from "
+        f"{sorted(_RESNETS) + ['unet', 'transformer']}"
+    )

@@ -1,0 +1,68 @@
+"""Scaled dot-product attention (dense reference implementation).
+
+The reference contains no attention at all — both workloads are CNNs
+(``pytorch/unet/model.py:51-81``, ``pytorch/resnet/main.py:40``; SURVEY.md
+§5.7) — but long-context support is first-class in this framework, so
+attention is a core op with three interchangeable implementations:
+
+- :func:`dense_attention` (here) — the O(S²)-memory einsum reference, used
+  on short sequences, on CPU, and as the numerical oracle in tests;
+- ``ops.pallas.flash_attention`` — the tiled online-softmax Pallas TPU
+  kernel (O(S) memory, MXU-shaped blocks);
+- ``parallel.ring_attention`` — sequence-parallel blockwise attention over
+  the mesh ``seq`` axis, rotating K/V shards with ``ppermute``.
+
+All three share this op's conventions: inputs ``[batch, seq, heads, head_dim]``
+("BSHD"), softmax accumulated in float32 regardless of input dtype, output in
+the input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative mask value; -inf breaks softmax when a row is fully masked
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Full-materialization attention over ``[B, S, H, D]`` inputs.
+
+    ``q_offset``/``kv_offset`` are the absolute positions of the first query /
+    key row — used by the blockwise/ring implementations, which call this on
+    sequence *shards* and need causal masking in global coordinates.
+    """
+    *_, q_len, _, head_dim = q.shape
+    kv_len = k.shape[-3]
+    scale = head_dim**-0.5
+    # [B, H, Sq, Skv] scores in f32: bf16 logits lose too much softmax precision.
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    weights = None
+    if causal:
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+        k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+        valid = q_pos >= k_pos
+        scores = jnp.where(valid, scores, NEG_INF)
+        # A query row with NO valid key (possible on blockwise shards that are
+        # entirely in the row's future) must contribute zero, not a uniform
+        # average of V — softmax alone would renormalize the all-masked row.
+        weights = jnp.where(
+            jnp.any(valid, axis=-1)[:, None], jax.nn.softmax(scores, axis=-1), 0.0
+        )
+    if weights is None:
+        weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

@@ -14,6 +14,12 @@ import jax
 import jax.numpy as jnp
 
 
+def _token_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-element negative log-likelihood, f32 log-softmax over the last axis."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+
+
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Mean softmax cross-entropy with integer labels.
 
@@ -21,10 +27,7 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     (``pytorch/resnet/main.py:113,129``): softmax over the last axis, mean
     over the batch.
     """
-    logits = logits.astype(jnp.float32)
-    log_probs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(_token_nll(logits, labels))
 
 
 def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -62,3 +65,19 @@ def dice_loss(
     union = jnp.sum(probs, axis=reduce_axes) + jnp.sum(targets, axis=reduce_axes)
     dice = (2.0 * intersection + eps) / (union + eps)
     return jnp.mean(1.0 - dice)
+
+
+def lm_cross_entropy(
+    logits: jax.Array, tokens: jax.Array, mask: jax.Array | None = None
+) -> jax.Array:
+    """Next-token LM loss: predict ``tokens[:, 1:]`` from ``logits[:, :-1]``.
+
+    No reference analog (the reference has no sequence models — SURVEY.md
+    §5.7); this is the training loss for the transformer workload. ``mask``
+    (1 = real token) excludes padding from the mean.
+    """
+    nll = _token_nll(logits[:, :-1], tokens[:, 1:])
+    if mask is None:
+        return jnp.mean(nll)
+    weights = mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)

@@ -8,14 +8,19 @@ all-gather/reduce-scatter collectives — the TPU-native way to get
 megatron-style TP without hand-writing either the sharded layers or their
 collectives.
 
-Rules (shape-based, applied leaf-wise):
-- ``Dense``/conv kernels ``[..., in, out]`` → shard ``out`` (columns /
-  output channels) over ``model`` when divisible and big enough to matter;
-- 0/1-D leaves (biases, BN scale/shift/stats, step counters) replicated.
+Rules (applied leaf-wise, path-aware):
+- projections *back into the residual stream* — parameter paths containing
+  ``out_proj`` or ``down_proj`` (the transformer's attention-output and MLP
+  down projections) — are **row-parallel**: input dim sharded over ``model``,
+  the megatron pairing that turns (column-parallel → row-parallel) into a
+  single all-reduce per block;
+- every other ``Dense``/conv kernel ``[..., in, out]`` is **column-parallel**:
+  ``out`` sharded over ``model`` when divisible and big enough to matter;
+- 0/1-D leaves (biases, norm scales, BN stats, step counters) replicated.
 
-Because the rule depends only on leaf shape, it applies uniformly to the
-whole train state: optimizer moments mirror their parameters' shapes and
-land on identical shardings — a free half of ZeRO (momentum memory splits
+Because the rule depends only on leaf path+shape, it applies uniformly to the
+whole train state: optimizer moments mirror their parameters' paths/shapes
+and land on identical shardings — a free half of ZeRO (momentum memory splits
 across ``model`` wherever weights do).
 """
 
@@ -30,12 +35,42 @@ from deeplearning_mpi_tpu.runtime.mesh import AXIS_MODEL
 
 PyTree = Any
 
+#: Path substrings marking kernels that project back into the residual stream
+#: (sharded on the *input* dim — megatron row-parallel).
+ROW_PARALLEL_MARKERS = ("out_proj", "down_proj")
 
-def tp_spec(leaf: jax.Array, tp: int, *, axis: str = AXIS_MODEL, min_size: int = 1024) -> P:
-    """PartitionSpec for one leaf under the column-parallel rule."""
-    if tp > 1 and leaf.ndim >= 2 and leaf.size >= min_size and leaf.shape[-1] % tp == 0:
+
+def tp_spec(
+    leaf: jax.Array,
+    tp: int,
+    *,
+    axis: str = AXIS_MODEL,
+    min_size: int = 1024,
+    path: str = "",
+) -> P:
+    """PartitionSpec for one leaf under the column/row-parallel rules."""
+    if tp <= 1 or leaf.ndim < 2 or leaf.size < min_size:
+        return P()
+    if any(marker in path for marker in ROW_PARALLEL_MARKERS):
+        if leaf.shape[-2] % tp == 0:
+            return P(*([None] * (leaf.ndim - 2)), axis, None)
+        return P()
+    if leaf.shape[-1] % tp == 0:
         return P(*([None] * (leaf.ndim - 1)), axis)
     return P()
+
+
+def _map_with_spec(fn, params: PyTree, tp: int, axis: str, min_size: int) -> PyTree:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: fn(
+            leaf,
+            tp_spec(
+                leaf, tp, axis=axis, min_size=min_size,
+                path=jax.tree_util.keystr(path),
+            ),
+        ),
+        params,
+    )
 
 
 def infer_tp_param_sharding(
@@ -47,11 +82,8 @@ def infer_tp_param_sharding(
 ) -> PyTree:
     """NamedSharding pytree for ``params`` (or any params-shaped pytree)."""
     tp = mesh.shape[axis]
-    return jax.tree.map(
-        lambda leaf: NamedSharding(
-            mesh, tp_spec(leaf, tp, axis=axis, min_size=min_size)
-        ),
-        params,
+    return _map_with_spec(
+        lambda leaf, spec: NamedSharding(mesh, spec), params, tp, axis, min_size
     )
 
 
@@ -63,9 +95,7 @@ def shard_state(state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL) -> PyTr
     degrades to full replication — exactly pure DP.
     """
     tp = mesh.shape[tp_axis]
-    return jax.tree.map(
-        lambda leaf: jax.device_put(
-            leaf, NamedSharding(mesh, tp_spec(leaf, tp, axis=tp_axis))
-        ),
-        state,
+    return _map_with_spec(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        state, tp, tp_axis, 1024,
     )

@@ -1,0 +1,170 @@
+"""Transformer LM + attention op tests (tiny config, virtual CPU devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM, get_model
+from deeplearning_mpi_tpu.ops import dense_attention, lm_cross_entropy
+from deeplearning_mpi_tpu.models.transformer import apply_rope
+
+
+@pytest.fixture(scope="module")
+def tiny_model_and_params():
+    model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)
+    return model, params
+
+
+class TestDenseAttention:
+    def test_matches_manual_softmax(self):
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(1, 5, 2, 4)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 5, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 5, 2, 4)), jnp.float32)
+        out = dense_attention(q, k, v, causal=False)
+        scores = np.einsum("bqhd,bkhd->bhqk", q, k) / 2.0  # scale = 4**-0.5
+        w = jax.nn.softmax(jnp.asarray(scores), axis=-1)
+        expected = np.einsum("bhqk,bkhd->bqhd", w, v)
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_causal_mask_blocks_future(self):
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 6, 1, 4)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 6, 1, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 6, 1, 4)), jnp.float32)
+        full = dense_attention(q, k, v, causal=True)
+        # Changing future keys/values must not change earlier outputs.
+        k2 = k.at[:, 4:].set(123.0)
+        v2 = v.at[:, 4:].set(-7.0)
+        perturbed = dense_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(full[:, :4], perturbed[:, :4], atol=1e-6)
+        assert not np.allclose(full[:, 5], perturbed[:, 5])
+
+    def test_fully_future_block_contributes_zero(self):
+        """A kv shard entirely in the queries' future must yield exact zeros
+        (not a softmax-renormalized uniform average of V)."""
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.normal(size=(1, 4, 2, 4)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 4, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 4, 2, 4)), jnp.float32)
+        out = dense_attention(q, k, v, causal=True, q_offset=0, kv_offset=8)
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    def test_offsets_match_slicing(self):
+        """Blockwise calls with offsets reproduce the full causal result."""
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(1, 8, 2, 4)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 8, 2, 4)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(1, 8, 2, 4)), jnp.float32)
+        full = dense_attention(q, k, v, causal=True)
+        # Second half queries attending over full kv with global positions.
+        part = dense_attention(q[:, 4:], k, v, causal=True, q_offset=4)
+        np.testing.assert_allclose(full[:, 4:], part, atol=1e-5)
+
+
+class TestRoPE:
+    def test_rotation_preserves_norm(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 7, 2, 8)), jnp.float32)
+        pos = jnp.broadcast_to(jnp.arange(7)[None, :], (1, 7))
+        rotated = apply_rope(x, pos)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x), axis=-1),
+            np.linalg.norm(np.asarray(rotated), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_relative_positions_only(self):
+        """RoPE attention scores depend only on relative offset."""
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+
+        def score(q_pos, k_pos):
+            qr = apply_rope(q, jnp.array([[q_pos]]))
+            kr = apply_rope(k, jnp.array([[k_pos]]))
+            return float(jnp.sum(qr * kr))
+
+        assert score(3, 1) == pytest.approx(score(10, 8), rel=1e-4)
+
+
+class TestTransformerLM:
+    def test_forward_shape_and_finite(self, tiny_model_and_params):
+        model, params = tiny_model_and_params
+        tokens = jnp.ones((2, 16), jnp.int32)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, 256)
+        assert logits.dtype == jnp.float32
+        assert bool(jnp.all(jnp.isfinite(logits)))
+
+    def test_causality_end_to_end(self, tiny_model_and_params):
+        model, params = tiny_model_and_params
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 256, (1, 12)), jnp.int32)
+        logits = model.apply(params, tokens)
+        tokens2 = tokens.at[0, 8:].set(0)
+        logits2 = model.apply(params, tokens2)
+        np.testing.assert_allclose(logits[0, :8], logits2[0, :8], atol=1e-4)
+
+    def test_untied_head_and_registry(self):
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=1, num_heads=2, head_dim=4,
+            d_model=8, d_ff=16, tied_embeddings=False,
+        )
+        model = get_model("transformer", config=cfg, dtype=jnp.float32)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+        assert "lm_head" in params["params"]
+        logits = model.apply(params, jnp.zeros((1, 4), jnp.int32))
+        assert logits.shape == (1, 4, 64)
+
+    def test_remat_matches_plain(self):
+        cfg = TransformerConfig.tiny()
+        tokens = jnp.ones((1, 8), jnp.int32)
+        plain = TransformerLM(config=cfg, dtype=jnp.float32)
+        remat = TransformerLM(config=cfg, dtype=jnp.float32, remat=True)
+        params = plain.init(jax.random.key(0), tokens)
+        np.testing.assert_allclose(
+            plain.apply(params, tokens), remat.apply(params, tokens), atol=1e-5
+        )
+
+    def test_grads_flow_through_loss(self, tiny_model_and_params):
+        model, params = tiny_model_and_params
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 256, (2, 16)), jnp.int32
+        )
+
+        def loss_fn(p):
+            return lm_cross_entropy(model.apply(p, tokens), tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+        assert all(np.isfinite(n) for n in norms)
+        assert any(n > 0 for n in norms)
+
+
+class TestLMCrossEntropy:
+    def test_uniform_logits_give_log_vocab(self):
+        logits = jnp.zeros((2, 5, 16))
+        tokens = jnp.ones((2, 5), jnp.int32)
+        assert float(lm_cross_entropy(logits, tokens)) == pytest.approx(
+            np.log(16.0), rel=1e-5
+        )
+
+    def test_mask_excludes_padding(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(1, 6, 8)), jnp.float32)
+        tokens = jnp.asarray(rng.integers(0, 8, (1, 6)), jnp.int32)
+        mask_all = jnp.ones((1, 6))
+        unmasked = lm_cross_entropy(logits, tokens, mask_all)
+        # Poison the last target; with it masked out the loss must not change.
+        poisoned = tokens.at[0, 5].set((int(tokens[0, 5]) + 1) % 8)
+        mask = mask_all.at[0, 5].set(0)
+        assert float(lm_cross_entropy(logits, poisoned, mask)) == pytest.approx(
+            float(lm_cross_entropy(logits, tokens, mask))
+        )
+        assert float(lm_cross_entropy(logits, tokens, mask)) != pytest.approx(
+            float(unmasked)
+        )
