@@ -138,6 +138,7 @@ class ShardedLoader:
         # Same stream on every process: replicated shards must stay identical.
         rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch, 1]))
 
+        n_real = len(self.dataset)
         for start in range(0, len(order), self.global_batch_size):
             window = order[start : start + self.global_batch_size]
             local_idx = np.concatenate(
@@ -147,6 +148,14 @@ class ShardedLoader:
             stacked = {k: np.stack([ex[k] for ex in examples]) for k in examples[0]}
             if self.transform is not None:
                 stacked = self.transform(stacked, rng)
+            if not self.drop_last:
+                # Validity mask: 0 marks wrap-padded duplicate rows (flat
+                # positions >= dataset size), so eval can exclude them from
+                # metric means instead of double-counting the pad source rows.
+                flat_pos = np.concatenate(
+                    [np.arange(start + a, start + b) for a, b in self.local_row_ranges]
+                )
+                stacked["__valid__"] = (flat_pos < n_real).astype(np.float32)
             yield {
                 k: jax.make_array_from_process_local_data(
                     shardings.setdefault(v.ndim, batch_sharding(self.mesh, ndim=v.ndim)),

@@ -20,23 +20,39 @@ def _token_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
 
 
-def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def masked_mean(values: jax.Array, where: jax.Array | None) -> jax.Array:
+    """Mean of per-example ``values`` [B, ...reduced], optionally weighted by a
+    [B] validity mask (0 = padded example, excluded)."""
+    if where is None:
+        return jnp.mean(values)
+    w = where.astype(jnp.float32)
+    return jnp.sum(values * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def softmax_cross_entropy(
+    logits: jax.Array, labels: jax.Array, where: jax.Array | None = None
+) -> jax.Array:
     """Mean softmax cross-entropy with integer labels.
 
     Equivalent of ``nn.CrossEntropyLoss()(outputs, labels)``
     (``pytorch/resnet/main.py:113,129``): softmax over the last axis, mean
-    over the batch.
+    over the batch. ``where`` ([B], 1 = real example) excludes wrap-padded
+    eval rows.
     """
-    return jnp.mean(_token_nll(logits, labels))
+    return masked_mean(_token_nll(logits, labels), where)
 
 
-def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+def sigmoid_binary_cross_entropy(
+    logits: jax.Array, targets: jax.Array, where: jax.Array | None = None
+) -> jax.Array:
     """Mean binary cross-entropy on logits.
 
     Equivalent of ``nn.BCEWithLogitsLoss()(predictions, masks)``
     (``pytorch/unet/train.py:160-162,183``): elementwise
     ``max(x,0) - x*y + log(1+exp(-|x|))``, mean over all elements — the same
-    log-sum-exp-stable form torch uses.
+    log-sum-exp-stable form torch uses. ``where`` ([B], 1 = real example)
+    excludes wrap-padded eval rows (equal-sized images ⇒ the all-elements
+    mean equals the mean of per-image means).
     """
     logits = logits.astype(jnp.float32)
     targets = targets.astype(jnp.float32)
@@ -45,7 +61,8 @@ def sigmoid_binary_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.A
         - logits * targets
         + jnp.log1p(jnp.exp(-jnp.abs(logits)))
     )
-    return jnp.mean(per_elem)
+    per_image = jnp.mean(per_elem, axis=tuple(range(1, per_elem.ndim)))
+    return masked_mean(per_image, where)
 
 
 def dice_loss(

@@ -10,21 +10,27 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deeplearning_mpi_tpu.ops.loss import masked_mean
 
-def top1_accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+
+def top1_accuracy(
+    logits: jax.Array, labels: jax.Array, where: jax.Array | None = None
+) -> jax.Array:
     """Fraction of argmax predictions matching integer labels.
 
     Equivalent of the reference's ``torch.max(outputs,1)`` / correct-count
     accumulation (``pytorch/resnet/main.py:64-71``). Returns a scalar in
-    [0, 1]; callers weight by batch size when accumulating across batches.
+    [0, 1]; callers accumulating across batches weight by the number of
+    *valid* examples (= batch size only when ``where`` is None).
     """
     preds = jnp.argmax(logits, axis=-1)
-    return jnp.mean(jnp.asarray(preds == labels, jnp.float32))
+    return masked_mean(jnp.asarray(preds == labels, jnp.float32), where)
 
 
 def dice_score(
     pred_mask: jax.Array,
     true_mask: jax.Array,
+    where: jax.Array | None = None,
     *,
     eps: float = 1e-8,
 ) -> jax.Array:
@@ -45,4 +51,4 @@ def dice_score(
     dice = (2.0 * intersection + eps) / (denom + eps)
     both_empty = denom == 0
     dice = jnp.where(both_empty, 1.0, dice)
-    return jnp.mean(dice)
+    return masked_mean(dice, where)

@@ -42,10 +42,13 @@ class Checkpointer:
 
     def save(self, state: TrainState, *, epoch: int) -> None:
         # Static fields (apply_fn, tx) are not data; persist arrays only.
+        # Async: Orbax serializes in the background while training continues;
+        # ordering across saves is the manager's job, and close() (and any
+        # restore) barriers before process exit. Blocking here would idle the
+        # devices for the full sharded-write duration every cadence.
         self.manager.save(
             epoch, args=ocp.args.StandardSave(_arrays_only(state))
         )
-        self.manager.wait_until_finished()
 
     def latest_epoch(self) -> int | None:
         return self.manager.latest_step()
@@ -53,6 +56,7 @@ class Checkpointer:
     def restore(self, template: TrainState, *, epoch: int | None = None) -> TrainState:
         """Restore into the shardings/dtypes of ``template`` (a freshly
         created state — supplies apply_fn/tx, which are code, not data)."""
+        self.manager.wait_until_finished()  # in-flight async save must land first
         if epoch is None:
             epoch = self.manager.latest_step()
         if epoch is None:

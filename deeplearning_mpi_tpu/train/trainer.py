@@ -14,7 +14,8 @@ Semantics carried over exactly (SURVEY.md §7 "Matching DDP semantics"):
 - loss is *averaged* over the global batch ⇒ gradients match DDP's
   rank-averaged gradients;
 - BatchNorm uses local per-replica statistics (DDP never syncs BN);
-- non-finite loss skips the optimizer step but still counts the batch
+- non-finite loss skips the optimizer step and is excluded from the epoch
+  mean, exactly like the reference's pre-accumulation ``continue``
   (``pytorch/unet/train.py:186-188``);
 - gradient clipping by global norm (``pytorch/unet/train.py:194``).
 
@@ -50,11 +51,15 @@ _TARGETS = {"classification": "label", "segmentation": "mask"}
 
 
 def _task_loss(task: str) -> LossFn:
+    """Loss for a task; ``where`` ([B] validity mask or None) excludes
+    wrap-padded eval rows from the mean."""
     if task == "classification":
-        return lambda logits, batch: softmax_cross_entropy(logits, batch["label"])
+        return lambda logits, batch, where=None: softmax_cross_entropy(
+            logits, batch["label"], where
+        )
     if task == "segmentation":
-        return lambda logits, batch: sigmoid_binary_cross_entropy(
-            logits[..., 0], batch["mask"]
+        return lambda logits, batch, where=None: sigmoid_binary_cross_entropy(
+            logits[..., 0], batch["mask"], where
         )
     raise ValueError(f"unknown task '{task}'")
 
@@ -116,16 +121,25 @@ def make_eval_step(task: str) -> Callable[[TrainState, Batch], dict[str, jax.Arr
     Segmentation: sigmoid > 0.5 threshold then per-image Dice
     (``pytorch/unet/train.py:115-140``).
     """
+
     loss_fn = _task_loss(task)
 
     def step(state: TrainState, batch: Batch) -> dict[str, jax.Array]:
         outputs = state.apply_fn(state.variables(), batch["image"], train=False)
-        metrics = {"loss": loss_fn(outputs, batch)}
+        # Wrap-padded rows (loader drop_last=False) carry __valid__=0 and are
+        # excluded from every mean; "weight" is the real-example count the
+        # caller accumulates by.
+        valid = batch.get("__valid__")
+        metrics = {"loss": loss_fn(outputs, batch, valid)}
         if task == "classification":
-            metrics["accuracy"] = top1_accuracy(outputs, batch["label"])
+            metrics["accuracy"] = top1_accuracy(outputs, batch["label"], valid)
         else:
             pred = (jax.nn.sigmoid(outputs[..., 0]) > 0.5).astype(jnp.float32)
-            metrics["dice"] = dice_score(pred, batch["mask"])
+            metrics["dice"] = dice_score(pred, batch["mask"], valid)
+        metrics["weight"] = (
+            jnp.sum(valid) if valid is not None
+            else jnp.asarray(batch["image"].shape[0], jnp.float32)
+        )
         return metrics
 
     return jax.jit(step)
@@ -199,17 +213,27 @@ class Trainer:
     def run_epoch(self, loader: Any, epoch: int) -> dict[str, float]:
         """One training epoch; returns mean loss + timing stats."""
         t0 = time.perf_counter()
-        losses: list[jax.Array] = []
+        loss_sum = finite_sum = None
         n_batches = 0
         images = 0
         for batch in prefetch(loader.epoch(epoch)):
             self.state, metrics = self.train_step(self.state, batch)
-            losses.append(metrics["loss"])
+            # Accumulate on device, excluding non-finite batches from the mean
+            # (the reference `continue`s before accumulating epoch loss,
+            # pytorch/unet/train.py:186-188) — one NaN batch must not poison
+            # the epoch stat while the guarded step correctly skipped it.
+            contrib = jnp.where(metrics["finite"] > 0, metrics["loss"], 0.0)  # NaN*0 is NaN
+            loss_sum = contrib if loss_sum is None else loss_sum + contrib
+            finite_sum = (
+                metrics["finite"] if finite_sum is None
+                else finite_sum + metrics["finite"]
+            )
             n_batches += 1
             images += batch["image"].shape[0]
         if not n_batches:
             raise ValueError("empty epoch — dataset smaller than one global batch")
-        mean_loss = float(jnp.mean(jnp.stack(losses)))  # one host sync per epoch
+        n_finite = float(finite_sum)  # one host sync per epoch
+        mean_loss = float(loss_sum) / max(n_finite, 1.0)
         duration = time.perf_counter() - t0
         stats = {
             "epoch": epoch,
@@ -217,6 +241,11 @@ class Trainer:
             "duration_s": duration,
             "images_per_s": images / duration,
         }
+        if n_finite < n_batches:
+            self._log(
+                f"Epoch {epoch}: skipped {n_batches - int(n_finite)} non-finite "
+                "loss batch(es)"
+            )
         # Parity: per-epoch loss print (resnet/main.py:134) + duration log
         # (unet/train.py:207-211), with throughput added.
         self._log(
@@ -232,16 +261,16 @@ class Trainer:
         JAX's async dispatch pipelined, like the train loop.
         """
         sums: dict[str, jax.Array] = {}
-        weight = 0
+        weight: jax.Array | None = None
         for batch in prefetch(loader.epoch(0)):
             metrics = self.eval_step(self.state, batch)
-            bs = batch["image"].shape[0]
+            w = metrics.pop("weight")  # real (non-padded) examples this batch
             for k, v in metrics.items():
-                sums[k] = sums[k] + v * bs if k in sums else v * bs
-            weight += bs
-        if not weight:
+                sums[k] = sums[k] + v * w if k in sums else v * w
+            weight = w if weight is None else weight + w
+        if weight is None or not float(weight):
             raise ValueError("empty eval loader")
-        return {k: float(v) / weight for k, v in sums.items()}
+        return {k: float(v) / float(weight) for k, v in sums.items()}
 
     def fit(
         self,

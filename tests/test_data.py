@@ -72,6 +72,17 @@ class TestShardedLoader:
         labels = np.asarray(batches[0]["label"])
         np.testing.assert_array_equal(labels[:5], ds.labels)
         np.testing.assert_array_equal(labels[5:10], ds.labels)  # wrapped
+        # Padded rows are flagged invalid so eval excludes the duplicates.
+        valid = np.asarray(batches[0]["__valid__"])
+        np.testing.assert_array_equal(valid, [1] * 5 + [0] * 11)
+
+    def test_valid_mask_counts_whole_dataset_once(self, mesh):
+        ds = SyntheticCIFAR10(40)  # 40 = 2 full batches of 16 + 8 padded tail
+        loader = ShardedLoader(ds, 16, mesh, shuffle=True, drop_last=False)
+        total_valid = sum(
+            float(np.sum(np.asarray(b["__valid__"]))) for b in loader.epoch(3)
+        )
+        assert total_valid == 40
 
     def test_empty_epoch_raises_clearly(self, mesh):
         ds = SyntheticCIFAR10(5)

@@ -113,6 +113,62 @@ class TestTrainStep:
         assert max_delta < 2e-3  # adam normalizes, but clipped grads keep it small
 
 
+class _ListLoader:
+    """Minimal loader stub: replays fixed batches for any epoch."""
+
+    def __init__(self, batches):
+        self.batches = batches
+
+    def epoch(self, epoch):
+        return iter(self.batches)
+
+
+class TestNonFiniteHandling:
+    def test_nan_batch_excluded_from_epoch_mean(self, mesh):
+        from deeplearning_mpi_tpu.train.trainer import Trainer
+
+        good = make_batch(seed=1)
+        poisoned = make_batch(seed=2)
+        poisoned["image"] = poisoned["image"].at[0, 0, 0, 0].set(jnp.nan)
+        trainer = Trainer(make_state(), "classification", mesh)
+        # Oracle: same state/batches without the poisoned batch in between.
+        oracle = Trainer(make_state(), "classification", mesh)
+        oracle_stats = oracle.run_epoch(_ListLoader([good, good]), epoch=0)
+        stats = trainer.run_epoch(_ListLoader([good, poisoned, good]), epoch=0)
+        # One NaN batch: skipped by the step, excluded from the mean — the
+        # denominator must be the finite count (2), not the batch count (3).
+        assert stats["loss"] == pytest.approx(oracle_stats["loss"], abs=1e-6)
+
+
+class TestEvalPaddingExclusion:
+    def test_evaluate_matches_exact_dataset_metrics(self, mesh):
+        from deeplearning_mpi_tpu.data.cifar10 import SyntheticCIFAR10, eval_transform
+        from deeplearning_mpi_tpu.data.loader import ShardedLoader
+        from deeplearning_mpi_tpu.train.trainer import Trainer
+
+        ds = SyntheticCIFAR10(40)  # 2 full batches of 16 + 8-row padded tail
+        loader = ShardedLoader(
+            ds, 16, mesh, shuffle=False, drop_last=False, transform=eval_transform
+        )
+        state = make_state()
+        trainer = Trainer(state, "classification", mesh)
+        result = trainer.evaluate(loader)
+        # Oracle: run the whole dataset (no padding) through the model once.
+        examples = [ds[i] for i in range(len(ds))]
+        batch = eval_transform(
+            {
+                "image": np.stack([ex["image"] for ex in examples]),
+                "label": np.stack([ex["label"] for ex in examples]),
+            },
+            np.random.default_rng(0),
+        )
+        logits = state.apply_fn(
+            state.variables(), jnp.asarray(batch["image"]), train=False
+        )
+        expected = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(batch["label"])))
+        assert result["accuracy"] == pytest.approx(expected, abs=1e-6)
+
+
 class TestEvalStep:
     def test_classification_metrics(self):
         state = make_state()
