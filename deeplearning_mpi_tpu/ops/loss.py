@@ -21,8 +21,9 @@ def _token_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 
 def masked_mean(values: jax.Array, where: jax.Array | None) -> jax.Array:
-    """Mean of per-example ``values`` [B, ...reduced], optionally weighted by a
-    [B] validity mask (0 = padded example, excluded)."""
+    """Mean of ``values``, optionally weighted by a broadcast-compatible
+    validity mask (0 = padded element, excluded) — [B] per-example masks and
+    [B, T] per-token masks both work."""
     if where is None:
         return jnp.mean(values)
     w = where.astype(jnp.float32)
@@ -94,7 +95,4 @@ def lm_cross_entropy(
     (1 = real token) excludes padding from the mean.
     """
     nll = _token_nll(logits[:, :-1], tokens[:, 1:])
-    if mask is None:
-        return jnp.mean(nll)
-    weights = mask[:, 1:].astype(jnp.float32)
-    return jnp.sum(nll * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    return masked_mean(nll, None if mask is None else mask[:, 1:])

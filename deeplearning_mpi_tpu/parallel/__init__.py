@@ -8,7 +8,15 @@ matrices over ``model``, sequence parallelism shards the token axis over
 ``seq`` (ring attention), expert parallelism shards experts over ``expert``.
 """
 
+from deeplearning_mpi_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention_fn,
+    ring_attention,
+)
 from deeplearning_mpi_tpu.parallel.tensor_parallel import (  # noqa: F401
     infer_tp_param_sharding,
     shard_state,
+)
+from deeplearning_mpi_tpu.parallel.ulysses import (  # noqa: F401
+    make_ulysses_attention_fn,
+    ulysses_attention,
 )

@@ -1,0 +1,177 @@
+"""Ring attention: sequence/context parallelism over the mesh ``seq`` axis.
+
+The reference has no sequence models and no context parallelism (SURVEY.md
+§5.7 — both workloads are CNNs), but long-context support is first-class in
+this framework. This module implements blockwise ring attention in the
+TPU-native idiom: Q/K/V are sharded along the sequence dimension over the
+``seq`` mesh axis; each device keeps its Q shard resident and the K/V shards
+rotate around the ring with ``lax.ppermute`` (XLA collective-permute riding
+ICI neighbor links), while a flash-style online softmax accumulates the
+output in O(S_local) memory. After ``seq_size`` rotations every Q shard has
+attended to every K/V shard without any device ever materializing the full
+sequence — the S²-memory wall and the HBM capacity of one chip stop bounding
+context length.
+
+Numerics follow ``ops.attention.dense_attention`` exactly (f32 accumulation,
+finite mask value, zero rows for fully-masked queries), so the dense op is
+the oracle in tests.
+
+Layout notes (TPU):
+- the rotating K/V buffers are ``[B, S_local, H, D]`` blocks — large,
+  contiguous, MXU-friendly matmul operands;
+- the ppermute of the *next* block is issued before the current block's
+  einsum so XLA's latency-hiding scheduler can overlap transfer with compute
+  (double-buffered ring);
+- causal masking is positional arithmetic in global coordinates, so a
+  rotation step whose K/V block is entirely in the query block's future
+  contributes zeros (the online-softmax accumulator is unchanged) — XLA
+  still executes the matmul, but correctness needs no special-casing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning_mpi_tpu.ops.attention import NEG_INF
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
+
+
+def _block_update(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    acc: tuple[jax.Array, jax.Array, jax.Array],
+    *,
+    causal: bool,
+    q_offset: jax.Array | int,
+    kv_offset: jax.Array | int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One online-softmax accumulation step over a K/V block.
+
+    ``acc = (o, l, m)``: running un-normalized output ``[B, Sq, H, D]`` (f32),
+    running softmax denominator ``[B, Sq, H]`` (f32), running row max
+    ``[B, Sq, H]`` (f32). The standard flash-attention recurrence.
+    """
+    o, l, m = acc
+    q_len, kv_len = q.shape[-3], k.shape[-3]
+    scale = q.shape[-1] ** -0.5
+    # [B, H, Sq, Skv] scores in f32 (bf16 logits lose softmax precision).
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if causal:
+        q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
+        k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
+        valid = q_pos >= k_pos
+        scores = jnp.where(valid, scores, NEG_INF)
+    m_block = jnp.max(scores, axis=-1)  # [B, H, Sq]
+    m_new = jnp.maximum(m, m_block.transpose(0, 2, 1))  # [B, Sq, H]
+    # exp(scores - m_new); rows where everything seen so far is masked keep
+    # m_new == NEG_INF and the finite mask value would make exp(0) == 1, so
+    # masked positions are re-zeroed explicitly (matches dense_attention's
+    # zero-row convention for fully-masked queries).
+    p = jnp.exp(scores - m_new.transpose(0, 2, 1)[:, :, :, None])
+    if causal:
+        p = jnp.where(valid, p, 0.0)
+    alpha = jnp.exp(m - m_new)  # [B, Sq, H] rescale of the old accumulator
+    l_new = l * alpha + jnp.sum(p, axis=-1).transpose(0, 2, 1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    o_new = o * alpha[..., None] + pv
+    return o_new, l_new, m_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """Blockwise ring attention over sequence shards (call inside shard_map).
+
+    Args: ``q``, ``k``, ``v`` — this device's sequence shard,
+    ``[B, S_local, H, D]``; the global sequence length is
+    ``S_local * axis_size(axis_name)`` and shard ``i`` holds rows
+    ``[i*S_local, (i+1)*S_local)``.
+
+    Returns the attention output for this device's Q shard, same shape and
+    dtype as ``q``.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_local = q.shape[-3]
+    q_offset = my_idx * s_local
+
+    batch, _, heads, head_dim = q.shape
+    acc0 = (
+        jnp.zeros((batch, s_local, heads, head_dim), jnp.float32),
+        jnp.zeros((batch, s_local, heads), jnp.float32),
+        jnp.full((batch, s_local, heads), NEG_INF, jnp.float32),
+    )
+    # Shift direction i -> i+1: after t steps this device holds the K/V shard
+    # originally owned by (my_idx - t) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def ring_step(t, carry):
+        k_blk, v_blk, acc = carry
+        # Issue the transfer of the *next* block first; XLA overlaps the
+        # collective-permute DMA with this step's einsums (double buffering).
+        k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+        kv_offset = ((my_idx - t) % n) * s_local
+        acc = _block_update(
+            q, k_blk, v_blk, acc,
+            causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+        )
+        return k_nxt, v_nxt, acc
+
+    if n == 1:
+        _, _, (o, l, m) = ring_step(0, (k, v, acc0))
+    else:
+        _, _, (o, l, m) = lax.fori_loop(0, n, ring_step, (k, v, acc0))
+    del m
+    out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None], 0.0)
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(
+    mesh: Mesh,
+    *,
+    seq_axis: str = AXIS_SEQ,
+    batch_axes: Any = (AXIS_DATA,),
+) -> Any:
+    """AttentionFn over *global* ``[B, S, H, D]`` arrays, for model injection.
+
+    Wraps :func:`ring_attention` in a ``shard_map`` with batch over
+    ``batch_axes`` and sequence over ``seq_axis`` — drop-in for
+    ``TransformerLM(attention_fn=...)``: the model stays a plain pjit program
+    and only attention switches to the explicit ring schedule.
+    """
+    spec = P(batch_axes, seq_axis, None, None)
+
+    @functools.lru_cache(maxsize=2)
+    def _sharded(causal: bool):
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        def fn(q, k, v):
+            return ring_attention(q, k, v, causal=causal, axis_name=seq_axis)
+
+        return fn
+
+    def attention_fn(q, k, v, *, causal: bool = True):
+        return _sharded(causal)(q, k, v)
+
+    return attention_fn

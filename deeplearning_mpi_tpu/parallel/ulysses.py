@@ -1,0 +1,110 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``seq`` mesh axis.
+
+The second of the framework's two context-parallel attention schedules (the
+first is ``parallel.ring_attention``). Where ring attention keeps activations
+sequence-sharded throughout and rotates K/V, the all-to-all schedule
+*re-shards*: two ``lax.all_to_all`` collectives trade the sequence sharding
+for a head sharding around the attention core —
+
+    [B, S/n, H, D]  --all_to_all-->  [B, S, H/n, D]   (full sequence,
+                                                        1/n of the heads)
+    ... exact dense/flash attention on whole sequences ...
+    [B, S, H/n, D]  --all_to_all-->  [B, S/n, H, D]
+
+Each device then runs *unsharded* attention for its head group, so any
+single-device kernel (the dense oracle or the Pallas flash kernel) drops in
+unchanged — no blockwise re-derivation, no online-softmax recombination.
+Trade-offs vs the ring schedule: communication is two all-to-alls of the
+whole activation (cheap, bandwidth-optimal on ICI) instead of n K/V
+rotations, but the head count must be divisible by the ``seq`` axis size and
+each device temporarily materializes full-sequence scores for its head group
+(O(S²/n) memory vs the ring's O(S·S/n)).
+
+The reference has no analog (no attention anywhere — SURVEY.md §5.7); the
+design follows the public DeepSpeed-Ulysses schedule, re-expressed as XLA
+collectives under ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning_mpi_tpu.ops.attention import dense_attention
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
+
+# (q, k, v [B,S,H,D], causal=...) -> [B,S,H,D], run on full sequences.
+InnerAttentionFn = Callable[..., jax.Array]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    axis_name: str = AXIS_SEQ,
+    inner: InnerAttentionFn = dense_attention,
+) -> jax.Array:
+    """All-to-all attention over sequence shards (call inside shard_map).
+
+    Inputs are this device's sequence shard ``[B, S_local, H, D]`` with
+    ``H % axis_size == 0``. Returns the same shard of the attention output.
+    """
+    n = lax.axis_size(axis_name)
+    heads = q.shape[-2]
+    if heads % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the "
+            f"'{axis_name}' axis size ({n})"
+        )
+    if n == 1:
+        return inner(q, k, v, causal=causal)
+    # seq-sharded -> head-sharded: split heads (axis 2), gather sequence (1).
+    to_heads = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # [B, S, H/n, D]
+    ctx = inner(qh, kh, vh, causal=causal)
+    # head-sharded -> seq-sharded: split sequence (1), gather heads (2).
+    return lax.all_to_all(
+        ctx, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def make_ulysses_attention_fn(
+    mesh: Mesh,
+    *,
+    seq_axis: str = AXIS_SEQ,
+    batch_axes: Any = (AXIS_DATA,),
+    inner: InnerAttentionFn = dense_attention,
+) -> Any:
+    """AttentionFn over *global* ``[B, S, H, D]`` arrays, for model injection.
+
+    Drop-in for ``TransformerLM(attention_fn=...)`` — same contract as
+    ``parallel.ring_attention.make_ring_attention_fn``.
+    """
+    spec = P(batch_axes, seq_axis, None, None)
+
+    @functools.lru_cache(maxsize=2)
+    def _sharded(causal: bool):
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+        def fn(q, k, v):
+            return ulysses_attention(
+                q, k, v, causal=causal, axis_name=seq_axis, inner=inner
+            )
+
+        return fn
+
+    def attention_fn(q, k, v, *, causal: bool = True):
+        return _sharded(causal)(q, k, v)
+
+    return attention_fn

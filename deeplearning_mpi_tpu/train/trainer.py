@@ -44,7 +44,9 @@ from deeplearning_mpi_tpu.ops import (
 from deeplearning_mpi_tpu.train.state import TrainState
 
 Batch = dict[str, jax.Array]
-LossFn = Callable[[jax.Array, Batch], jax.Array]
+#: (logits, batch, where=None) -> scalar loss; ``where`` is an optional [B]
+#: validity mask excluding wrap-padded eval rows.
+LossFn = Callable[..., jax.Array]
 
 #: batch key holding the target, per task.
 _TARGETS = {"classification": "label", "segmentation": "mask"}
@@ -233,7 +235,10 @@ class Trainer:
         if not n_batches:
             raise ValueError("empty epoch — dataset smaller than one global batch")
         n_finite = float(finite_sum)  # one host sync per epoch
-        mean_loss = float(loss_sum) / max(n_finite, 1.0)
+        # All-non-finite epoch: report NaN, not a perfect-looking 0.0 — no
+        # optimizer step ran, and downstream best-checkpoint selection must
+        # not read the epoch as converged.
+        mean_loss = float(loss_sum) / n_finite if n_finite else float("nan")
         duration = time.perf_counter() - t0
         stats = {
             "epoch": epoch,

@@ -1,0 +1,91 @@
+"""Sequence/context parallelism tests: ring + all-to-all attention vs the
+dense oracle, on the 8-virtual-device mesh (SURVEY.md §4's no-hardware
+multi-process trick)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models.transformer import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.ops.attention import dense_attention
+from deeplearning_mpi_tpu.parallel import (
+    make_ring_attention_fn,
+    make_ulysses_attention_fn,
+)
+from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, batch_sharding, create_mesh
+
+
+def seq_mesh(seq=4, data=2):
+    return create_mesh(MeshSpec(data=data, seq=seq))
+
+
+def qkv(B=4, S=32, H=4, D=16, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.normal(size=(B, S, H, D)).astype(dtype)) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
+@pytest.mark.parametrize("make_fn", [make_ring_attention_fn, make_ulysses_attention_fn],
+                         ids=["ring", "ulysses"])
+def test_matches_dense_oracle(causal, make_fn):
+    mesh = seq_mesh()
+    q, k, v = qkv()
+    out = make_fn(mesh)(q, k, v, causal=causal)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("make_fn", [make_ring_attention_fn, make_ulysses_attention_fn],
+                         ids=["ring", "ulysses"])
+def test_grads_match_dense(make_fn):
+    """Backward through the collective schedule must match dense attention —
+    training correctness, not just inference."""
+    mesh = seq_mesh()
+    q, k, v = qkv(S=16)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss, argnums=(1, 2, 3))(dense_attention, q, k, v)
+    g_out = jax.grad(loss, argnums=(1, 2, 3))(make_fn(mesh), q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_ring_seq8_uneven_heads():
+    """The ring schedule has no head-divisibility constraint: seq=8 > heads=4."""
+    mesh = seq_mesh(seq=8, data=1)
+    q, k, v = qkv(S=64, H=4)
+    out = make_ring_attention_fn(mesh)(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = seq_mesh(seq=8, data=1)
+    q, k, v = qkv(S=64, H=4)  # 4 heads over seq=8: invalid
+    with pytest.raises(ValueError, match="divisible"):
+        make_ulysses_attention_fn(mesh)(q, k, v, causal=True)
+
+
+def test_transformer_with_ring_attention_matches_dense():
+    """Full TransformerLM forward with sequence-parallel attention injected ==
+    the dense-attention model, bitwise-same params (the attention_fn injection
+    point exists exactly for this swap)."""
+    mesh = seq_mesh()
+    cfg = TransformerConfig.tiny()
+    dense_model = TransformerLM(cfg, dtype=jnp.float32)
+    ring_model = TransformerLM(
+        cfg, dtype=jnp.float32, attention_fn=make_ring_attention_fn(mesh)
+    )
+    rng = np.random.default_rng(1)
+    tokens_np = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    variables = dense_model.init(jax.random.key(0), jnp.asarray(tokens_np))
+
+    ref = dense_model.apply(variables, jnp.asarray(tokens_np))
+    tokens = jax.device_put(jnp.asarray(tokens_np), batch_sharding(mesh, ndim=2))
+    out = jax.jit(ring_model.apply)(variables, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
