@@ -9,3 +9,4 @@ from deeplearning_mpi_tpu.ops.loss import (  # noqa: F401
     softmax_cross_entropy,
 )
 from deeplearning_mpi_tpu.ops.metrics import dice_score, top1_accuracy  # noqa: F401
+from deeplearning_mpi_tpu.ops.pallas import flash_attention  # noqa: F401

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the hand-scheduled hot ops.
+
+XLA's fusions cover the reference workloads (conv/BN/pooling — SURVEY.md
+§2b maps cuDNN onto plain XLA:TPU kernels), so Pallas is reserved for the ops
+where explicit VMEM scheduling beats the compiler: flash attention's online
+softmax over S² scores that must never be materialized in HBM.
+"""
+
+from deeplearning_mpi_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention,
+)

@@ -1,0 +1,278 @@
+"""Flash attention: tiled online-softmax Pallas TPU kernel.
+
+The memory-bound op XLA cannot rescue: dense attention materializes the
+``[S, S]`` score matrix in HBM, so past ~8k tokens the HBM round-trips (not
+the MXU) bound throughput and past ~32k the scores don't fit at all. This
+kernel streams K/V blocks through VMEM against a resident Q block,
+maintaining the flash-attention online-softmax accumulator
+``(acc, m, l)`` in VMEM scratch — O(S) memory, every matmul an
+MXU-shaped ``[block_q, head_dim] x [head_dim, block_k]`` tile.
+
+Schedule: grid ``(batch, heads, q_blocks, kv_blocks)``, the first three axes
+parallel (Mosaic splits them over the two TensorCores), the kv axis
+sequential ("arbitrary") so scratch carries the accumulator across kv steps.
+Causal masking is positional arithmetic in global coordinates; kv blocks
+entirely in a q block's future skip their matmuls via ``pl.when``.
+
+Backward is a custom VJP in blockwise pure JAX (``lax.scan`` over kv
+blocks): recomputes the row logsumexp online, then accumulates
+dq/dk/dv per block — O(S·block_k) live memory, never the full score
+matrix. It trades one extra QKᵀ pass (~20% backward FLOPs) for not
+threading the lse out of the kernel; the Pallas backward kernel is a
+later optimization.
+
+No reference analog (the reference has no attention — SURVEY.md §5.7).
+Conventions follow ``ops.attention.dense_attention`` (BSHD layout, f32
+softmax, zero rows for fully-masked queries), which is the oracle in tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
+
+
+def _swap_sh(x: jax.Array) -> jax.Array:
+    """BSHD <-> BHSD (self-inverse transpose of the seq/heads axes)."""
+    return x.transpose(0, 2, 1, 3)
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, causal: bool, scale: float, block_q: int, block_k: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: skip kv blocks whose every key is in every query's future.
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        q = q_ref[0, 0]  # [bq, d]
+        k = k_ref[0, 0]  # [bk, d]
+        v = v_ref[0, 0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            # finite NEG_INF ⇒ exp(0)=1 on rows still at the init value; re-zero.
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = jnp.where(l > 0.0, o, 0.0).astype(o_ref.dtype)
+
+
+def _fwd_pallas(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    """Run the kernel on BHSD-transposed inputs; returns BSHD output."""
+    batch, seq, heads, head_dim = q.shape
+    bq, bk = min(block_q, seq), min(block_k, seq)
+    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
+    grid = (batch, heads, seq // bq, seq // bk)
+    out = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel,
+            causal=causal, scale=head_dim**-0.5, block_q=bq, block_k=bk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, head_dim), lambda b, h, i, j: (b, h, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, bk, head_dim), lambda b, h, i, j: (b, h, j, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running denom
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return _swap_sh(out)
+
+
+def _blockwise_lse(
+    q: jax.Array, k_blocks: jax.Array, causal: bool, block_k: int, scale: float
+) -> jax.Array:
+    """Row logsumexp over all keys, streamed kv-block-wise. BHSD q."""
+    seq = q.shape[2]
+
+    def step(carry, inputs):
+        m, l = carry
+        j, k_blk = inputs
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (seq, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (seq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # Rows masked in every block seen so far self-pollute (exp(0)=1 per
+        # masked entry), but the first valid block rescales l by
+        # exp(NEG_INF - real_max) = 0, erasing the pollution — and causally
+        # every row has a valid diagonal key, so the global lse is exact.
+        p_sum = jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
+        l_new = l * jnp.exp(m - m_new) + p_sum
+        return (m_new, l_new), None
+
+    nk = k_blocks.shape[0]
+    batch, heads, _, _ = q.shape
+    m0 = jnp.full((batch, heads, seq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((batch, heads, seq), jnp.float32)
+    (m, l), _ = lax.scan(step, (m0, l0), (jnp.arange(nk), k_blocks))
+    return m + jnp.log(jnp.maximum(l, 1e-30))  # lse; fully-masked rows: ~NEG_INF
+
+
+def _flash_bwd_impl(
+    q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, do: jax.Array,
+    causal: bool, block_k: int, interpret: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Blockwise flash backward in pure JAX (BSHD in/out)."""
+    del interpret
+    batch, seq, heads, head_dim = q.shape
+    bk = min(block_k, seq)
+    nk = seq // bk
+    scale = head_dim**-0.5
+    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
+    ot, dot_ = _swap_sh(o).astype(jnp.float32), _swap_sh(do).astype(jnp.float32)
+    k_blocks = kt.reshape(batch, heads, nk, bk, head_dim).transpose(2, 0, 1, 3, 4)
+    v_blocks = vt.reshape(batch, heads, nk, bk, head_dim).transpose(2, 0, 1, 3, 4)
+
+    lse = _blockwise_lse(qt, k_blocks, causal, bk, scale)  # [B,H,S]
+    delta = jnp.sum(ot * dot_, axis=-1)  # [B,H,S] row dot(o, do)
+
+    def step(dq_acc, inputs):
+        j, k_blk, v_blk = inputs
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qt, k_blk, preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = lax.broadcasted_iota(jnp.int32, (seq, bk), 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (seq, bk), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [B,H,S,bk]; 0 for masked/empty rows
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dot_, preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dot_, v_blk, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=jnp.float32
+        )
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qt, preferred_element_type=jnp.float32)
+        return dq_acc, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((batch, heads, seq, head_dim), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, dq0, (jnp.arange(nk), k_blocks, v_blocks)
+    )
+    merge = lambda blocks: _swap_sh(  # noqa: E731  [nk,B,H,bk,D] -> BSHD
+        blocks.transpose(1, 2, 0, 3, 4).reshape(batch, heads, seq, head_dim)
+    )
+    return (
+        _swap_sh(dq).astype(q.dtype),
+        merge(dk_blocks).astype(k.dtype),
+        merge(dv_blocks).astype(v.dtype),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    o = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, o = res
+    return _flash_bwd_impl(q, k, v, o, do, causal, block_k, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Tiled flash attention over ``[B, S, H, D]`` (drop-in for
+    ``dense_attention`` and valid as ``TransformerLM(attention_fn=...)``).
+
+    ``interpret=None`` auto-selects: compiled Mosaic on TPU, the Pallas
+    interpreter elsewhere (so CPU tests and the virtual-device mesh run the
+    same code path). Sequences not divisible by the (clamped) block sizes
+    fall back to the dense op — correctness everywhere, tiling where it
+    counts.
+    """
+    seq = q.shape[1]
+    bq, bk = min(block_q, seq), min(block_k, seq)
+    if seq % bq or seq % bk:
+        return dense_attention(q, k, v, causal=causal)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, bq, bk, interpret)

@@ -124,7 +124,8 @@ def ring_attention(
 
     def ring_step(t, carry):
         k_blk, v_blk, acc = carry
-        # Issue the transfer of the *next* block first; XLA overlaps the
+        # Issue the transfer of the *next* block first; it depends only on the
+        # incoming K/V, so XLA's latency-hiding scheduler overlaps the
         # collective-permute DMA with this step's einsums (double buffering).
         k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
@@ -135,11 +136,17 @@ def ring_attention(
         )
         return k_nxt, v_nxt, acc
 
-    if n == 1:
-        _, _, (o, l, m) = ring_step(0, (k, v, acc0))
-    else:
-        _, _, (o, l, m) = lax.fori_loop(0, n, ring_step, (k, v, acc0))
-    del m
+    # n-1 rotations, then the last block's update outside the loop — the
+    # final iteration's K/V transfer would be discarded, and inside a
+    # compiled while loop dead ppermutes are NOT eliminated (1/n of the
+    # ring's ICI volume). n == 1 degrades to a single local update.
+    if n > 1:
+        k, v, acc0 = lax.fori_loop(0, n - 1, ring_step, (k, v, acc0))
+    o, l, _ = _block_update(
+        q, k, v, acc0,
+        causal=causal, q_offset=q_offset,
+        kv_offset=((my_idx - (n - 1)) % n) * s_local,
+    )
     out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None], 0.0)
     return out.astype(q.dtype)
 
