@@ -1,0 +1,180 @@
+"""Transformer LM training — the long-context / multi-axis-parallel workload.
+
+No reference analog (the reference's workloads are CNNs — ``SURVEY.md``
+§5.7); this is the workload that exercises the framework's first-class
+long-context and parallelism machinery:
+
+    # dense LM on synthetic bytes, pure DP
+    python -m deeplearning_mpi_tpu.cli.train_lm --num_epochs 3
+
+    # 64k context over a seq axis with ring attention + TP, on 8 fake devices
+    python -m deeplearning_mpi_tpu.cli.train_lm \
+        --n_virtual_devices 8 --sp 4 --tp 2 --attention ring --seq_len 65536
+
+    # MoE LM with experts sharded over the expert axis
+    python -m deeplearning_mpi_tpu.cli.train_lm --ep 4 --moe_experts 8
+
+Same trainer, logger, checkpoint, and flag conventions as the
+resnet/unet CLIs (``pytorch/resnet/main.py:167-182`` flag contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from deeplearning_mpi_tpu.utils import config
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    config.add_topology_flags(parser)
+    config.add_training_flags(
+        parser, num_epochs=10, batch_size=32, learning_rate=3e-4, random_seed=0,
+        model_filename="lm",
+    )
+    group = parser.add_argument_group("model")
+    group.add_argument("--seq_len", type=int, default=512)
+    group.add_argument("--num_layers", type=int, default=4)
+    group.add_argument("--num_heads", type=int, default=8)
+    group.add_argument("--head_dim", type=int, default=32)
+    group.add_argument("--d_model", type=int, default=256)
+    group.add_argument("--d_ff", type=int, default=1024)
+    group.add_argument("--remat", action="store_true",
+                       help="checkpoint each block (recompute in backward) — trades FLOPs for HBM")
+    group.add_argument("--attention", default="dense",
+                       choices=["dense", "flash", "ring", "ulysses"],
+                       help="attention core: flash = Pallas TPU kernel; ring/ulysses = sequence-parallel over --sp")
+    group.add_argument("--moe_experts", type=int, default=0,
+                       help="experts per MLP (0 = dense); shard with --ep")
+    group.add_argument("--moe_top_k", type=int, default=2)
+    group.add_argument("--moe_aux_weight", type=float, default=0.01)
+    data = parser.add_argument_group("data")
+    data.add_argument("--text_file", default=None,
+                      help="train on this file's bytes (vocab 256); default: synthetic motifs")
+    data.add_argument("--train_sequences", type=int, default=512,
+                      help="synthetic dataset size (sequences)")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from deeplearning_mpi_tpu.utils import config
+
+    topo, mesh = config.setup_runtime(args)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.data import ShardedLoader
+    from deeplearning_mpi_tpu.data.lm_text import ByteTextDataset, SyntheticTokens
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.train import Checkpointer, Trainer, create_train_state
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+    from deeplearning_mpi_tpu.utils.logging import RunLogger
+
+    logger = RunLogger(args.log_dir)
+    logger.log_system_information()
+    logger.log_hyperparameters(vars(args))
+
+    if args.text_file:
+        dataset = ByteTextDataset(args.text_file, args.seq_len)
+    else:
+        dataset = SyntheticTokens(
+            args.train_sequences, args.seq_len, seed=args.random_seed
+        )
+    n_eval = max(1, len(dataset) // 10)
+    train_ds = _Slice(dataset, 0, len(dataset) - n_eval)
+    eval_ds = _Slice(dataset, len(dataset) - n_eval, len(dataset))
+
+    train_loader = ShardedLoader(
+        train_ds, args.batch_size, mesh, shuffle=True, seed=args.random_seed
+    )
+    eval_loader = ShardedLoader(
+        eval_ds, args.batch_size, mesh, shuffle=False, drop_last=False
+    )
+
+    attention_fn = None
+    if args.attention == "flash":
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention
+
+        attention_fn = flash_attention
+    elif args.attention == "ring":
+        from deeplearning_mpi_tpu.parallel import make_ring_attention_fn
+
+        attention_fn = make_ring_attention_fn(mesh)
+    elif args.attention == "ulysses":
+        from deeplearning_mpi_tpu.parallel import make_ulysses_attention_fn
+
+        attention_fn = make_ulysses_attention_fn(mesh)
+
+    cfg = TransformerConfig(
+        vocab_size=256,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        head_dim=args.head_dim,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
+    )
+    model = TransformerLM(
+        config=cfg,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        attention_fn=attention_fn,
+        remat=args.remat,
+    )
+    tx = build_optimizer("adam", args.learning_rate, clip_norm=1.0)
+    state = create_train_state(
+        model, jax.random.key(args.random_seed),
+        jnp.zeros((1, args.seq_len), jnp.int32), tx,
+    )
+
+    checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    start_epoch = 0
+    if args.resume:
+        latest = checkpointer.latest_epoch()
+        if latest is None:
+            logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
+        else:
+            state = checkpointer.restore(state)
+            start_epoch = latest + 1
+            logger.log(f"resumed from epoch {latest} (step {int(state.step)})")
+
+    trainer = Trainer(
+        state, "lm", mesh,
+        logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
+        aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
+    )
+    trainer.place_state()
+    try:
+        trainer.fit(
+            train_loader, args.num_epochs,
+            eval_loader=eval_loader, start_epoch=start_epoch,
+        )
+    finally:
+        checkpointer.close()
+        from deeplearning_mpi_tpu.runtime import bootstrap
+        bootstrap.shutdown()
+    return 0
+
+
+class _Slice:
+    """Contiguous view of a dataset — the train/eval split (the reference
+    splits 80/20 with ``random_split``, ``pytorch/unet/train.py:86-88``)."""
+
+    def __init__(self, dataset, start: int, stop: int) -> None:
+        self.dataset = dataset
+        self.start = start
+        self.stop = stop
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __getitem__(self, index: int):
+        return self.dataset[self.start + index]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

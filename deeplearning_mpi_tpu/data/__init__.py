@@ -10,6 +10,10 @@ over the ``data`` axis.
 
 from deeplearning_mpi_tpu.data.loader import ShardedLoader  # noqa: F401
 from deeplearning_mpi_tpu.data.cifar10 import CIFAR10, SyntheticCIFAR10  # noqa: F401
+from deeplearning_mpi_tpu.data.lm_text import (  # noqa: F401
+    ByteTextDataset,
+    SyntheticTokens,
+)
 from deeplearning_mpi_tpu.data.segmentation import (  # noqa: F401
     SegmentationFolderDataset,
     SyntheticShapesDataset,

@@ -1,0 +1,79 @@
+"""Language-model datasets: byte-level text + synthetic token streams.
+
+No reference analog (the reference's two workloads are CNNs over images —
+``SURVEY.md`` §5.7); this feeds the framework's transformer/long-context
+workload. Byte-level tokenization (vocab 256) keeps the pipeline hermetic:
+any text file works, no tokenizer artifacts to download — the moral
+equivalent of the reference's "prefetch the dataset out-of-band, never
+download in-job" stance (``pytorch/resnet/download.py:1-19``).
+
+Examples are ``{"tokens": int32 [seq_len]}`` — fixed length, static shapes
+(XLA compiles one program per shape). The LM loss shifts internally
+(predict ``tokens[1:]`` from ``logits[:-1]``), so no separate target key.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+class ByteTextDataset:
+    """Non-overlapping fixed-length byte windows over a UTF-8/binary file.
+
+    ``seq_len``-sized chunks of the raw byte stream; the trailing partial
+    chunk is dropped (static shapes). Vocab is the full byte range (256).
+    """
+
+    vocab_size = 256
+
+    def __init__(self, path: str | Path, seq_len: int) -> None:
+        data = np.frombuffer(Path(path).read_bytes(), np.uint8)
+        n_chunks = len(data) // seq_len
+        if n_chunks == 0:
+            raise ValueError(
+                f"{path} holds {len(data)} bytes < one sequence of {seq_len}"
+            )
+        self.chunks = data[: n_chunks * seq_len].reshape(n_chunks, seq_len)
+
+    def __len__(self) -> int:
+        return len(self.chunks)
+
+    def __getitem__(self, index: int) -> dict[str, np.ndarray]:
+        return {"tokens": self.chunks[index].astype(np.int32)}
+
+
+class SyntheticTokens:
+    """Hermetic LM stand-in: structured pseudo-text a model can learn.
+
+    Each sequence is a repeating random motif with noise, so the loss has
+    learnable signal (a pure-uniform stream would pin the loss at
+    ``log(vocab)`` and hide training bugs). Deterministic per (seed, index).
+    """
+
+    def __init__(
+        self,
+        num_sequences: int,
+        seq_len: int,
+        *,
+        vocab_size: int = 256,
+        seed: int = 0,
+    ) -> None:
+        self.num_sequences = num_sequences
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_sequences
+
+    def __getitem__(self, index: int) -> dict[str, np.ndarray]:
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, index]))
+        motif = rng.integers(0, self.vocab_size, 16)
+        tokens = np.tile(motif, self.seq_len // 16 + 1)[: self.seq_len]
+        noise = rng.random(self.seq_len) < 0.05
+        tokens = np.where(
+            noise, rng.integers(0, self.vocab_size, self.seq_len), tokens
+        )
+        return {"tokens": tokens.astype(np.int32)}

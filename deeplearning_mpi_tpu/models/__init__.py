@@ -19,6 +19,7 @@ from deeplearning_mpi_tpu.models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
+from deeplearning_mpi_tpu.models.moe import MoEMLP, collect_aux_loss  # noqa: F401
 from deeplearning_mpi_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,
     TransformerLM,

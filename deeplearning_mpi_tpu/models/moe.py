@@ -1,0 +1,147 @@
+"""Mixture-of-Experts MLP with capacity-based dense dispatch (GShard-style).
+
+No reference analog (the reference's models are CNNs with no MoE —
+``SURVEY.md`` §2c "Expert parallel: NO"), but expert parallelism is a
+first-class axis of this framework's mesh, and this layer is what exercises
+it.
+
+TPU-first design choices:
+- **Static shapes everywhere.** Routing uses the GShard/Switch dense-dispatch
+  formulation: every expert processes a fixed-capacity ``[E, G, C, d]`` block
+  and over-capacity tokens are dropped (their block output is zero, so they
+  ride the transformer's residual connection unchanged). No gather/scatter
+  with data-dependent shapes — XLA can tile every einsum onto the MXU.
+- **Sharding does the communication.** Expert weight stacks are sharded
+  ``[E→expert, ...]`` over the mesh's ``expert`` axis (see
+  ``parallel/expert_parallel.py``); the dispatch/combine einsums then contract
+  a ``data``-sharded operand with an ``expert``-sharded one and GSPMD inserts
+  the all-to-alls — the hand-written ``a2a`` of GPU MoE stacks is a sharding
+  annotation here.
+- f32 router. Routing decisions (softmax + top-k + cumsum positions) are
+  computed in float32; bf16 router logits flip top-k order at scale.
+
+The layer slots into :class:`~deeplearning_mpi_tpu.models.transformer.Block`
+via its ``mlp_cls`` injection point (same positional ``(d_ff, dtype)``
+signature as ``SwiGLU``), so a dense LM becomes an MoE LM by configuration.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+#: Flax collection + name under which each MoE layer sows its scalar
+#: load-balance loss. Collect with ``collect_aux_loss``.
+AUX_COLLECTION = "moe_losses"
+AUX_NAME = "load_balance"
+
+
+def collect_aux_loss(variables: dict[str, Any]) -> jax.Array:
+    """Sum every sown MoE load-balance loss in a mutated-variables dict.
+
+    Returns a scalar 0.0 when the tree has no MoE layers (dense models), so
+    callers can add it unconditionally: ``loss + aux_weight * collect_aux_loss(m)``.
+    """
+    tree = variables.get(AUX_COLLECTION, {})
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(leaf) for leaf in leaves)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed mixture of SwiGLU experts, fixed capacity per expert.
+
+    Drop-in for :class:`SwiGLU` in a transformer block: same
+    ``(d_ff, dtype)`` leading attributes, same ``[B, S, d] -> [B, S, d]``
+    contract. Expert weights live in stacked parameters named ``experts_*``
+    with a leading ``[num_experts, ...]`` dim — the path marker + shape the
+    expert-parallel sharding rule keys on.
+    """
+
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    #: renormalize the selected top-k gates to sum to 1 per token.
+    normalize_gates: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, seq, d_model = x.shape
+        n_exp, k = self.num_experts, self.top_k
+        # Per-group (= per batch row) expert capacity. ceil so tiny test
+        # configs never round to zero; static because shapes are static.
+        capacity = max(1, math.ceil(k * seq * self.capacity_factor / n_exp))
+        capacity = min(capacity, seq)  # an expert can't hold more than all tokens
+
+        # --- Router (f32): probs, top-k selection -------------------------
+        router_logits = nn.Dense(
+            n_exp, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E]
+        gates, expert_idx = jax.lax.top_k(probs, k)  # [B, S, k]
+        if self.normalize_gates:
+            gates = gates / jnp.maximum(
+                jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+            )
+
+        # --- Positions within each expert's capacity buffer ---------------
+        # Slot-by-slot (k is 1 or 2 in practice): tokens claim positions in
+        # routing order — sequence order within a slot, slot 0 before slot 1 —
+        # via exclusive cumsums. Over-capacity claims are dropped (GShard).
+        combine = jnp.zeros((batch, seq, n_exp, capacity), jnp.float32)
+        count = jnp.zeros((batch, 1, n_exp), jnp.int32)  # claims so far per expert
+        for slot in range(k):
+            mask = jax.nn.one_hot(expert_idx[..., slot], n_exp, dtype=jnp.int32)
+            # exclusive cumsum over the sequence + claims from earlier slots
+            pos = jnp.cumsum(mask, axis=1) - mask + count  # [B, S, E]
+            keep = (mask * (pos < capacity)).astype(jnp.float32)
+            slot_dispatch = keep[..., None] * jax.nn.one_hot(
+                pos, capacity, dtype=jnp.float32
+            )  # [B, S, E, C]
+            combine = combine + gates[..., slot, None, None] * slot_dispatch
+            count = count + jnp.sum(mask, axis=1, keepdims=True)
+        dispatch = (combine > 0.0).astype(x.dtype)  # [B, S, E, C]
+
+        # --- Load-balance aux loss (Switch Transformer eq. 4) --------------
+        # E * sum_e (fraction of tokens routed to e) * (mean router prob of e);
+        # 1.0 at perfect balance. Uses slot-0 (primary) assignments.
+        primary = jax.nn.one_hot(expert_idx[..., 0], n_exp, dtype=jnp.float32)
+        frac_tokens = jnp.mean(primary, axis=(0, 1))  # [E]
+        mean_probs = jnp.mean(probs, axis=(0, 1))  # [E]
+        aux = n_exp * jnp.sum(frac_tokens * mean_probs)
+        self.sow(AUX_COLLECTION, AUX_NAME, aux)
+
+        # --- Expert computation (stacked SwiGLU, einsum-only) --------------
+        # Stacked weights [E, ...]: leading dim shards over the mesh `expert`
+        # axis, last matmul dim over `model` (see expert_parallel.ep_spec).
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param(
+            "experts_gate", init, (n_exp, d_model, self.d_ff), jnp.float32
+        ).astype(self.dtype)
+        w_up = self.param(
+            "experts_up", init, (n_exp, d_model, self.d_ff), jnp.float32
+        ).astype(self.dtype)
+        w_down = self.param(
+            "experts_down", init, (n_exp, self.d_ff, d_model), jnp.float32
+        ).astype(self.dtype)
+
+        xe = x.astype(self.dtype)
+        # dispatch: groups g = batch rows. [B,S,E,C] x [B,S,d] -> [E,B,C,d]
+        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xe)
+        hidden = nn.silu(
+            jnp.einsum("egcd,edf->egcf", expert_in, w_gate)
+        ) * jnp.einsum("egcd,edf->egcf", expert_in, w_up)
+        expert_out = jnp.einsum("egcf,efd->egcd", hidden, w_down)
+        # combine carries the gate weights; dropped tokens get exact zeros
+        # (residual passthrough in the enclosing block).
+        return jnp.einsum(
+            "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
+        )

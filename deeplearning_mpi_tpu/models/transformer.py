@@ -23,6 +23,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable
 
 import flax.linen as nn
@@ -128,7 +129,12 @@ class Block(nn.Module):
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
-    """Size knobs for :class:`TransformerLM`; ``tiny()`` is the test config."""
+    """Size knobs for :class:`TransformerLM`; ``tiny()`` is the test config.
+
+    ``moe_experts > 0`` swaps every block's MLP for a routed
+    :class:`~deeplearning_mpi_tpu.models.moe.MoEMLP` (top-k routing, fixed
+    capacity, experts sharded over the mesh ``expert`` axis).
+    """
 
     vocab_size: int = 32_000
     num_layers: int = 12
@@ -137,6 +143,12 @@ class TransformerConfig:
     d_model: int = 768
     d_ff: int = 2048
     tied_embeddings: bool = True
+    # The load-balance aux-loss weight is a *trainer* knob
+    # (``Trainer(aux_weight=...)``), not a model attribute: the model only
+    # sows the loss (``MoEMLP``), the training loss composes it.
+    moe_experts: int = 0  # 0 = dense SwiGLU MLP
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @staticmethod
     def tiny() -> "TransformerConfig":
@@ -144,6 +156,10 @@ class TransformerConfig:
             vocab_size=256, num_layers=2, num_heads=4, head_dim=8,
             d_model=32, d_ff=64,
         )
+
+    @staticmethod
+    def tiny_moe(num_experts: int = 4) -> "TransformerConfig":
+        return dataclasses.replace(TransformerConfig.tiny(), moe_experts=num_experts)
 
 
 class TransformerLM(nn.Module):
@@ -161,7 +177,14 @@ class TransformerLM(nn.Module):
     mlp_cls: type[nn.Module] | None = None
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions: jax.Array | None = None) -> jax.Array:
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: jax.Array | None = None,
+        *,
+        train: bool = False,
+    ) -> jax.Array:
+        del train  # no dropout/batch-stats yet; accepted for trainer uniformity
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(
@@ -172,11 +195,21 @@ class TransformerLM(nn.Module):
             embedding_init=nn.initializers.normal(0.02), name="embed",
         )
         x = embed(tokens)
+        mlp_cls = self.mlp_cls
+        if mlp_cls is None and cfg.moe_experts > 0:
+            from deeplearning_mpi_tpu.models.moe import MoEMLP
+
+            mlp_cls = functools.partial(
+                MoEMLP,
+                num_experts=cfg.moe_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+            )
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
-                attention_fn=self.attention_fn, mlp_cls=self.mlp_cls,
+                attention_fn=self.attention_fn, mlp_cls=mlp_cls,
                 name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)

@@ -8,6 +8,7 @@ matrices over ``model``, sequence parallelism shards the token axis over
 ``seq`` (ring attention), expert parallelism shards experts over ``expert``.
 """
 
+from deeplearning_mpi_tpu.parallel.expert_parallel import ep_spec  # noqa: F401
 from deeplearning_mpi_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention_fn,
     ring_attention,

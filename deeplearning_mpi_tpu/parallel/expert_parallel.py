@@ -1,0 +1,63 @@
+"""Expert parallelism: sharding rules for stacked MoE expert weights.
+
+No reference analog (``SURVEY.md`` §2c: "Expert parallel (EP / MoE): NO");
+here EP is the ``expert`` mesh axis plus this rule. Expert weight stacks
+(``MoEMLP``'s ``experts_*`` params, shaped ``[E, in, out]``) shard their
+leading expert dim over ``expert`` and their matmul dim over ``model`` —
+EP×TP composed in one PartitionSpec. The dispatch/combine all-to-alls are
+NOT written anywhere: ``MoEMLP``'s einsums contract a ``data``-sharded
+activation with an ``expert``-sharded weight stack, and GSPMD inserts the
+collectives (the TPU-native equivalent of the hand-rolled
+``all_to_all`` + NCCL group calls in GPU MoE stacks).
+
+The rule is path-keyed like the TP rule (``tensor_parallel.tp_spec``): any
+3-D leaf whose path contains ``experts`` is treated as a stacked expert
+weight; everything else falls through to the TP rule. Optimizer moments
+mirror parameter paths/shapes, so they land on identical shardings for free.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT, AXIS_MODEL
+
+#: Param-path substring marking stacked per-expert weights ``[E, ...]``.
+EXPERT_MARKER = "experts"
+
+#: Name substrings of expert stacks whose *input* dim is the sharded matmul
+#: dim (megatron row-parallel within each expert) — the projection back into
+#: the residual stream.
+ROW_PARALLEL_EXPERT_MARKERS = ("down",)
+
+
+def is_expert_leaf(path: str, leaf: jax.Array) -> bool:
+    return EXPERT_MARKER in path and leaf.ndim >= 3
+
+
+def ep_spec(
+    leaf: jax.Array,
+    ep: int,
+    tp: int,
+    *,
+    path: str,
+    expert_axis: str = AXIS_EXPERT,
+    model_axis: str = AXIS_MODEL,
+) -> P:
+    """PartitionSpec for a stacked expert weight ``[E, in, out]``.
+
+    Leading dim over ``expert`` (when divisible); within each expert the
+    megatron rule on the trailing matmul dims: ``down`` projections shard the
+    input dim (row-parallel), everything else the output dim (column-parallel).
+    """
+    dims: list[str | None] = [None] * leaf.ndim
+    if ep > 1 and leaf.shape[0] % ep == 0:
+        dims[0] = expert_axis
+    if tp > 1:
+        if any(m in path for m in ROW_PARALLEL_EXPERT_MARKERS):
+            if leaf.shape[-2] % tp == 0:
+                dims[-2] = model_axis
+        elif leaf.shape[-1] % tp == 0:
+            dims[-1] = model_axis
+    return P(*dims)

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning_mpi_tpu.ops.attention import NEG_INF
+from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
 
 
@@ -178,7 +178,8 @@ def make_ring_attention_fn(
 
         return fn
 
-    def attention_fn(q, k, v, *, causal: bool = True):
-        return _sharded(causal)(q, k, v)
+    from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
 
-    return attention_fn
+    return with_divisibility_fallback(
+        mesh, batch_axes, seq_axis, _sharded, dense_attention
+    )

@@ -1,0 +1,39 @@
+"""Shared plumbing for the sequence-parallel attention factories."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from jax.sharding import Mesh
+
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_SEQ
+
+
+def with_divisibility_fallback(
+    mesh: Mesh,
+    batch_axes: Any,
+    seq_axis: str,
+    sharded: Callable[[bool], Callable],
+    fallback: Callable,
+) -> Callable:
+    """Wrap a seq-parallel attention schedule with a static-shape fallback.
+
+    ``sharded(causal)`` returns the shard_map'd schedule; ``fallback`` is a
+    single-device attention core. Shapes the mesh can't divide — notably the
+    batch-1 forward ``model.init`` runs to shape the params (attention itself
+    has no params) — take the fallback instead of failing shard_map's
+    divisibility check. The decision is static (trace-time shapes), so jit
+    caches one program per shape as usual.
+    """
+    batch_list = [batch_axes] if isinstance(batch_axes, str) else list(batch_axes)
+    dp = 1
+    for a in batch_list:
+        dp *= mesh.shape[a]
+    sp = mesh.shape[seq_axis if seq_axis else AXIS_SEQ]
+
+    def attention_fn(q, k, v, *, causal: bool = True):
+        if q.shape[0] % dp or q.shape[1] % sp:
+            return fallback(q, k, v, causal=causal)
+        return sharded(causal)(q, k, v)
+
+    return attention_fn

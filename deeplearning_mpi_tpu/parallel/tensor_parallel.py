@@ -60,12 +60,30 @@ def tp_spec(
     return P()
 
 
-def _map_with_spec(fn, params: PyTree, tp: int, axis: str, min_size: int) -> PyTree:
+def param_spec(
+    leaf: jax.Array,
+    *,
+    tp: int,
+    ep: int = 1,
+    axis: str = AXIS_MODEL,
+    min_size: int = 1024,
+    path: str = "",
+) -> P:
+    """Combined EP+TP rule for one leaf: stacked expert weights (path contains
+    ``experts``, ndim≥3) get the expert rule; everything else the TP rule."""
+    from deeplearning_mpi_tpu.parallel import expert_parallel
+
+    if expert_parallel.is_expert_leaf(path, leaf):
+        return expert_parallel.ep_spec(leaf, ep, tp, path=path, model_axis=axis)
+    return tp_spec(leaf, tp, axis=axis, min_size=min_size, path=path)
+
+
+def _map_with_spec(fn, params: PyTree, tp: int, ep: int, axis: str, min_size: int) -> PyTree:
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: fn(
             leaf,
-            tp_spec(
-                leaf, tp, axis=axis, min_size=min_size,
+            param_spec(
+                leaf, tp=tp, ep=ep, axis=axis, min_size=min_size,
                 path=jax.tree_util.keystr(path),
             ),
         ),
@@ -81,21 +99,28 @@ def infer_tp_param_sharding(
     min_size: int = 1024,
 ) -> PyTree:
     """NamedSharding pytree for ``params`` (or any params-shaped pytree)."""
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT
+
     tp = mesh.shape[axis]
+    ep = mesh.shape.get(AXIS_EXPERT, 1)
     return _map_with_spec(
-        lambda leaf, spec: NamedSharding(mesh, spec), params, tp, axis, min_size
+        lambda leaf, spec: NamedSharding(mesh, spec), params, tp, ep, axis, min_size
     )
 
 
 def shard_state(state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL) -> PyTree:
-    """Place a whole TrainState on the mesh under the TP rule.
+    """Place a whole TrainState on the mesh under the EP+TP rules.
 
-    Kernels and their optimizer moments shard over ``model``; biases, BN
-    statistics, and the step counter replicate. With ``tp == 1`` this
-    degrades to full replication — exactly pure DP.
+    Kernels and their optimizer moments shard over ``model``, stacked expert
+    weights over ``expert`` (+``model``); biases, BN statistics, and the step
+    counter replicate. With all axes size 1 this degrades to full replication
+    — exactly pure DP.
     """
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT
+
     tp = mesh.shape[tp_axis]
+    ep = mesh.shape.get(AXIS_EXPERT, 1)
     return _map_with_spec(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        state, tp, tp_axis, 1024,
+        state, tp, ep, tp_axis, 1024,
     )

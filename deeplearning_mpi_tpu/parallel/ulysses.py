@@ -104,7 +104,6 @@ def make_ulysses_attention_fn(
 
         return fn
 
-    def attention_fn(q, k, v, *, causal: bool = True):
-        return _sharded(causal)(q, k, v)
+    from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
 
-    return attention_fn
+    return with_divisibility_fallback(mesh, batch_axes, seq_axis, _sharded, inner)

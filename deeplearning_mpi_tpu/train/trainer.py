@@ -35,8 +35,10 @@ import optax
 from jax.sharding import Mesh
 
 from deeplearning_mpi_tpu.data.loader import prefetch
+from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
 from deeplearning_mpi_tpu.ops import (
     dice_score,
+    lm_cross_entropy,
     sigmoid_binary_cross_entropy,
     softmax_cross_entropy,
     top1_accuracy,
@@ -48,8 +50,17 @@ Batch = dict[str, jax.Array]
 #: validity mask excluding wrap-padded eval rows.
 LossFn = Callable[..., jax.Array]
 
-#: batch key holding the target, per task.
-_TARGETS = {"classification": "label", "segmentation": "mask"}
+#: batch key holding the model input, per task.
+_INPUTS = {"classification": "image", "segmentation": "image", "lm": "tokens"}
+
+
+def _lm_loss(logits: jax.Array, batch: Batch, where: jax.Array | None = None) -> jax.Array:
+    # Combine the loader's [B] validity mask with any [B, S] token mask.
+    mask = batch.get("mask")
+    if where is not None:
+        where_bs = jnp.broadcast_to(where[:, None], batch["tokens"].shape)
+        mask = where_bs if mask is None else mask * where_bs
+    return lm_cross_entropy(logits, batch["tokens"], mask)
 
 
 def _task_loss(task: str) -> LossFn:
@@ -63,6 +74,8 @@ def _task_loss(task: str) -> LossFn:
         return lambda logits, batch, where=None: sigmoid_binary_cross_entropy(
             logits[..., 0], batch["mask"], where
         )
+    if task == "lm":
+        return _lm_loss
     raise ValueError(f"unknown task '{task}'")
 
 
@@ -70,27 +83,32 @@ def make_train_step(
     task: str,
     *,
     donate: bool = True,
+    aux_weight: float = 0.0,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
 
     Grad clipping and the optimizer live in ``state.tx`` (optax chain), so one
     step function serves every workload. ``donate=True`` donates the input
     state's buffers — the update is in-place in HBM, halving peak parameter
-    memory versus the reference's retain-everything step.
+    memory versus the reference's retain-everything step. ``aux_weight``
+    scales sown auxiliary losses (MoE load-balance) into the optimized loss.
     """
     loss_fn = _task_loss(task)
+    input_key = _INPUTS[task]
 
     def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict[str, jax.Array]]:
         def compute_loss(params):
             outputs, mutated = state.apply_fn(
                 {"params": params, "batch_stats": state.batch_stats},
-                batch["image"],
+                batch[input_key],
                 train=True,
-                mutable=["batch_stats"],
+                mutable=["batch_stats", AUX_COLLECTION],
             )
-            return loss_fn(outputs, batch), mutated["batch_stats"]
+            loss = loss_fn(outputs, batch)
+            total = loss + aux_weight * collect_aux_loss(mutated) if aux_weight else loss
+            return total, (loss, mutated.get("batch_stats", {}))
 
-        (loss, new_batch_stats), grads = jax.value_and_grad(
+        (_, (loss, new_batch_stats)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params)
 
@@ -125,9 +143,10 @@ def make_eval_step(task: str) -> Callable[[TrainState, Batch], dict[str, jax.Arr
     """
 
     loss_fn = _task_loss(task)
+    input_key = _INPUTS[task]
 
     def step(state: TrainState, batch: Batch) -> dict[str, jax.Array]:
-        outputs = state.apply_fn(state.variables(), batch["image"], train=False)
+        outputs = state.apply_fn(state.variables(), batch[input_key], train=False)
         # Wrap-padded rows (loader drop_last=False) carry __valid__=0 and are
         # excluded from every mean; "weight" is the real-example count the
         # caller accumulates by.
@@ -135,12 +154,14 @@ def make_eval_step(task: str) -> Callable[[TrainState, Batch], dict[str, jax.Arr
         metrics = {"loss": loss_fn(outputs, batch, valid)}
         if task == "classification":
             metrics["accuracy"] = top1_accuracy(outputs, batch["label"], valid)
-        else:
+        elif task == "segmentation":
             pred = (jax.nn.sigmoid(outputs[..., 0]) > 0.5).astype(jnp.float32)
             metrics["dice"] = dice_score(pred, batch["mask"], valid)
+        # lm: loss only; perplexity = exp(mean loss) is derived by the caller
+        # after cross-batch averaging (exp of a mean ≠ mean of exps).
         metrics["weight"] = (
             jnp.sum(valid) if valid is not None
-            else jnp.asarray(batch["image"].shape[0], jnp.float32)
+            else jnp.asarray(batch[input_key].shape[0], jnp.float32)
         )
         return metrics
 
@@ -195,6 +216,7 @@ class Trainer:
         logger: Any = None,
         checkpointer: Any = None,
         eval_every: int = 10,  # "every 10 epochs" (resnet/main.py:136, unet/train.py:213)
+        aux_weight: float = 0.0,  # MoE load-balance loss weight
     ) -> None:
         self.state = state
         self.task = task
@@ -202,7 +224,7 @@ class Trainer:
         self.logger = logger
         self.checkpointer = checkpointer
         self.eval_every = eval_every
-        self.train_step = make_train_step(task)
+        self.train_step = make_train_step(task, aux_weight=aux_weight)
         self.eval_step = make_eval_step(task)
         self.history: list[dict[str, float]] = []
 
@@ -231,7 +253,7 @@ class Trainer:
                 else finite_sum + metrics["finite"]
             )
             n_batches += 1
-            images += batch["image"].shape[0]
+            images += batch[_INPUTS[self.task]].shape[0]
         if not n_batches:
             raise ValueError("empty epoch — dataset smaller than one global batch")
         n_finite = float(finite_sum)  # one host sync per epoch
@@ -275,7 +297,12 @@ class Trainer:
             weight = w if weight is None else weight + w
         if weight is None or not float(weight):
             raise ValueError("empty eval loader")
-        return {k: float(v) / float(weight) for k, v in sums.items()}
+        means = {k: float(v) / float(weight) for k, v in sums.items()}
+        if self.task == "lm":
+            import math
+
+            means["perplexity"] = math.exp(min(means["loss"], 30.0))
+        return means
 
     def fit(
         self,

@@ -24,6 +24,9 @@ def add_topology_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--n_virtual_devices", type=int, default=None, help="fake N CPU devices for hardware-free multi-device runs")
     group.add_argument("--dp", type=int, default=-1, help="data-parallel degree (-1: all remaining devices)")
     group.add_argument("--tp", type=int, default=1, help="tensor-parallel degree (model axis)")
+    group.add_argument("--pp", type=int, default=1, help="pipeline-parallel degree (pipe axis)")
+    group.add_argument("--sp", type=int, default=1, help="sequence-parallel degree (seq axis; ring/ulysses attention)")
+    group.add_argument("--ep", type=int, default=1, help="expert-parallel degree (expert axis; MoE)")
 
 
 def add_training_flags(
@@ -74,5 +77,13 @@ def setup_runtime(args: argparse.Namespace):
         process_id=args.process_id,
         platform=args.platform,
     )
-    mesh = create_mesh(MeshSpec(data=args.dp, model=args.tp))
+    mesh = create_mesh(
+        MeshSpec(
+            data=args.dp,
+            pipe=getattr(args, "pp", 1),
+            expert=getattr(args, "ep", 1),
+            seq=getattr(args, "sp", 1),
+            model=args.tp,
+        )
+    )
     return topo, mesh
