@@ -42,6 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
     group.add_argument("--d_ff", type=int, default=1024)
     group.add_argument("--remat", action="store_true",
                        help="checkpoint each block (recompute in backward) — trades FLOPs for HBM")
+    group.add_argument("--microbatches", type=int, default=4,
+                       help="GPipe microbatches when --pp > 1 (bubble fraction = (pp-1)/(M+pp-1))")
     group.add_argument("--attention", default="dense",
                        choices=["dense", "flash", "ring", "ulysses"],
                        help="attention core: flash = Pallas TPU kernel; ring/ulysses = sequence-parallel over --sp")
@@ -119,12 +121,18 @@ def main(argv: list[str] | None = None) -> int:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
     )
-    model = TransformerLM(
-        config=cfg,
-        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
-        attention_fn=attention_fn,
-        remat=args.remat,
-    )
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.pp > 1:
+        from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
+
+        model = PipelinedLM(
+            cfg, mesh, num_microbatches=args.microbatches,
+            dtype=dtype, attention_fn=attention_fn, remat=args.remat,
+        )
+    else:
+        model = TransformerLM(
+            config=cfg, dtype=dtype, attention_fn=attention_fn, remat=args.remat,
+        )
     tx = build_optimizer("adam", args.learning_rate, clip_norm=1.0)
     state = create_train_state(
         model, jax.random.key(args.random_seed),
@@ -148,10 +156,10 @@ def main(argv: list[str] | None = None) -> int:
         aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
     )
     trainer.place_state()
+    config.build_observability(args, trainer)
     try:
-        trainer.fit(
-            train_loader, args.num_epochs,
-            eval_loader=eval_loader, start_epoch=start_epoch,
+        config.execute_training(
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
         )
     finally:
         checkpointer.close()

@@ -55,7 +55,11 @@ def main(argv: list[str] | None = None) -> int:
     import jax.numpy as jnp
 
     from deeplearning_mpi_tpu.data import CIFAR10, ShardedLoader, SyntheticCIFAR10
-    from deeplearning_mpi_tpu.data.cifar10 import eval_transform, train_transform
+    from deeplearning_mpi_tpu.data.native import (
+        eval_transform,
+        native_available,
+        train_transform,
+    )
     from deeplearning_mpi_tpu.models import get_model
     from deeplearning_mpi_tpu.train import Checkpointer, Trainer, create_train_state
     from deeplearning_mpi_tpu.train.trainer import build_optimizer
@@ -64,6 +68,12 @@ def main(argv: list[str] | None = None) -> int:
     logger = RunLogger(args.log_dir)
     logger.log_system_information()
     logger.log_hyperparameters(vars(args))
+    logger.log(
+        "input pipeline: native C++ transforms"
+        if native_available()
+        else "input pipeline: numpy transforms (native lib unavailable; "
+        "set g++ on PATH or unset DLMPI_TPU_NO_NATIVE)"
+    )
 
     if args.synthetic:
         train_ds = SyntheticCIFAR10(args.train_samples, seed=args.random_seed)
@@ -111,10 +121,10 @@ def main(argv: list[str] | None = None) -> int:
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
+    config.build_observability(args, trainer)
     try:
-        trainer.fit(
-            train_loader, args.num_epochs,
-            eval_loader=eval_loader, start_epoch=start_epoch,
+        config.execute_training(
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
         )
     finally:
         checkpointer.close()

@@ -136,10 +136,10 @@ def main(argv: list[str] | None = None) -> int:
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
+    config.build_observability(args, trainer)
     try:
-        trainer.fit(
-            train_loader, args.num_epochs,
-            eval_loader=eval_loader, start_epoch=start_epoch,
+        config.execute_training(
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
         )
     finally:
         checkpointer.close()

@@ -1,0 +1,179 @@
+"""ctypes bindings for the native (C++) data-loader core.
+
+The reference's input pipeline leans on torch's native DataLoader machinery —
+15 worker processes on the resnet path (``pytorch/resnet/main.py:100``),
+``os.cpu_count()//2`` on the unet path (``pytorch/unet/train.py:92``); see
+``SURVEY.md`` §2b. The TPU-native equivalent is per-host and threaded, not
+per-rank and process-forked: ``native/fastloader.cc`` provides fused
+multithreaded pad+crop+flip+normalize kernels over whole uint8 batches, and
+this module compiles it on first use (g++, cached by source hash) and exposes
+batch transforms with the exact semantics — same RNG draws, same output — as
+the numpy reference transforms in ``data.cifar10``. When no compiler is
+available the numpy path is used transparently, so the framework stays
+pure-Python-runnable (the moral of the reference's gloo fallback,
+``pytorch/hello_world/hello_world.py:44``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+from deeplearning_mpi_tpu.data.cifar10 import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    eval_transform as _np_eval_transform,
+    train_transform as _np_train_transform,
+)
+
+_SOURCE = Path(__file__).resolve().parents[2] / "native" / "fastloader.cc"
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+def _build_library() -> ctypes.CDLL | None:
+    """Compile (once, cached by source hash) and load fastloader.so."""
+    source = _SOURCE.read_text()
+    digest = hashlib.sha256(source.encode()).hexdigest()[:16]
+    cache_dir = Path(
+        os.environ.get("DLMPI_TPU_CACHE", Path.home() / ".cache" / "dlmpi_tpu")
+    )
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    so_path = cache_dir / f"fastloader-{digest}.so"
+    if not so_path.exists():
+        # Build in a tempdir INSIDE the cache dir: os.replace is only atomic
+        # (and only legal) within one filesystem, and /tmp is often a
+        # different one (tmpfs).
+        with tempfile.TemporaryDirectory(dir=cache_dir) as tmp:
+            tmp_so = Path(tmp) / "fastloader.so"
+            cmd = [
+                "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                str(_SOURCE), "-o", str(tmp_so),
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_so, so_path)  # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(str(so_path))
+    lib.fl_version.restype = ctypes.c_int
+    if lib.fl_version() != 1:
+        raise RuntimeError("fastloader ABI version mismatch")
+    return lib
+
+
+def get_library() -> ctypes.CDLL | None:
+    """The loaded native library, or None when unavailable (no g++, etc.)."""
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        if os.environ.get("DLMPI_TPU_NO_NATIVE"):
+            _lib = None
+        else:
+            try:
+                _lib = _build_library()
+            except Exception:
+                _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return get_library() is not None
+
+
+def _scale_bias(mean: np.ndarray, std: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    # u8/255 normalized: (u8/255 - mean)/std  ==  u8 * 1/(255*std) + (-mean/std)
+    scale = (1.0 / (255.0 * std)).astype(np.float32)
+    bias = (-mean / std).astype(np.float32)
+    return scale, bias
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def crop_flip_normalize(
+    images: np.ndarray,
+    ys: np.ndarray,
+    xs: np.ndarray,
+    flips: np.ndarray,
+    *,
+    pad: int = 4,
+    mean: np.ndarray = CIFAR10_MEAN,
+    std: np.ndarray = CIFAR10_STD,
+    max_threads: int | None = None,
+) -> np.ndarray:
+    """Fused RandomCrop(pad)+flip+normalize over a uint8 NHWC batch."""
+    lib = get_library()
+    assert lib is not None, "native library unavailable"
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    out = np.empty((n, h, w, c), np.float32)
+    scale, bias = _scale_bias(np.asarray(mean), np.asarray(std))
+    lib.fl_crop_flip_normalize(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, c,
+        np.ascontiguousarray(ys, np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        np.ascontiguousarray(xs, np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        np.ascontiguousarray(flips, np.uint8).ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        pad, _f32ptr(scale), _f32ptr(bias), _f32ptr(out),
+        max_threads or os.cpu_count() or 1,
+    )
+    return out
+
+
+def normalize(
+    images: np.ndarray,
+    *,
+    mean: np.ndarray = CIFAR10_MEAN,
+    std: np.ndarray = CIFAR10_STD,
+    max_threads: int | None = None,
+) -> np.ndarray:
+    """Per-channel uint8 → normalized float32 (the eval transform)."""
+    lib = get_library()
+    assert lib is not None, "native library unavailable"
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    out = np.empty((n, h, w, c), np.float32)
+    scale, bias = _scale_bias(np.asarray(mean), np.asarray(std))
+    lib.fl_normalize(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n, h, w, c, _f32ptr(scale), _f32ptr(bias), _f32ptr(out),
+        max_threads or os.cpu_count() or 1,
+    )
+    return out
+
+
+def train_transform(
+    batch: dict[str, np.ndarray], rng: np.random.Generator
+) -> dict[str, np.ndarray]:
+    """Native-accelerated CIFAR train transform.
+
+    Draws the SAME random numbers in the SAME order as
+    ``data.cifar10.train_transform`` (offsets, then flips), so swapping the
+    implementations never changes a seeded run — only its host-side speed.
+    Falls back to the numpy transform when the library is unavailable.
+    """
+    if get_library() is None:
+        return _np_train_transform(batch, rng)
+    images = batch["image"]
+    n = images.shape[0]
+    ys = rng.integers(0, 9, size=n)
+    xs = rng.integers(0, 9, size=n)
+    flips = rng.random(n) < 0.5
+    return {
+        "image": crop_flip_normalize(images, ys, xs, flips),
+        "label": batch["label"],
+    }
+
+
+def eval_transform(
+    batch: dict[str, np.ndarray], rng: np.random.Generator | None = None
+) -> dict[str, np.ndarray]:
+    """Native-accelerated normalize-only transform (falls back to numpy)."""
+    if get_library() is None:
+        return _np_eval_transform(batch, rng)
+    return {"image": normalize(batch["image"]), "label": batch["label"]}

@@ -1,0 +1,184 @@
+"""Pipeline-parallel Transformer LM: stages over the mesh ``pipe`` axis.
+
+No reference analog (``SURVEY.md`` §2c: PP absent); this is the workload
+driver for ``parallel.pipeline``. The decomposition is the standard one:
+
+- **embed** (token embedding) and **head** (final norm + logits) run outside
+  the pipeline as ordinary GSPMD-sharded ops on the full batch;
+- the ``num_layers`` transformer blocks split into ``num_stages`` equal
+  stages whose parameters live in ONE stacked pytree (leaf ``[S, ...]``,
+  sharded over ``pipe``), created by ``jax.vmap`` over per-stage inits;
+- activations are split into ``num_microbatches`` and driven through the
+  GPipe ``lax.scan``/``ppermute`` schedule of
+  :func:`~deeplearning_mpi_tpu.parallel.pipeline.pipeline_apply`.
+
+This is a plain Python model class (not ``nn.Module``) exposing the same
+``init(rng, tokens, train=...)`` / ``apply(variables, tokens, ...)`` contract
+the trainer consumes (``train.state.create_train_state``), because the
+pipeline's param layout — one stacked tree instead of per-layer subtrees —
+is easier to state explicitly than to coax out of module transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deeplearning_mpi_tpu.models.transformer import (
+    Block,
+    RMSNorm,
+    TransformerConfig,
+)
+from deeplearning_mpi_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+)
+
+
+class StageBlocks(nn.Module):
+    """One pipeline stage: ``num_blocks`` consecutive transformer blocks.
+
+    ``remat`` checkpoints each block (recompute activations in backward) —
+    composes with pipelining for the standard PP+remat memory recipe.
+    """
+
+    config: TransformerConfig
+    num_blocks: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Any = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.config
+        block_cls = nn.remat(Block) if self.remat else Block
+        for i in range(self.num_blocks):
+            x = block_cls(
+                cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
+                attention_fn=self.attention_fn, name=f"block_{i}",
+            )(x, positions)
+        return x
+
+
+class EmbedHead(nn.Module):
+    """Embedding in, logits out — the non-pipelined ends of the LM."""
+
+    config: TransformerConfig
+    dtype: Any = jnp.bfloat16
+
+    def setup(self) -> None:
+        cfg = self.config
+        self.embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=self.dtype,
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        self.final_norm = RMSNorm()
+        if not cfg.tied_embeddings:
+            self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=self.dtype)
+
+    def encode(self, tokens: jax.Array) -> jax.Array:
+        return self.embed(tokens)
+
+    def decode(self, x: jax.Array) -> jax.Array:
+        x = self.final_norm(x)
+        if self.config.tied_embeddings:
+            logits = self.embed.attend(x.astype(self.dtype))
+        else:
+            logits = self.lm_head(x)
+        return logits.astype(jnp.float32)
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        # Init-only path: touches every param so one ``init`` shapes them all.
+        return self.decode(self.encode(tokens))
+
+
+class PipelinedLM:
+    """GPipe-parallel causal LM with the trainer's init/apply contract."""
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        mesh: jax.sharding.Mesh,
+        *,
+        num_stages: int | None = None,
+        num_microbatches: int = 4,
+        dtype: Any = jnp.bfloat16,
+        attention_fn: Any = None,
+        remat: bool = False,
+    ) -> None:
+        if config.moe_experts:
+            raise NotImplementedError(
+                "PP+MoE in one model is not wired yet (sown aux losses don't "
+                "cross pipeline_apply); use MoE with dp/tp/ep meshes"
+            )
+        self.config = config
+        self.mesh = mesh
+        self.num_stages = num_stages or mesh.shape["pipe"]
+        if self.num_stages != mesh.shape["pipe"] and mesh.shape["pipe"] != 1:
+            raise ValueError(
+                f"num_stages {self.num_stages} != mesh pipe size {mesh.shape['pipe']}"
+            )
+        if config.num_layers % self.num_stages:
+            raise ValueError(
+                f"num_layers {config.num_layers} not divisible into "
+                f"{self.num_stages} stages"
+            )
+        self.num_microbatches = num_microbatches
+        self.dtype = dtype
+        self.stage_mod = StageBlocks(
+            config, config.num_layers // self.num_stages, dtype, attention_fn,
+            remat=remat,
+        )
+        self.embed_head = EmbedHead(config, dtype)
+
+    def init(self, rng: jax.Array, tokens: jax.Array, train: bool = False) -> dict:
+        del train
+        r_eh, r_st = jax.random.split(rng)
+        eh_params = self.embed_head.init(r_eh, tokens)["params"]
+        x = jnp.zeros((1, tokens.shape[-1], self.config.d_model), self.dtype)
+        pos = jnp.zeros((1, tokens.shape[-1]), jnp.int32)
+        stage_params = jax.vmap(
+            lambda key: self.stage_mod.init(key, x, pos)["params"]
+        )(jax.random.split(r_st, self.num_stages))
+        return {"params": {"embed_head": eh_params, "stages": stage_params}}
+
+    def apply(
+        self,
+        variables: dict,
+        tokens: jax.Array,
+        positions: jax.Array | None = None,
+        *,
+        train: bool = False,
+        mutable: Any = (),
+    ):
+        del train
+        params = variables["params"]
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[-1], dtype=jnp.int32)[None, :], tokens.shape
+            )
+        x = self.embed_head.apply(
+            {"params": params["embed_head"]}, tokens, method=EmbedHead.encode
+        )
+        xs = split_microbatches(
+            {"x": x, "pos": positions}, self.num_microbatches
+        )
+
+        def stage_fn(stage_params, acts):
+            y = self.stage_mod.apply(
+                {"params": stage_params}, acts["x"], acts["pos"]
+            )
+            return {"x": y, "pos": acts["pos"]}
+
+        ys = pipeline_apply(stage_fn, params["stages"], xs, mesh=self.mesh)
+        out = merge_microbatches(ys)["x"]
+        logits = self.embed_head.apply(
+            {"params": params["embed_head"]}, out, method=EmbedHead.decode
+        )
+        if mutable:
+            return logits, {}
+        return logits

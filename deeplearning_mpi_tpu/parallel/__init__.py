@@ -9,6 +9,11 @@ matrices over ``model``, sequence parallelism shards the token axis over
 """
 
 from deeplearning_mpi_tpu.parallel.expert_parallel import ep_spec  # noqa: F401
+from deeplearning_mpi_tpu.parallel.pipeline import (  # noqa: F401
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+)
 from deeplearning_mpi_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention_fn,
     ring_attention,

@@ -1,0 +1,183 @@
+"""Pipeline parallelism: GPipe schedule over the mesh ``pipe`` axis.
+
+No reference analog (``SURVEY.md`` §2c: "Pipeline parallel (PP): NO"); here PP
+is first-class. The design is TPU-idiomatic SPMD, not a torch-style stage
+graph with send/recv threads:
+
+- **Stage weights are one stacked pytree** — every leaf carries a leading
+  ``[num_stages, ...]`` dim sharded over ``pipe``, so placement is a sharding
+  annotation like every other axis (and optimizer moments follow for free).
+- **The schedule is a single ``lax.scan``** inside a ``shard_map`` that is
+  *manual only over* ``pipe`` (``axis_names={'pipe'}``): every device runs the
+  same program; at step ``t`` stage 0 ingests microbatch ``t`` while each
+  other stage transforms the activation it received, then all activations
+  shift one stage down the ``lax.ppermute`` ring (collective-permute riding
+  ICI neighbor links). After ``M + S - 1`` steps all ``M`` microbatches have
+  drained. The other mesh axes stay **auto**, so data/tensor/sequence
+  sharding inside a stage is still GSPMD's job — PP composes with dp/tp/sp
+  by construction rather than by a hand-managed communicator hierarchy.
+- **Bubble accounting is explicit**: utilization is ``M / (M + S - 1)``;
+  callers pick ``M`` (microbatches) accordingly. The first/last ``S-1`` steps
+  run stages on zero inputs (the GPipe fill/drain bubble) — wasted FLOPs, not
+  wrong results, since only the last stage's aligned outputs are kept.
+
+Differentiable end-to-end (scan + ppermute + dynamic-update all have
+transposes), so ``jax.grad`` of a loss over :func:`pipeline_apply` yields the
+standard GPipe backward schedule, reversed by AD instead of hand-scheduled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_PIPE
+
+PyTree = Any
+#: stage_fn(stage_params, activations) -> activations (same pytree structure
+#: and shapes — steady-state pipelines need uniform inter-stage types).
+StageFn = Callable[[PyTree, PyTree], PyTree]
+
+
+def split_microbatches(tree: PyTree, num_microbatches: int) -> PyTree:
+    """``[B, ...]`` leaves → ``[M, B/M, ...]`` microbatch-major leaves."""
+
+    def split(x):
+        batch = x.shape[0]
+        if batch % num_microbatches:
+            raise ValueError(
+                f"batch {batch} not divisible by {num_microbatches} microbatches"
+            )
+        return x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+
+    return jax.tree.map(split, tree)
+
+
+def merge_microbatches(tree: PyTree) -> PyTree:
+    """Inverse of :func:`split_microbatches`."""
+    return jax.tree.map(lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), tree)
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    stage_params: PyTree,
+    microbatches: PyTree,
+    *,
+    mesh: Mesh,
+    axis: str = AXIS_PIPE,
+) -> PyTree:
+    """Run ``M`` microbatches through ``S`` pipelined stages (GPipe).
+
+    Args:
+      stage_fn: one stage's computation; applied ``S`` times per microbatch.
+      stage_params: pytree whose every leaf is stacked ``[S, ...]`` and
+        sharded ``P(axis, ...)`` — stage ``i`` owns slice ``i``.
+      microbatches: activations pytree, leaves ``[M, mb, ...]`` (use
+        :func:`split_microbatches`), replicated along ``pipe``.
+      mesh: mesh whose ``axis`` size equals ``S``. The other axes remain
+        auto/GSPMD inside stages.
+
+    Returns the last stage's outputs ``[M, mb, ...]``, replicated over the
+    ``pipe`` axis.
+    """
+    num_stages = mesh.shape[axis]
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if len(leading) != 1:
+        raise ValueError(f"inconsistent stage-stack sizes: {sorted(leading)}")
+    (stack_size,) = leading
+    num_micro = {leaf.shape[0] for leaf in jax.tree.leaves(microbatches)}
+    if len(num_micro) != 1:
+        raise ValueError(f"inconsistent microbatch counts: {sorted(num_micro)}")
+    (num_micro,) = num_micro
+
+    if num_stages == 1:
+        # Degenerate pipeline (pipe axis of size 1): run the whole stage
+        # stack sequentially — scan over stages, map over microbatches. Lets
+        # an S-stage model run unchanged on an unpipelined mesh.
+        def one_stage(xs, p_s):
+            return jax.lax.map(lambda x: stage_fn(p_s, x), xs), None
+
+        out, _ = lax.scan(one_stage, microbatches, stage_params)
+        return out
+
+    if stack_size != num_stages:
+        raise ValueError(
+            f"stage_params leaves must all be stacked [{num_stages}, ...] to "
+            f"match mesh axis '{axis}'; got leading dim {stack_size}"
+        )
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stage_params
+    )
+    x_specs = jax.tree.map(lambda _: P(), microbatches)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, x_specs),
+        out_specs=jax.tree.map(lambda _: P(), microbatches),
+        axis_names={axis},
+        # Partial-manual shard_map requires vma checking (it is also what
+        # verifies the post-psum outputs really are pipe-invariant, honoring
+        # the out_specs P() replication promise).
+        check_vma=True,
+    )
+    def run(params, xs):
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)  # this stage's slice
+        stage = lax.axis_index(axis)
+        last = num_stages - 1
+        # The scan carry becomes pipe-varying inside the loop (each stage holds
+        # a different microbatch), so the zero-initialized carry must be typed
+        # varying too or the carry types won't match under vma checking.
+        varying = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: lax.pcast(a, (axis,), to="varying"), t
+        )
+        state0 = varying(jax.tree.map(lambda x: jnp.zeros_like(x[0]), xs))
+        outs0 = varying(jax.tree.map(jnp.zeros_like, xs))
+        perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        def step(carry, t):
+            state, outs = carry
+            # Stage 0 ingests microbatch t (clamped in the drain phase, where
+            # its output is bubble anyway); others use the activation
+            # ppermuted in from upstream.
+            feed = jax.tree.map(lambda x: x[jnp.minimum(t, num_micro - 1)], xs)
+            x_in = jax.tree.map(
+                lambda f, st: jnp.where(stage == 0, f, st), feed, state
+            )
+            y = stage_fn(params, x_in)
+            # Shift down the ring; stage 0 receives zeros (no sender), the
+            # last stage's send is dropped.
+            y_next = jax.tree.map(lambda a: lax.ppermute(a, axis, perm), y)
+            # The last stage's step-t output is microbatch t-(S-1)'s result.
+            out_idx = t - (num_stages - 1)
+            clamped = jnp.maximum(out_idx, 0)
+            write = jnp.logical_and(stage == last, out_idx >= 0)
+
+            def upd(outs_leaf, y_leaf):
+                cur = lax.dynamic_index_in_dim(outs_leaf, clamped, 0, keepdims=False)
+                return lax.dynamic_update_index_in_dim(
+                    outs_leaf, jnp.where(write, y_leaf, cur), clamped, 0
+                )
+
+            outs = jax.tree.map(upd, outs, y)
+            return (y_next, outs), None
+
+        (_, outs), _ = lax.scan(
+            step, (state0, outs0), jnp.arange(num_micro + num_stages - 1)
+        )
+        # Only the last stage holds real outputs; psum broadcasts them so the
+        # result is replicated along pipe (out_specs P() promise).
+        return jax.tree.map(
+            lambda o: lax.psum(
+                jnp.where(stage == last, o, jnp.zeros_like(o)), axis
+            ),
+            outs,
+        )
+
+    return run(stage_params, microbatches)

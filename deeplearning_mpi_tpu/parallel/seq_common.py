@@ -32,8 +32,19 @@ def with_divisibility_fallback(
     sp = mesh.shape[seq_axis if seq_axis else AXIS_SEQ]
 
     def attention_fn(q, k, v, *, causal: bool = True):
-        if q.shape[0] % dp or q.shape[1] % sp:
+        if q.shape[0] % dp == 0 and q.shape[1] % sp == 0:
+            return sharded(causal)(q, k, v)
+        if q.shape[0] == 1:
+            # model.init's batch-1 param-shaping forward (and batch-1
+            # inference): attention has no params, so the core swap is safe.
             return fallback(q, k, v, causal=causal)
-        return sharded(causal)(q, k, v)
+        # A real training/eval shape the mesh can't divide must not silently
+        # lose its sequence sharding (dense attention at long context is an
+        # OOM or an order-of-magnitude regression) — fail with the fix.
+        raise ValueError(
+            f"attention input [batch={q.shape[0]}, seq={q.shape[1]}] not "
+            f"divisible by mesh (data={dp}, seq={sp}); pad the sequence "
+            f"length / batch or change the mesh axes"
+        )
 
     return attention_fn

@@ -60,30 +60,70 @@ def tp_spec(
     return P()
 
 
+#: Param-path substring marking pipeline-stage-stacked leaves ``[S, ...]``
+#: (``models.pipeline_lm.PipelinedLM`` puts all stage params under "stages").
+STAGE_MARKER = "stages"
+
+
 def param_spec(
     leaf: jax.Array,
     *,
     tp: int,
     ep: int = 1,
+    pp: int = 1,
     axis: str = AXIS_MODEL,
     min_size: int = 1024,
     path: str = "",
 ) -> P:
-    """Combined EP+TP rule for one leaf: stacked expert weights (path contains
-    ``experts``, ndim≥3) get the expert rule; everything else the TP rule."""
+    """Combined PP+EP+TP rule for one leaf.
+
+    - path contains ``stages`` → the leading dim is a pipeline-stage stack:
+      sharded over ``pipe`` (when divisible) and excluded from the trailing
+      megatron rules;
+    - path contains ``experts`` (ndim≥3 after any stage dim) → expert rule:
+      stack dim over ``expert``, megatron row/col on the matmul dims;
+    - otherwise the plain TP rule on the trailing dims.
+    """
     from deeplearning_mpi_tpu.parallel import expert_parallel
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_PIPE
 
-    if expert_parallel.is_expert_leaf(path, leaf):
-        return expert_parallel.ep_spec(leaf, ep, tp, path=path, model_axis=axis)
-    return tp_spec(leaf, tp, axis=axis, min_size=min_size, path=path)
+    start = 0
+    pipe_axis: str | None = None
+    if STAGE_MARKER in path and leaf.ndim >= 1:
+        if pp > 1 and leaf.shape[0] % pp == 0:
+            pipe_axis = AXIS_PIPE
+        start = 1  # leading dim is the stage stack either way
+    if expert_parallel.EXPERT_MARKER in path and leaf.ndim - start >= 3:
+        inner = expert_parallel.ep_spec(
+            jax.ShapeDtypeStruct(leaf.shape[start:], leaf.dtype),
+            ep, tp, path=path, model_axis=axis,
+        )
+    elif leaf.ndim - start >= 2 and leaf.size >= min_size:
+        dims: list[str | None] = [None] * (leaf.ndim - start)
+        if tp > 1:
+            if any(marker in path for marker in ROW_PARALLEL_MARKERS):
+                if leaf.shape[-2] % tp == 0:
+                    dims[-2] = axis
+            elif leaf.shape[-1] % tp == 0:
+                dims[-1] = axis
+        inner = P(*dims)
+    else:
+        inner = P()
+    full = ([pipe_axis] if start else []) + list(inner)
+    # Canonicalize: all-None (replicated) specs compare equal to P().
+    if not any(a is not None for a in full):
+        return P()
+    return P(*full)
 
 
-def _map_with_spec(fn, params: PyTree, tp: int, ep: int, axis: str, min_size: int) -> PyTree:
+def _map_with_spec(
+    fn, params: PyTree, tp: int, ep: int, pp: int, axis: str, min_size: int
+) -> PyTree:
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: fn(
             leaf,
             param_spec(
-                leaf, tp=tp, ep=ep, axis=axis, min_size=min_size,
+                leaf, tp=tp, ep=ep, pp=pp, axis=axis, min_size=min_size,
                 path=jax.tree_util.keystr(path),
             ),
         ),
@@ -99,12 +139,13 @@ def infer_tp_param_sharding(
     min_size: int = 1024,
 ) -> PyTree:
     """NamedSharding pytree for ``params`` (or any params-shaped pytree)."""
-    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT, AXIS_PIPE
 
     tp = mesh.shape[axis]
     ep = mesh.shape.get(AXIS_EXPERT, 1)
+    pp = mesh.shape.get(AXIS_PIPE, 1)
     return _map_with_spec(
-        lambda leaf, spec: NamedSharding(mesh, spec), params, tp, ep, axis, min_size
+        lambda leaf, spec: NamedSharding(mesh, spec), params, tp, ep, pp, axis, min_size
     )
 
 
@@ -116,11 +157,12 @@ def shard_state(state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL) -> PyTr
     counter replicate. With all axes size 1 this degrades to full replication
     — exactly pure DP.
     """
-    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT, AXIS_PIPE
 
     tp = mesh.shape[tp_axis]
     ep = mesh.shape.get(AXIS_EXPERT, 1)
+    pp = mesh.shape.get(AXIS_PIPE, 1)
     return _map_with_spec(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        state, tp, ep, tp_axis, 1024,
+        state, tp, ep, pp, tp_axis, 1024,
     )

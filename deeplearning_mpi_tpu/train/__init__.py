@@ -7,3 +7,9 @@ from deeplearning_mpi_tpu.train.trainer import (  # noqa: F401
     make_train_step,
 )
 from deeplearning_mpi_tpu.train.checkpoint import Checkpointer  # noqa: F401
+from deeplearning_mpi_tpu.train.resilience import (  # noqa: F401
+    Heartbeat,
+    TrainingFailure,
+    preflight,
+    run_with_auto_resume,
+)

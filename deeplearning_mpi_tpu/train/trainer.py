@@ -217,6 +217,9 @@ class Trainer:
         checkpointer: Any = None,
         eval_every: int = 10,  # "every 10 epochs" (resnet/main.py:136, unet/train.py:213)
         aux_weight: float = 0.0,  # MoE load-balance loss weight
+        profiler: Any = None,  # utils.profiling.Profiler; traces a few hot steps
+        heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
+        time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
     ) -> None:
         self.state = state
         self.task = task
@@ -224,9 +227,13 @@ class Trainer:
         self.logger = logger
         self.checkpointer = checkpointer
         self.eval_every = eval_every
+        self.profiler = profiler
+        self.heartbeat = heartbeat
+        self.time_steps = time_steps
         self.train_step = make_train_step(task, aux_weight=aux_weight)
         self.eval_step = make_eval_step(task)
         self.history: list[dict[str, float]] = []
+        self._profiled = False
 
     def _log(self, msg: str) -> None:
         if self.logger is not None:
@@ -234,14 +241,30 @@ class Trainer:
         elif jax.process_index() == 0:
             print(msg)
 
+    #: step window traced when a profiler is attached (skips compile steps).
+    PROFILE_STEPS = (3, 6)
+
     def run_epoch(self, loader: Any, epoch: int) -> dict[str, float]:
         """One training epoch; returns mean loss + timing stats."""
+        from deeplearning_mpi_tpu.utils.profiling import StepTimer
+
         t0 = time.perf_counter()
         loss_sum = finite_sum = None
         n_batches = 0
         images = 0
+        timer = StepTimer(sync_every=25) if self.time_steps else None
         for batch in prefetch(loader.epoch(epoch)):
+            if self.profiler is not None and not self._profiled:
+                if n_batches == self.PROFILE_STEPS[0]:
+                    self.profiler.start()
+                elif n_batches == self.PROFILE_STEPS[1]:
+                    self.profiler.stop()
+                    self._profiled = True
             self.state, metrics = self.train_step(self.state, batch)
+            if timer is not None:
+                timer.tick(metrics["loss"])
+            if self.heartbeat is not None:
+                self.heartbeat.progress = {"epoch": epoch, "step_in_epoch": n_batches}
             # Accumulate on device, excluding non-finite batches from the mean
             # (the reference `continue`s before accumulating epoch loss,
             # pytorch/unet/train.py:186-188) — one NaN batch must not poison
@@ -268,6 +291,8 @@ class Trainer:
             "duration_s": duration,
             "images_per_s": images / duration,
         }
+        if timer is not None:
+            stats.update(timer.summary(items_per_step=images // max(n_batches, 1)))
         if n_finite < n_batches:
             self._log(
                 f"Epoch {epoch}: skipped {n_batches - int(n_finite)} non-finite "
@@ -345,6 +370,8 @@ class Trainer:
             )
         if self.checkpointer is not None and last_saved != final_epoch:
             self.checkpointer.save(self.state, epoch=final_epoch)
+        if self.profiler is not None:
+            self.profiler.stop()  # idempotent; closes a trace left open by a short epoch
         return self.history
 
     def place_state(self) -> None:

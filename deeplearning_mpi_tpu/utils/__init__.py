@@ -1,3 +1,9 @@
-"""Cross-cutting utilities: run logging, config/flag system."""
+"""Cross-cutting utilities: run logging, profiling, config/flag system."""
 
 from deeplearning_mpi_tpu.utils.logging import RunLogger  # noqa: F401
+from deeplearning_mpi_tpu.utils.profiling import (  # noqa: F401
+    Profiler,
+    StepTimer,
+    measure_collective_latency,
+    nan_debug_mode,
+)

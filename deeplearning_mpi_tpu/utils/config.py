@@ -58,6 +58,9 @@ def add_training_flags(
     group.add_argument("--log_dir", default="logs")
     group.add_argument("--eval_every", type=int, default=10, help="epochs between evals/checkpoints (reference cadence: resnet/main.py:136)")
     group.add_argument("--dtype", default="float32", choices=("float32", "bfloat16"), help="compute dtype (params stay float32)")
+    group.add_argument("--profile_dir", default=None, help="write a jax.profiler trace of a few hot steps here (TensorBoard/Perfetto)")
+    group.add_argument("--max_restarts", type=int, default=0, help="auto-resume from the latest checkpoint this many times on failure (0 = fail immediately; the reference's analog is manual restart with --resume)")
+    group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
 
 
 def setup_runtime(args: argparse.Namespace):
@@ -86,4 +89,65 @@ def setup_runtime(args: argparse.Namespace):
             model=args.tp,
         )
     )
+    if getattr(args, "debug_nans", False):
+        from deeplearning_mpi_tpu.utils.profiling import nan_debug_mode
+
+        nan_debug_mode(True)
     return topo, mesh
+
+
+def build_observability(args: argparse.Namespace, trainer) -> None:
+    """Attach profiler + heartbeat from the shared flags to a Trainer."""
+    from deeplearning_mpi_tpu.train.resilience import Heartbeat
+    from deeplearning_mpi_tpu.utils.profiling import Profiler
+
+    if getattr(args, "profile_dir", None):
+        trainer.profiler = Profiler(args.profile_dir)
+    if getattr(args, "log_dir", None):
+        import pathlib
+
+        trainer.heartbeat = Heartbeat(
+            pathlib.Path(args.log_dir) / "heartbeat.json"
+        ).start()
+
+
+def execute_training(
+    trainer,
+    checkpointer,
+    args: argparse.Namespace,
+    train_loader,
+    eval_loader,
+    start_epoch: int,
+):
+    """Shared CLI tail: fit with optional auto-resume, then clean teardown.
+
+    ``--max_restarts N`` turns crashes into restore-latest-checkpoint-and-
+    continue (see ``train.resilience.run_with_auto_resume``); the reference's
+    only recovery is a manual re-launch with ``--resume``
+    (``pytorch/unet/train.py:342-345``).
+    """
+    from deeplearning_mpi_tpu.train.resilience import run_with_auto_resume
+
+    def fit(restart_epoch: int):
+        start = max(start_epoch, restart_epoch)
+        if restart_epoch > max(start_epoch, 0):
+            # Crash restart: reload the latest full checkpoint.
+            trainer.state = checkpointer.restore(trainer.state)
+            trainer.place_state()
+        return trainer.fit(
+            train_loader, args.num_epochs,
+            eval_loader=eval_loader, start_epoch=start,
+        )
+
+    try:
+        if args.max_restarts > 0 and checkpointer is not None:
+            return run_with_auto_resume(
+                fit, checkpointer,
+                max_restarts=args.max_restarts, logger=trainer.logger,
+            )
+        return fit(start_epoch)
+    finally:
+        if trainer.heartbeat is not None:
+            trainer.heartbeat.stop()
+        if trainer.profiler is not None:
+            trainer.profiler.stop()  # finalize a trace left open by a crash

@@ -1,0 +1,133 @@
+// Native data-loader core: fused batch augmentation kernels.
+//
+// TPU-native equivalent of the reference's native input machinery — torch's
+// C-accelerated DataLoader worker pool (num_workers=15 at
+// pytorch/resnet/main.py:100, os.cpu_count()//2 at pytorch/unet/train.py:92;
+// SURVEY.md §2b "DataLoader worker pool"). Instead of N worker *processes*
+// each running Python transforms, the per-host pipeline calls these fused
+// multithreaded kernels on whole uint8 batches: one pass over memory does
+// pad+crop+flip+normalize and writes float32 ready for jax.device_put, so the
+// host side keeps TPU chips fed without Python-loop or pickle overhead.
+//
+// Built at first use by deeplearning_mpi_tpu/data/native.py via g++ (see
+// _build_library there); driven through ctypes. Everything here is plain C
+// ABI: raw pointers + ints, no Python.h dependency.
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(first, last) over [0, n) chunks on up to max_threads threads.
+void parallel_for(int n, int max_threads, void (*fn)(int, int, void*), void* ctx) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int threads = std::max(1, std::min({max_threads, hw, n}));
+  if (threads == 1) {
+    fn(0, n, ctx);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  int chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int first = t * chunk;
+    int last = std::min(n, first + chunk);
+    if (first >= last) break;
+    pool.emplace_back(fn, first, last, ctx);
+  }
+  for (auto& th : pool) th.join();
+}
+
+struct CropCtx {
+  const uint8_t* in;   // [N, H, W, C]
+  const int32_t* ys;   // [N] crop offsets in the padded image
+  const int32_t* xs;   // [N]
+  const uint8_t* flip; // [N] 1 = horizontal flip
+  const float* scale;  // [C] = 1 / (255 * std)
+  const float* bias;   // [C] = -mean / std
+  float* out;          // [N, H, W, C]
+  int h, w, c, pad;
+};
+
+// One image: crop an h×w window at (y-pad, x-pad) out of the zero-padded
+// input, optional horizontal flip, then out = u8/255 * (1/std) - mean/std,
+// all in a single pass (no padded intermediate is ever materialized).
+void crop_flip_normalize_range(int first, int last, void* vctx) {
+  const CropCtx& k = *static_cast<CropCtx*>(vctx);
+  const int h = k.h, w = k.w, c = k.c, pad = k.pad;
+  for (int i = first; i < last; ++i) {
+    const uint8_t* img = k.in + static_cast<int64_t>(i) * h * w * c;
+    float* dst = k.out + static_cast<int64_t>(i) * h * w * c;
+    const int y0 = k.ys[i] - pad;  // top-left of the window in source coords
+    const int x0 = k.xs[i] - pad;
+    const bool flip = k.flip[i] != 0;
+    for (int y = 0; y < h; ++y) {
+      const int sy = y0 + y;
+      const bool row_in = sy >= 0 && sy < h;
+      for (int x = 0; x < w; ++x) {
+        const int dx = flip ? (w - 1 - x) : x;
+        float* px = dst + (static_cast<int64_t>(y) * w + dx) * c;
+        const int sx = x0 + x;
+        if (row_in && sx >= 0 && sx < w) {
+          const uint8_t* sp = img + (static_cast<int64_t>(sy) * w + sx) * c;
+          for (int ch = 0; ch < c; ++ch)
+            px[ch] = static_cast<float>(sp[ch]) * k.scale[ch] + k.bias[ch];
+        } else {
+          for (int ch = 0; ch < c; ++ch)  // zero-padding ⇒ normalized zero
+            px[ch] = k.bias[ch];
+        }
+      }
+    }
+  }
+}
+
+struct NormCtx {
+  const uint8_t* in;
+  const float* scale;
+  const float* bias;
+  float* out;
+  int64_t pixels;  // h*w per image
+  int c;
+};
+
+void normalize_range(int first, int last, void* vctx) {
+  const NormCtx& k = *static_cast<NormCtx*>(vctx);
+  for (int i = first; i < last; ++i) {
+    const uint8_t* src = k.in + i * k.pixels * k.c;
+    float* dst = k.out + i * k.pixels * k.c;
+    for (int64_t p = 0; p < k.pixels; ++p)
+      for (int ch = 0; ch < k.c; ++ch, ++src, ++dst)
+        *dst = static_cast<float>(*src) * k.scale[ch] + k.bias[ch];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// RandomCrop(pad)+RandomHorizontalFlip+normalize, fused. Offsets ys/xs are in
+// [0, 2*pad] (position of the crop window in the padded image), matching the
+// reference's torchvision RandomCrop(32, padding=4) semantics
+// (pytorch/resnet/main.py:82-87).
+void fl_crop_flip_normalize(const uint8_t* in, int n, int h, int w, int c,
+                            const int32_t* ys, const int32_t* xs,
+                            const uint8_t* flip, int pad, const float* scale,
+                            const float* bias, float* out, int max_threads) {
+  CropCtx ctx{in, ys, xs, flip, scale, bias, out, h, w, c, pad};
+  parallel_for(n, max_threads, crop_flip_normalize_range, &ctx);
+}
+
+// out = u8 * scale + bias (per channel) — the eval-path transform
+// (pytorch/resnet/main.py:88).
+void fl_normalize(const uint8_t* in, int n, int h, int w, int c,
+                  const float* scale, const float* bias, float* out,
+                  int max_threads) {
+  NormCtx ctx{in, scale, bias, out, static_cast<int64_t>(h) * w, c};
+  parallel_for(n, max_threads, normalize_range, &ctx);
+}
+
+int fl_version(void) { return 1; }
+
+}  // extern "C"

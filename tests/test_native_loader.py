@@ -1,0 +1,76 @@
+"""Native (C++) data-loader core vs the numpy reference transforms."""
+
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.data import native
+from deeplearning_mpi_tpu.data.cifar10 import eval_transform as np_eval
+from deeplearning_mpi_tpu.data.cifar10 import train_transform as np_train
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library unavailable (no g++?)"
+)
+
+
+def _batch(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.integers(0, 256, (n, 32, 32, 3)).astype(np.uint8),
+        "label": rng.integers(0, 10, n).astype(np.int32),
+    }
+
+
+class TestNativeTransforms:
+    def test_train_transform_matches_numpy_bitwise_rng(self):
+        """Same seeded rng ⇒ the native and numpy train transforms draw the
+        same crops/flips and produce (near-)identical float batches."""
+        batch = _batch()
+        out_np = np_train(dict(batch), np.random.default_rng(123))
+        out_nat = native.train_transform(dict(batch), np.random.default_rng(123))
+        np.testing.assert_allclose(
+            out_nat["image"], out_np["image"], rtol=0, atol=1e-6
+        )
+        np.testing.assert_array_equal(out_nat["label"], out_np["label"])
+
+    def test_eval_transform_matches_numpy(self):
+        batch = _batch(seed=1)
+        out_np = np_eval(dict(batch))
+        out_nat = native.eval_transform(dict(batch))
+        np.testing.assert_allclose(
+            out_nat["image"], out_np["image"], rtol=0, atol=1e-6
+        )
+
+    def test_zero_padding_region_is_normalized_zero(self):
+        """A crop fully in the pad border must equal normalize(0)."""
+        images = np.full((1, 32, 32, 3), 255, np.uint8)
+        out = native.crop_flip_normalize(
+            images,
+            ys=np.array([0]), xs=np.array([0]), flips=np.array([0]),
+        )
+        # window at (0,0) in padded coords: first 4 rows/cols come from the
+        # zero border.
+        from deeplearning_mpi_tpu.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+        expected_border = (-CIFAR10_MEAN / CIFAR10_STD).astype(np.float32)
+        np.testing.assert_allclose(out[0, 0, 0], expected_border, atol=1e-6)
+        expected_body = ((1.0 - CIFAR10_MEAN) / CIFAR10_STD).astype(np.float32)
+        np.testing.assert_allclose(out[0, 10, 10], expected_body, atol=1e-6)
+
+    def test_flip_reverses_width(self):
+        rng = np.random.default_rng(2)
+        images = rng.integers(0, 256, (2, 32, 32, 3)).astype(np.uint8)
+        base = native.crop_flip_normalize(
+            images, ys=np.array([4, 4]), xs=np.array([4, 4]),
+            flips=np.array([0, 0]),
+        )
+        flipped = native.crop_flip_normalize(
+            images, ys=np.array([4, 4]), xs=np.array([4, 4]),
+            flips=np.array([1, 1]),
+        )
+        np.testing.assert_allclose(flipped, base[:, :, ::-1], atol=1e-6)
+
+    def test_threaded_matches_single_thread(self):
+        batch = _batch(n=64, seed=3)["image"]
+        a = native.normalize(batch, max_threads=1)
+        b = native.normalize(batch, max_threads=8)
+        np.testing.assert_array_equal(a, b)

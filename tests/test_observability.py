@@ -1,0 +1,153 @@
+"""Profiling, step timing, collective latency, and resilience subsystems."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.train.resilience import (
+    Heartbeat,
+    TrainingFailure,
+    preflight,
+    run_with_auto_resume,
+)
+from deeplearning_mpi_tpu.utils.profiling import (
+    Profiler,
+    StepTimer,
+    measure_collective_latency,
+)
+
+
+class TestStepTimer:
+    def test_times_steps_and_summarizes(self):
+        timer = StepTimer(sync_every=4)
+        x = jnp.zeros((8, 8))
+        step = jax.jit(lambda a: a @ a + 1.0)
+        out = step(x)
+        timer.tick(out)  # window start
+        for _ in range(8):
+            out = step(out)
+            timer.tick(out)
+        s = timer.summary(items_per_step=32)
+        assert s["steps_timed"] == 8
+        assert s["step_ms_p50"] > 0
+        assert s["items_per_s"] > 0
+        assert s["items_per_s_per_device"] == pytest.approx(
+            s["items_per_s"] / jax.device_count()
+        )
+
+    def test_empty_summary(self):
+        assert StepTimer().summary() == {}
+
+    def test_short_run_flushes_partial_window(self):
+        """Fewer steps than sync_every must still produce stats (summary
+        flushes the pending window)."""
+        timer = StepTimer(sync_every=10)
+        x = jnp.ones((4, 4))
+        step = jax.jit(lambda a: a + 1.0)
+        out = step(x)
+        timer.tick(out)
+        for _ in range(3):
+            out = step(out)
+            timer.tick(out)
+        s = timer.summary()
+        assert s["steps_timed"] == 3
+        assert s["step_ms_p50"] > 0
+
+
+class TestProfiler:
+    def test_trace_writes_files(self, tmp_path):
+        prof = Profiler(tmp_path / "trace")
+        step = jax.jit(lambda a: a * 2.0)
+        out = prof.trace_steps(step, jnp.ones((4,)), num_steps=2)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+        files = list((tmp_path / "trace").rglob("*"))
+        assert files, "profiler trace produced no files"
+
+    def test_disabled_profiler_is_noop(self):
+        prof = Profiler(None)
+        with prof:
+            pass  # no trace dir: start/stop must be no-ops
+
+
+class TestCollectiveLatency:
+    def test_measures_allreduce_on_mesh(self, mesh):
+        out = measure_collective_latency(mesh, num_floats=1 << 12, trials=3)
+        assert out["axis_size"] == 8
+        assert out["all_reduce_ms_min"] > 0
+        assert out["bus_gbps"] > 0
+
+
+class TestAutoResume:
+    def test_retries_from_checkpoint_then_succeeds(self):
+        calls = []
+
+        class FakeCkpt:
+            def latest_epoch(self):
+                return 3
+
+        def fit(start_epoch):
+            calls.append(start_epoch)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            return "done"
+
+        out = run_with_auto_resume(
+            fit, FakeCkpt(), max_restarts=3, restart_delay_s=0.0,
+            logger=type("L", (), {"log": staticmethod(lambda m: None)})(),
+        )
+        assert out == "done"
+        assert calls == [0, 4, 4]  # restarts resume at checkpoint epoch + 1
+
+    def test_exhausted_budget_raises_loudly(self):
+        class FakeCkpt:
+            def latest_epoch(self):
+                return None
+
+        def fit(start_epoch):
+            raise RuntimeError("persistent failure")
+
+        with pytest.raises(TrainingFailure):
+            run_with_auto_resume(
+                fit, FakeCkpt(), max_restarts=1, restart_delay_s=0.0,
+                logger=type("L", (), {"log": staticmethod(lambda m: None)})(),
+            )
+
+
+class TestHeartbeat:
+    def test_writes_progress_json(self, tmp_path):
+        path = tmp_path / "hb.json"
+        hb = Heartbeat(path, interval_s=0.05)
+        with hb:
+            hb.progress = {"epoch": 2, "step": 17}
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if path.exists() and "step" in path.read_text():
+                    break
+                time.sleep(0.05)
+        payload = json.loads(path.read_text())
+        assert payload["step"] == 17
+        assert payload["process_index"] == 0
+
+    def test_stop_is_idempotent(self, tmp_path):
+        hb = Heartbeat(tmp_path / "hb.json", interval_s=0.05).start()
+        hb.stop()
+        hb.stop()
+
+
+class TestPreflight:
+    def test_missing_data_dir_fails_with_message(self, tmp_path):
+        with pytest.raises(SystemExit, match="data directory"):
+            preflight(data_dir=str(tmp_path / "nope"))
+
+    def test_creates_model_and_log_dirs(self, tmp_path):
+        preflight(model_dir=str(tmp_path / "m"), log_dir=str(tmp_path / "l"))
+        assert (tmp_path / "m").is_dir() and (tmp_path / "l").is_dir()
+
+    def test_batch_divisibility(self, mesh):
+        with pytest.raises(SystemExit, match="divisible"):
+            preflight(global_batch_size=12, mesh=mesh)
+        preflight(global_batch_size=16, mesh=mesh)  # ok

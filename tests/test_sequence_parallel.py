@@ -71,6 +71,25 @@ def test_ulysses_rejects_indivisible_heads():
         make_ulysses_attention_fn(mesh)(q, k, v, causal=True)
 
 
+def test_indivisible_training_shape_raises_not_silent_dense():
+    """A real batch whose seq length the mesh can't divide must fail loudly —
+    silently dropping to dense attention would be an OOM at long context."""
+    mesh = seq_mesh(seq=4, data=2)
+    q, k, v = qkv(B=4, S=30)  # 30 % 4 != 0
+    with pytest.raises(ValueError, match="not\\s+divisible"):
+        make_ring_attention_fn(mesh)(q, k, v, causal=True)
+
+
+def test_batch_one_init_falls_back_to_dense():
+    """model.init's batch-1 forward takes the dense core instead of failing
+    shard_map's divisibility check (attention has no params to shape)."""
+    mesh = seq_mesh(seq=4, data=2)
+    q, k, v = qkv(B=1, S=32)
+    out = make_ring_attention_fn(mesh)(q, k, v, causal=True)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_transformer_with_ring_attention_matches_dense():
     """Full TransformerLM forward with sequence-parallel attention injected ==
     the dense-attention model, bitwise-same params (the attention_fn injection
