@@ -66,6 +66,13 @@ def main(argv: list[str] | None = None) -> int:
 
     topo, mesh = config.setup_runtime(args)
 
+    from deeplearning_mpi_tpu.train.resilience import preflight
+
+    preflight(
+        model_dir=args.model_dir, log_dir=args.log_dir,
+        global_batch_size=args.batch_size, mesh=mesh,
+    )
+
     import jax
     import jax.numpy as jnp
 
@@ -134,10 +141,14 @@ def main(argv: list[str] | None = None) -> int:
             config=cfg, dtype=dtype, attention_fn=attention_fn, remat=args.remat,
         )
     tx = build_optimizer("adam", args.learning_rate, clip_norm=1.0)
-    state = create_train_state(
-        model, jax.random.key(args.random_seed),
-        jnp.zeros((1, args.seq_len), jnp.int32), tx,
-    )
+
+    def state_factory():
+        return create_train_state(
+            model, jax.random.key(args.random_seed),
+            jnp.zeros((1, args.seq_len), jnp.int32), tx,
+        )
+
+    state = state_factory()
 
     checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
     start_epoch = 0
@@ -159,7 +170,8 @@ def main(argv: list[str] | None = None) -> int:
     config.build_observability(args, trainer)
     try:
         config.execute_training(
-            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
+            state_factory=state_factory,
         )
     finally:
         checkpointer.close()

@@ -51,6 +51,14 @@ def main(argv: list[str] | None = None) -> int:
 
     topo, mesh = config.setup_runtime(args)
 
+    from deeplearning_mpi_tpu.train.resilience import preflight
+
+    preflight(
+        data_dir=None if args.synthetic else args.data_dir,
+        model_dir=args.model_dir, log_dir=args.log_dir,
+        global_batch_size=args.batch_size, mesh=mesh,
+    )
+
     import jax
     import jax.numpy as jnp
 
@@ -101,9 +109,12 @@ def main(argv: list[str] | None = None) -> int:
         "sgd", args.learning_rate,
         momentum=args.momentum, weight_decay=args.weight_decay,
     )
-    state = create_train_state(
-        model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx
-    )
+    def state_factory():
+        return create_train_state(
+            model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx
+        )
+
+    state = state_factory()
 
     checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
     start_epoch = 0
@@ -124,7 +135,8 @@ def main(argv: list[str] | None = None) -> int:
     config.build_observability(args, trainer)
     try:
         config.execute_training(
-            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
+            state_factory=state_factory,
         )
     finally:
         checkpointer.close()

@@ -45,6 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="train on synthetic ellipse-segmentation data")
     parser.add_argument("--train_samples", type=int, default=256)
     parser.add_argument("--image_size", type=int, default=64, help="synthetic image size")
+    parser.add_argument("--volumetric", action="store_true",
+                        help="3-D UNet on [D,H,W,1] volumes (BASELINE.md config #5; "
+                        "synthetic ellipsoid data — the reference is 2-D only)")
+    parser.add_argument("--remat", action="store_true",
+                        help="checkpoint each DoubleConv (recompute in backward) — "
+                        "the 3-D-volume memory recipe with --dtype bfloat16")
     return parser
 
 
@@ -54,6 +60,14 @@ def main(argv: list[str] | None = None) -> int:
     from deeplearning_mpi_tpu.utils import config
 
     topo, mesh = config.setup_runtime(args)
+
+    from deeplearning_mpi_tpu.train.resilience import preflight
+
+    preflight(
+        data_dir=None if (args.synthetic or args.volumetric) else args.data_dir,
+        model_dir=args.model_dir, log_dir=args.log_dir,
+        global_batch_size=args.batch_size, mesh=mesh,
+    )
 
     import jax
     import jax.numpy as jnp
@@ -73,7 +87,14 @@ def main(argv: list[str] | None = None) -> int:
     logger.log_system_information()
     logger.log_hyperparameters(vars(args))
 
-    if args.synthetic:
+    if args.volumetric:
+        from deeplearning_mpi_tpu.data.segmentation import SyntheticVolumesDataset
+
+        full = SyntheticVolumesDataset(
+            args.train_samples, size=args.image_size, seed=args.random_seed
+        )
+        sample_hw = (args.image_size,) * 3
+    elif args.synthetic:
         full = SyntheticShapesDataset(
             args.train_samples, size=args.image_size, seed=args.random_seed
         )
@@ -110,15 +131,22 @@ def main(argv: list[str] | None = None) -> int:
         _Subset(val_idx), args.batch_size, mesh, shuffle=False, drop_last=False
     )
 
+    channels = 1 if args.volumetric else 3
     model = UNet(
         out_classes=1, bilinear=args.bilinear,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        spatial_dims=3 if args.volumetric else 2,
+        remat=args.remat,
     )
     tx = build_optimizer("adam", args.learning_rate, clip_norm=args.clip_norm)
-    state = create_train_state(
-        model, jax.random.key(args.random_seed),
-        jnp.zeros((1, *sample_hw, 3)), tx,
-    )
+
+    def state_factory():
+        return create_train_state(
+            model, jax.random.key(args.random_seed),
+            jnp.zeros((1, *sample_hw, channels)), tx,
+        )
+
+    state = state_factory()
 
     checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
     start_epoch = 0
@@ -139,7 +167,8 @@ def main(argv: list[str] | None = None) -> int:
     config.build_observability(args, trainer)
     try:
         config.execute_training(
-            trainer, checkpointer, args, train_loader, eval_loader, start_epoch
+            trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
+            state_factory=state_factory,
         )
     finally:
         checkpointer.close()

@@ -133,3 +133,34 @@ class SyntheticShapesDataset:
         image = rng.normal(0.3, 0.08, (s, s, 3)).astype(np.float32)
         image += mask[..., None] * rng.uniform(0.3, 0.5)
         return {"image": np.clip(image, 0, 1), "mask": mask}
+
+
+class SyntheticVolumesDataset:
+    """Deterministic random-ellipsoid 3-D masks — the volumetric analog of
+    :class:`SyntheticShapesDataset`, feeding the 3-D UNet (BASELINE.md config
+    ladder #5; no reference analog — its data is 2-D microscopy,
+    ``pytorch/unet/data/README.md:1-9``). Examples:
+    ``{"image": [D, H, W, 1] float32, "mask": [D, H, W] float32}``.
+    """
+
+    def __init__(self, n: int = 32, *, size: int = 32, seed: int = 0) -> None:
+        self.size = size
+        rng = np.random.default_rng(seed)
+        self.item_seeds = rng.integers(0, 2**31, size=n)
+
+    def __len__(self) -> int:
+        return len(self.item_seeds)
+
+    def __getitem__(self, index: int) -> dict[str, np.ndarray]:
+        rng = np.random.default_rng(self.item_seeds[index])
+        s = self.size
+        cz, cy, cx = rng.uniform(0.25 * s, 0.75 * s, 3)
+        rz, ry, rx = rng.uniform(0.12 * s, 0.25 * s, 3)
+        zz, yy, xx = np.mgrid[0:s, 0:s, 0:s]
+        mask = (
+            ((zz - cz) / rz) ** 2 + ((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2
+            <= 1
+        ).astype(np.float32)
+        image = rng.normal(0.3, 0.08, (s, s, s, 1)).astype(np.float32)
+        image += mask[..., None] * rng.uniform(0.3, 0.5)
+        return {"image": np.clip(image, 0, 1), "mask": mask}

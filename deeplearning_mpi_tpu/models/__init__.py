@@ -41,10 +41,12 @@ def get_model(name: str, **kwargs: Any) -> nn.Module:
         return _RESNETS[name](**kwargs)
     if name == "unet":
         return UNet(**kwargs)
+    if name == "unet3d":
+        return UNet(spatial_dims=3, **kwargs)
     if name == "transformer":
         config = kwargs.pop("config", None) or TransformerConfig()
         return TransformerLM(config=config, **kwargs)
     raise ValueError(
         f"unknown model '{name}'; choose from "
-        f"{sorted(_RESNETS) + ['unet', 'transformer']}"
+        f"{sorted(_RESNETS) + ['unet', 'unet3d', 'transformer']}"
     )

@@ -1,4 +1,4 @@
-"""2-D UNet in Flax linen, NHWC, bf16-ready.
+"""UNet (2-D and 3-D) in Flax linen, channels-last, bf16-ready.
 
 From-scratch TPU-native build of the reference's UNet
 (``pytorch/unet/model.py:5-81``): ``DoubleConv`` = 2×[Conv3×3 (SAME) + BN +
@@ -16,6 +16,18 @@ Deviations from the reference, on purpose:
 - BatchNorm uses local per-replica statistics by default — DDP parity
   (SURVEY.md §2c) — with opt-in cross-replica sync via
   ``bn_cross_replica_axis``.
+
+Beyond-parity extensions (BASELINE.md config ladder #5 "3-D UNet with mixed
+precision + gradient checkpointing" — the reference is 2-D fp32 only):
+- ``spatial_dims=3`` builds the volumetric variant (NDHWC) with the same
+  channel schedule — every kernel/pool/upsample becomes its 3-D analog;
+- ``remat=True`` checkpoints each DoubleConv (recompute in backward) — with
+  bf16 ``dtype`` this is the standard memory recipe for 3-D volumes.
+
+Checkpoint compatibility note: blocks carry explicit names
+(``down_i``/``bottleneck``/``up_i``) so remat and non-remat configs share one
+param tree; checkpoints saved by the earlier auto-named (``DoubleConv_N``)
+revision of this module do not restore into it.
 """
 
 from __future__ import annotations
@@ -31,7 +43,11 @@ ModuleDef = Any
 
 
 class DoubleConv(nn.Module):
-    """2×[Conv3×3 SAME + BN + ReLU] — ``pytorch/unet/model.py:5-18``."""
+    """2×[Conv3ᵈ SAME + BN + ReLU] — ``pytorch/unet/model.py:5-18``.
+
+    The conv partial carries the kernel size, so the same block serves 2-D
+    and 3-D UNets.
+    """
 
     filters: int
     conv: ModuleDef
@@ -40,7 +56,7 @@ class DoubleConv(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         for _ in range(2):
-            x = self.conv(self.filters, (3, 3))(x)
+            x = self.conv(self.filters)(x)
             x = self.norm()(x)
             x = nn.relu(x)
         return x
@@ -62,11 +78,20 @@ class UNet(nn.Module):
     bn_cross_replica_axis: str | None = None
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
+    spatial_dims: int = 2  # 2 = NHWC images, 3 = NDHWC volumes
+    remat: bool = False  # checkpoint each DoubleConv (memory for recompute)
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
+        d = self.spatial_dims
+        if x.ndim != d + 2:
+            raise ValueError(
+                f"expected [batch, {'x'.join('S' * d)}, channels] input for "
+                f"spatial_dims={d}; got shape {x.shape}"
+            )
         conv = functools.partial(
             nn.Conv,
+            kernel_size=(3,) * d,
             use_bias=False,
             dtype=self.dtype,
             param_dtype=jnp.float32,
@@ -81,35 +106,43 @@ class UNet(nn.Module):
             param_dtype=jnp.float32,
             axis_name=self.bn_cross_replica_axis,
         )
-        double = functools.partial(DoubleConv, conv=conv, norm=norm)
+        double_cls = nn.remat(DoubleConv) if self.remat else DoubleConv
+        double = functools.partial(double_cls, conv=conv, norm=norm)
 
         x = x.astype(self.dtype)
         skips = []
-        for f in self.features:
-            x = double(f)(x)  # pre-pool activation is the skip (model.py:27-30)
+        # Explicit names: under nn.remat the auto-generated class-based names
+        # change (CheckpointDoubleConv_*), which would silently fork the param
+        # tree between remat and non-remat configs.
+        for i, f in enumerate(self.features):
+            x = double(f, name=f"down_{i}")(x)  # pre-pool output is the skip (model.py:27-30)
             skips.append(x)
-            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            x = nn.max_pool(x, (2,) * d, strides=(2,) * d)
 
-        x = double(self.features[-1] * 2)(x)  # bottleneck (model.py:61)
+        x = double(self.features[-1] * 2, name="bottleneck")(x)  # model.py:61
 
-        for f, skip in zip(reversed(self.features), reversed(skips)):
+        for i, (f, skip) in enumerate(zip(reversed(self.features), reversed(skips))):
             if self.bilinear:
-                b, h, w, c = x.shape
-                x = jax.image.resize(x, (b, h * 2, w * 2, c), method="bilinear")
-                x = conv(f, (1, 1))(x)
+                shape = (
+                    x.shape[0],
+                    *(s * 2 for s in x.shape[1:-1]),
+                    x.shape[-1],
+                )
+                x = jax.image.resize(x, shape, method="linear")
+                x = conv(f, kernel_size=(1,) * d)(x)
             else:
                 x = nn.ConvTranspose(
                     f,
-                    (2, 2),
-                    strides=(2, 2),
+                    (2,) * d,
+                    strides=(2,) * d,
                     dtype=self.dtype,
                     param_dtype=jnp.float32,
                 )(x)
             x = jnp.concatenate([skip, x], axis=-1)  # concat on channels (model.py:46)
-            x = double(f)(x)
+            x = double(f, name=f"up_{i}")(x)
 
         # 1×1 head, with bias (no BN follows) — model.py:68,80.
         x = nn.Conv(
-            self.out_classes, (1, 1), dtype=self.dtype, param_dtype=jnp.float32
+            self.out_classes, (1,) * d, dtype=self.dtype, param_dtype=jnp.float32
         )(x)
         return x.astype(jnp.float32)

@@ -93,22 +93,13 @@ def param_spec(
         if pp > 1 and leaf.shape[0] % pp == 0:
             pipe_axis = AXIS_PIPE
         start = 1  # leading dim is the stage stack either way
-    if expert_parallel.EXPERT_MARKER in path and leaf.ndim - start >= 3:
-        inner = expert_parallel.ep_spec(
-            jax.ShapeDtypeStruct(leaf.shape[start:], leaf.dtype),
-            ep, tp, path=path, model_axis=axis,
-        )
-    elif leaf.ndim - start >= 2 and leaf.size >= min_size:
-        dims: list[str | None] = [None] * (leaf.ndim - start)
-        if tp > 1:
-            if any(marker in path for marker in ROW_PARALLEL_MARKERS):
-                if leaf.shape[-2] % tp == 0:
-                    dims[-2] = axis
-            elif leaf.shape[-1] % tp == 0:
-                dims[-1] = axis
-        inner = P(*dims)
+    # Rules below see the per-stage slice (leading stack dim stripped), so
+    # e.g. min_size thresholds what one stage actually holds.
+    slice_ = jax.ShapeDtypeStruct(leaf.shape[start:], leaf.dtype)
+    if expert_parallel.EXPERT_MARKER in path and slice_.ndim >= 3:
+        inner = expert_parallel.ep_spec(slice_, ep, tp, path=path, model_axis=axis)
     else:
-        inner = P()
+        inner = tp_spec(slice_, tp, axis=axis, min_size=min_size, path=path)
     full = ([pipe_axis] if start else []) + list(inner)
     # Canonicalize: all-None (replicated) specs compare equal to P().
     if not any(a is not None for a in full):

@@ -118,25 +118,38 @@ def execute_training(
     train_loader,
     eval_loader,
     start_epoch: int,
+    state_factory=None,
 ):
     """Shared CLI tail: fit with optional auto-resume, then clean teardown.
 
     ``--max_restarts N`` turns crashes into restore-latest-checkpoint-and-
     continue (see ``train.resilience.run_with_auto_resume``); the reference's
     only recovery is a manual re-launch with ``--resume``
-    (``pytorch/unet/train.py:342-345``).
+    (``pytorch/unet/train.py:342-345``). ``state_factory`` rebuilds a fresh
+    initial TrainState for restarts that happen before the first checkpoint —
+    required because the jitted step donates the state's buffers, so a crash
+    mid-step leaves ``trainer.state`` deleted and unusable.
     """
     from deeplearning_mpi_tpu.train.resilience import run_with_auto_resume
 
+    attempts = 0
+
     def fit(restart_epoch: int):
-        start = max(start_epoch, restart_epoch)
-        if restart_epoch > max(start_epoch, 0):
-            # Crash restart: reload the latest full checkpoint.
-            trainer.state = checkpointer.restore(trainer.state)
+        nonlocal attempts
+        attempts += 1
+        if attempts > 1:
+            # Crash restart: the previous state's buffers may be donated/
+            # deleted — ALWAYS rebuild, from the latest checkpoint when one
+            # exists, else from a fresh init.
+            if checkpointer.latest_epoch() is not None:
+                template = state_factory() if state_factory else trainer.state
+                trainer.state = checkpointer.restore(template)
+            elif state_factory is not None:
+                trainer.state = state_factory()
             trainer.place_state()
         return trainer.fit(
             train_loader, args.num_epochs,
-            eval_loader=eval_loader, start_epoch=start,
+            eval_loader=eval_loader, start_epoch=max(start_epoch, restart_epoch),
         )
 
     try:

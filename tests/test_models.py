@@ -114,3 +114,78 @@ class TestRegistry:
     def test_unknown_model(self):
         with pytest.raises(ValueError, match="unknown model"):
             get_model("vgg16")
+
+
+class TestUNet3D:
+    """Volumetric UNet (BASELINE.md config ladder #5 — beyond-parity)."""
+
+    def test_forward_shape(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning_mpi_tpu.models import get_model
+
+        model = get_model("unet3d", out_classes=1, features=(4, 8), dtype=jnp.float32)
+        x = jnp.zeros((1, 16, 16, 16, 1))
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (1, 16, 16, 16, 1)
+
+    def test_remat_matches_plain(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning_mpi_tpu.models import UNet
+
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(1, 8, 8, 8, 1)), jnp.float32
+        )
+        plain = UNet(out_classes=1, features=(4,), spatial_dims=3, dtype=jnp.float32)
+        remat = UNet(
+            out_classes=1, features=(4,), spatial_dims=3, dtype=jnp.float32,
+            remat=True,
+        )
+        variables = plain.init(jax.random.key(0), x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(plain.apply(variables, x, train=False)),
+            np.asarray(remat.apply(variables, x, train=False)),
+            atol=1e-5,
+        )
+
+    def test_wrong_rank_input_raises(self):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        from deeplearning_mpi_tpu.models import UNet
+
+        model = UNet(out_classes=1, features=(4,), spatial_dims=3)
+        with pytest.raises(ValueError, match="spatial_dims=3"):
+            model.init(jax.random.key(0), jnp.zeros((1, 8, 8, 3)), train=False)
+
+    def test_trains_on_synthetic_volumes(self, mesh):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning_mpi_tpu.data import ShardedLoader
+        from deeplearning_mpi_tpu.data.segmentation import SyntheticVolumesDataset
+        from deeplearning_mpi_tpu.models import UNet
+        from deeplearning_mpi_tpu.train import Trainer, create_train_state
+        from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+        model = UNet(out_classes=1, features=(4, 8), spatial_dims=3, dtype=jnp.float32)
+        tx = build_optimizer("adam", 3e-3, clip_norm=1.0)
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16, 16, 16, 1)), tx
+        )
+        trainer = Trainer(state, "segmentation", mesh)
+        trainer.place_state()
+        loader = ShardedLoader(
+            SyntheticVolumesDataset(16, size=16, seed=0), 8, mesh,
+            shuffle=True, seed=0,
+        )
+        stats = [trainer.run_epoch(loader, e) for e in range(2)]
+        assert np.isfinite(stats[0]["loss"])
+        assert stats[-1]["loss"] < stats[0]["loss"]

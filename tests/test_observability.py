@@ -151,3 +151,76 @@ class TestPreflight:
         with pytest.raises(SystemExit, match="divisible"):
             preflight(global_batch_size=12, mesh=mesh)
         preflight(global_batch_size=16, mesh=mesh)  # ok
+
+
+class TestExecuteTraining:
+    """The CLI tail: donated-state rebuild on pre-checkpoint crashes."""
+
+    def _make(self, fail_times, latest=None):
+        import argparse
+
+        calls = {"fit": 0, "factory": 0, "restore": 0, "placed": 0}
+
+        class FakeTrainer:
+            heartbeat = None
+            profiler = None
+            logger = type("L", (), {"log": staticmethod(lambda m: None)})()
+            state = "initial"
+
+            def place_state(self):
+                calls["placed"] += 1
+
+            def fit(self, loader, num_epochs, eval_loader=None, start_epoch=0):
+                calls["fit"] += 1
+                if calls["fit"] <= fail_times:
+                    raise RuntimeError("crash")
+                return "done"
+
+        class FakeCkpt:
+            def latest_epoch(self):
+                return latest
+
+            def restore(self, template):
+                calls["restore"] += 1
+                return "restored"
+
+        def state_factory():
+            calls["factory"] += 1
+            return "fresh"
+
+        args = argparse.Namespace(num_epochs=5, max_restarts=2)
+        return FakeTrainer(), FakeCkpt(), args, state_factory, calls
+
+    def test_precheckpoint_crash_rebuilds_fresh_state(self):
+        from deeplearning_mpi_tpu.utils.config import execute_training
+
+        trainer, ckpt, args, factory, calls = self._make(fail_times=1, latest=None)
+        # Patch out the restart delay to keep the test fast.
+        import deeplearning_mpi_tpu.train.resilience as res
+        from unittest import mock
+
+        with mock.patch.object(res.time, "sleep"):
+            out = execute_training(
+                trainer, ckpt, args, None, None, 0, state_factory=factory
+            )
+        assert out == "done"
+        # crash before any checkpoint: a FRESH state must be built (the old
+        # one's buffers were donated), never the deleted one reused
+        assert calls["factory"] == 1
+        assert trainer.state == "fresh"
+        assert calls["placed"] == 1
+
+    def test_postcheckpoint_crash_restores_latest(self):
+        import deeplearning_mpi_tpu.train.resilience as res
+        from unittest import mock
+
+        from deeplearning_mpi_tpu.utils.config import execute_training
+
+        trainer, ckpt, args, factory, calls = self._make(fail_times=1, latest=3)
+        with mock.patch.object(res.time, "sleep"):
+            out = execute_training(
+                trainer, ckpt, args, None, None, 0, state_factory=factory
+            )
+        assert out == "done"
+        assert calls["restore"] == 1
+        assert trainer.state == "restored"
