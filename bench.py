@@ -50,15 +50,18 @@ def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
     labels = jax.random.randint(rng, (batch_size,), 0, 10)
     batch = {"image": images, "label": labels}
 
-    # Warmup: compile + 2 steps.
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    # Warmup: compile + 2 steps. host_sync fetches the scalar loss — see its
+    # docstring for why block_until_ready is not a reliable sync here.
     for _ in range(3):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    host_sync(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    host_sync(metrics["loss"])  # the whole step chain must complete to produce this
     dt = time.perf_counter() - t0
 
     n_chips = jax.device_count()

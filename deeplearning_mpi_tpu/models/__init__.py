@@ -42,7 +42,8 @@ def get_model(name: str, **kwargs: Any) -> nn.Module:
     if name == "unet":
         return UNet(**kwargs)
     if name == "unet3d":
-        return UNet(spatial_dims=3, **kwargs)
+        kwargs.setdefault("spatial_dims", 3)
+        return UNet(**kwargs)
     if name == "transformer":
         config = kwargs.pop("config", None) or TransformerConfig()
         return TransformerLM(config=config, **kwargs)

@@ -132,6 +132,11 @@ def execute_training(
     """
     from deeplearning_mpi_tpu.train.resilience import run_with_auto_resume
 
+    if args.max_restarts > 0 and state_factory is None:
+        # Without a factory, a pre-checkpoint crash would retry on the
+        # donated/deleted state and burn every restart on buffer errors.
+        raise ValueError("--max_restarts requires a state_factory")
+
     attempts = 0
 
     def fit(restart_epoch: int):

@@ -12,9 +12,10 @@ This module supplies both halves TPU-natively:
   capture on a running job.
 - :class:`StepTimer` measures per-step wall time **correctly under JAX's
   async dispatch** (a naive ``time.time()`` around ``train_step`` measures
-  Python dispatch, not device compute — the device runs ahead), by
-  ``block_until_ready`` on a sampling cadence. From it come images/sec/chip
-  and step-latency percentiles — the BASELINE.md primary metrics.
+  Python dispatch, not device compute — the device runs ahead), by a
+  device→host fetch (:func:`host_sync`) on a sampling cadence. From it come
+  images/sec/chip and step-latency percentiles — the BASELINE.md primary
+  metrics.
 - :func:`measure_collective_latency` times an N-byte gradient-style
   all-reduce over the mesh's ``data`` axis — the "DDP all-reduce step
   latency" number the baseline asks for, measured the same way on CPU
@@ -31,6 +32,22 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def host_sync(x: Any) -> None:
+    """Force device completion by fetching one leaf to the host.
+
+    ``jax.block_until_ready`` can return before remote execution finishes on
+    tunneled platforms (observed on the axon TPU tunnel: a chained-matmul
+    "benchmark" reported 14 PFLOPS on one v5e until a real device→host fetch
+    was inserted; with the fetch it reports a physical ~140 TFLOPS). A D2H
+    copy cannot complete before the producing computation has, so fetching is
+    the reliable sync. Pass a SMALL output (a scalar loss) — the fetch copies
+    it.
+    """
+    leaves = jax.tree.leaves(x)
+    if leaves:
+        np.asarray(leaves[0])
 
 
 class Profiler:
@@ -93,7 +110,7 @@ class StepTimer:
         self._last_output: Any = None
 
     def _close_window(self) -> None:
-        jax.block_until_ready(self._last_output)
+        host_sync(self._last_output)
         now = time.perf_counter()
         per_step = (now - self._window_start) / self._pending
         self.durations_s.extend([per_step] * self._pending)
@@ -103,7 +120,7 @@ class StepTimer:
     def tick(self, step_output: Any) -> None:
         if self._window_start is None:
             # First call: sync so the window starts from an idle device.
-            jax.block_until_ready(step_output)
+            host_sync(step_output)
             self._window_start = time.perf_counter()
             return
         self._pending += 1
@@ -159,22 +176,26 @@ def measure_collective_latency(
 
     @jax.jit
     def allreduce(x):
-        return jax.shard_map(
+        # Reduce to one scalar so the timing fetch is tiny. Summing the WHOLE
+        # result (not a slice) keeps the full-buffer collective live — a
+        # sliced dependency could let XLA shrink the psum to 8 floats.
+        reduced = jax.shard_map(
             lambda s: jax.lax.psum(s, axis),
             mesh=mesh,
             in_specs=P(axis), out_specs=P(),
             check_vma=False,
         )(x)
+        return jnp.sum(reduced)
 
     x = jax.device_put(
         jnp.ones((n * num_floats,), jnp.float32),
         NamedSharding(mesh, P(axis)),
     )
-    jax.block_until_ready(allreduce(x))  # compile + warm
+    host_sync(allreduce(x))  # compile + warm
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        jax.block_until_ready(allreduce(x))
+        host_sync(allreduce(x))
         times.append(time.perf_counter() - t0)
     mean = sum(times) / len(times)
     # Ring all-reduce moves 2*(n-1)/n of the buffer per device.
