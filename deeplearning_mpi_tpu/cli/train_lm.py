@@ -146,6 +146,7 @@ def main(argv: list[str] | None = None) -> int:
         return create_train_state(
             model, jax.random.key(args.random_seed),
             jnp.zeros((1, args.seq_len), jnp.int32), tx,
+            mesh=mesh, zero=args.zero,
         )
 
     state = state_factory()
@@ -165,6 +166,7 @@ def main(argv: list[str] | None = None) -> int:
         state, "lm", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
         aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
+        zero=args.zero,
     )
     trainer.place_state()
     config.build_observability(args, trainer)

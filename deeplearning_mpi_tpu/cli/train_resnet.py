@@ -112,6 +112,7 @@ def main(argv: list[str] | None = None) -> int:
     def state_factory():
         return create_train_state(
             model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx
+            mesh=mesh, zero=args.zero,
         )
 
     state = state_factory()
@@ -130,6 +131,7 @@ def main(argv: list[str] | None = None) -> int:
     trainer = Trainer(
         state, "classification", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
+        zero=args.zero,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
     config.build_observability(args, trainer)

@@ -144,6 +144,7 @@ def main(argv: list[str] | None = None) -> int:
         return create_train_state(
             model, jax.random.key(args.random_seed),
             jnp.zeros((1, *sample_hw, channels)), tx,
+            mesh=mesh, zero=args.zero,
         )
 
     state = state_factory()
@@ -162,6 +163,7 @@ def main(argv: list[str] | None = None) -> int:
     trainer = Trainer(
         state, "segmentation", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
+        zero=args.zero,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
     config.build_observability(args, trainer)

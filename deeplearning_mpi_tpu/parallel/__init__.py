@@ -19,6 +19,7 @@ from deeplearning_mpi_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
 )
 from deeplearning_mpi_tpu.parallel.tensor_parallel import (  # noqa: F401
+    infer_state_sharding,
     infer_tp_param_sharding,
     shard_state,
 )

@@ -140,20 +140,55 @@ def infer_tp_param_sharding(
     )
 
 
-def shard_state(state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL) -> PyTree:
-    """Place a whole TrainState on the mesh under the EP+TP rules.
+def infer_state_sharding(
+    state: PyTree,
+    mesh: Mesh,
+    *,
+    tp_axis: str = AXIS_MODEL,
+    zero: bool = False,
+    min_size: int = 1024,
+) -> PyTree:
+    """NamedSharding pytree for a whole TrainState under the EP+TP(+ZeRO)
+    rules — the single source of truth for state placement.
 
-    Kernels and their optimizer moments shard over ``model``, stacked expert
-    weights over ``expert`` (+``model``); biases, BN statistics, and the step
-    counter replicate. With all axes size 1 this degrades to full replication
-    — exactly pure DP.
+    Works on concrete arrays or abstract leaves (``jax.eval_shape`` output),
+    so it can supply ``out_shardings`` for the state-init jit — states whose
+    replicated form would not fit one device's HBM are then born sharded
+    instead of being materialized replicated and re-placed.
     """
-    from deeplearning_mpi_tpu.runtime.mesh import AXIS_EXPERT, AXIS_PIPE
+    from deeplearning_mpi_tpu.parallel.zero import zero1_spec
+    from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_EXPERT, AXIS_PIPE
 
     tp = mesh.shape[tp_axis]
     ep = mesh.shape.get(AXIS_EXPERT, 1)
     pp = mesh.shape.get(AXIS_PIPE, 1)
-    return _map_with_spec(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
-        state, tp, ep, pp, tp_axis, 1024,
+    # zero1_spec shards onto the single 'data' axis, so the divisibility
+    # factor must be that axis's size (not a product over data_axes()).
+    dp = mesh.shape.get(AXIS_DATA, 1) if zero else 1
+
+    def spec_for(path, leaf):
+        spec = param_spec(
+            leaf, tp=tp, ep=ep, pp=pp, axis=tp_axis, min_size=min_size, path=path
+        )
+        if zero and ".opt_state" in path:
+            spec = zero1_spec(leaf, spec, dp)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(jax.tree_util.keystr(path), leaf), state
     )
+
+
+def shard_state(
+    state: PyTree, mesh: Mesh, *, tp_axis: str = AXIS_MODEL, zero: bool = False
+) -> PyTree:
+    """Place a whole TrainState on the mesh under the EP+TP(+ZeRO) rules.
+
+    Kernels and their optimizer moments shard over ``model``, stacked expert
+    weights over ``expert`` (+``model``); biases, BN statistics, and the step
+    counter replicate. With all axes size 1 this degrades to full replication
+    — exactly pure DP. ``zero=True`` additionally shards optimizer-state
+    leaves over ``data`` (ZeRO-1; see ``parallel.zero``).
+    """
+    shardings = infer_state_sharding(state, mesh, tp_axis=tp_axis, zero=zero)
+    return jax.tree.map(jax.device_put, state, shardings)

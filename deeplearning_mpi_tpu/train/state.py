@@ -38,6 +38,9 @@ def create_train_state(
     rng: jax.Array,
     sample_input: jax.Array,
     tx: optax.GradientTransformation,
+    *,
+    mesh: Any = None,
+    zero: bool = False,
 ) -> TrainState:
     """Initialize model variables and optimizer state.
 
@@ -46,6 +49,11 @@ def create_train_state(
     process initializes from the same seed and the arrays are replicated by
     sharding — same effect, no broadcast step (cf. ``set_random_seeds``,
     ``resnet/main.py:26-33``).
+
+    With ``mesh`` given, the init jit carries ``out_shardings`` from the
+    TP/EP/PP(+ZeRO when ``zero=True``) placement rules, so the state is
+    *born sharded* — a state whose replicated form exceeds one device's HBM
+    (the very case ZeRO exists for) never materializes replicated.
     """
     def build(rng: jax.Array) -> TrainState:
         variables = model.init(rng, sample_input, train=False)
@@ -62,4 +70,11 @@ def create_train_state(
     # One compiled program instead of hundreds of eager dispatches — on real
     # TPU, un-jitted init pays a per-op compile+transfer round-trip and can
     # take minutes for a ResNet-50.
-    return jax.jit(build)(rng)
+    if mesh is None:
+        return jax.jit(build)(rng)
+
+    from deeplearning_mpi_tpu.parallel import infer_state_sharding
+
+    abstract = jax.eval_shape(build, rng)
+    shardings = infer_state_sharding(abstract, mesh, zero=zero)
+    return jax.jit(build, out_shardings=shardings)(rng)

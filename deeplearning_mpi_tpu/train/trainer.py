@@ -220,6 +220,7 @@ class Trainer:
         profiler: Any = None,  # utils.profiling.Profiler; traces a few hot steps
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
+        zero: bool = False,  # ZeRO-1: shard optimizer state over the data axis
     ) -> None:
         self.state = state
         self.task = task
@@ -230,6 +231,7 @@ class Trainer:
         self.profiler = profiler
         self.heartbeat = heartbeat
         self.time_steps = time_steps
+        self.zero = zero
         self.train_step = make_train_step(task, aux_weight=aux_weight)
         self.eval_step = make_eval_step(task)
         self.history: list[dict[str, float]] = []
@@ -375,15 +377,17 @@ class Trainer:
         return self.history
 
     def place_state(self) -> None:
-        """Place the state on the mesh under the TP sharding rule.
+        """Place the state on the mesh under the TP/EP/PP (+ZeRO-1) rules.
 
-        With a ``model`` axis of size 1 this is full replication — pure DP,
-        the DDP-parity configuration. With tp > 1, kernels and their optimizer
-        moments shard over ``model`` (megatron-style TP via GSPMD).
+        With all non-data axes size 1 and ``zero=False`` this is full
+        replication — pure DP, the DDP-parity configuration. With tp > 1,
+        kernels and their optimizer moments shard over ``model``
+        (megatron-style TP via GSPMD); ``zero=True`` additionally shards
+        optimizer state over ``data``.
         """
         from deeplearning_mpi_tpu.parallel import shard_state
 
-        self.state = shard_state(self.state, self.mesh)
+        self.state = shard_state(self.state, self.mesh, zero=self.zero)
 
     # Back-compat alias for the DP-only name.
     replicate_state = place_state

@@ -27,6 +27,7 @@ def add_topology_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--pp", type=int, default=1, help="pipeline-parallel degree (pipe axis)")
     group.add_argument("--sp", type=int, default=1, help="sequence-parallel degree (seq axis; ring/ulysses attention)")
     group.add_argument("--ep", type=int, default=1, help="expert-parallel degree (expert axis; MoE)")
+    group.add_argument("--zero", action="store_true", help="ZeRO-1: shard optimizer state over the data axis (moments drop to 1/dp per device)")
 
 
 def add_training_flags(
