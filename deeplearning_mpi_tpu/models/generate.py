@@ -1,0 +1,106 @@
+"""Autoregressive text generation with a KV cache — the LM inference path.
+
+No reference analog (the reference trains and evaluates CNNs only); a
+complete LM workload needs generation, and the TPU-idiomatic shape is ONE
+jitted ``lax.scan`` over token positions: prefill and decode are the same
+per-position body (prompt tokens are fed, generated tokens are sampled), the
+KV cache is the scan carry, and every shape is static — XLA compiles one
+program for the whole generation regardless of prompt length.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning_mpi_tpu.models.transformer import TransformerLM
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Sample token ids from ``[B, V]`` logits.
+
+    ``temperature == 0`` is greedy argmax; ``top_k > 0`` restricts sampling
+    to the k highest-probability tokens (static decisions — part of the
+    compiled program, not traced values).
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
+
+    Returns ``[B, P + max_new_tokens]`` (prompt included). The decode-mode
+    twin of ``model`` shares its params; the cache sized ``P + max_new`` is
+    created by a decode-mode ``init`` and threaded through the scan.
+    """
+    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+
+    # Decode-mode init with the full-length input shapes the cache buffers;
+    # params from init are discarded (we use the trained ones).
+    cache = decode_model.init(
+        jax.random.key(0), jnp.zeros((batch, total), jnp.int32)
+    )["cache"]
+
+    def body(carry, i):
+        cache, prev_tok, rng = carry
+        # Prefill phase feeds the prompt; afterwards, the previous sample.
+        prompt_tok = lax.dynamic_index_in_dim(
+            prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
+        )
+        tok = jnp.where(i < prompt_len, prompt_tok, prev_tok)
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((batch, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        next_tok = sample_logits(
+            logits[:, 0], sub, temperature=temperature, top_k=top_k
+        )
+        return (mutated["cache"], next_tok, rng), tok
+
+    init = (cache, jnp.zeros((batch,), jnp.int32), rng)
+    (_, _, _), consumed = lax.scan(body, init, jnp.arange(total))
+    # consumed[i] is the token fed at position i: prompt tokens for i < P,
+    # and for i >= P the sample produced at step i-1 — i.e. exactly the
+    # generated continuation. (The final step's sample would be the token
+    # for position `total`, outside the window, and is discarded.)
+    return jnp.moveaxis(consumed, 0, 1)  # [B, total]
+
+
+def generate_jit(model: TransformerLM, **static_kwargs: Any):
+    """Jitted generate with static sampling knobs:
+    ``fn(params, prompt, rng) -> [B, P + max_new]``."""
+
+    def fn(params, prompt, rng):
+        return generate(model, params, prompt, rng=rng, **static_kwargs)
+
+    return jax.jit(fn)

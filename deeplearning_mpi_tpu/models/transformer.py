@@ -29,8 +29,9 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from deeplearning_mpi_tpu.ops.attention import dense_attention
+from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
 
 # (q, k, v [B,S,H,D], causal=...) -> context [B,S,H,D]
 AttentionFn = Callable[..., jax.Array]
@@ -66,12 +67,20 @@ class RMSNorm(nn.Module):
 
 
 class Attention(nn.Module):
-    """Multi-head self-attention with RoPE and a pluggable attention core."""
+    """Multi-head self-attention with RoPE and a pluggable attention core.
+
+    ``decode=True`` switches to single-token autoregressive mode: K/V for
+    each new token are appended to a ``cache`` collection
+    (``cached_key``/``cached_value`` ``[B, max_len, H, D]`` + a scalar
+    ``cache_index``), and the query attends over the filled prefix — O(S)
+    per generated token instead of re-running the O(S²) full sequence.
+    """
 
     num_heads: int
     head_dim: int
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array, *, causal: bool = True) -> jax.Array:
@@ -86,11 +95,63 @@ class Attention(nn.Module):
         v = dense("v_proj")(x).reshape(shape)
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
-        attn = self.attention_fn or dense_attention
-        ctx = attn(q, k, v, causal=causal)
+        if self.decode:
+            ctx = self._cached_attention(q, k, v)
+        else:
+            attn = self.attention_fn or dense_attention
+            ctx = attn(q, k, v, causal=causal)
         ctx = ctx.reshape(batch, seq, features)
         # "out_proj" triggers tensor_parallel's row-parallel (input-dim) rule.
         return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="out_proj")(ctx)
+
+    def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        """One decode step: append K/V to the cache, attend over the prefix.
+
+        The cache must be initialized by an ``init(..., decode=True)`` /
+        first apply with a ``[B, max_len, ...]``-shaped input establishing
+        ``max_len``; decode steps then feed one token at a time (seq == 1).
+        """
+        batch, seq, heads, head_dim = q.shape
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((batch, seq, heads, head_dim), self.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((batch, seq, heads, head_dim), self.dtype),
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            return jnp.zeros_like(q)
+        max_len = cached_k.value.shape[1]
+        if seq != 1:
+            raise ValueError(
+                f"decode mode feeds one token per step, got seq={seq}; "
+                "initialize the cache with the full [B, max_len] shape"
+            )
+        i = index.value
+        new_k = lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.dtype), (0, i, 0, 0)
+        )
+        new_v = lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.dtype), (0, i, 0, 0)
+        )
+        cached_k.value, cached_v.value = new_k, new_v
+        index.value = i + 1
+        # Scores over the whole buffer, future positions masked out.
+        scale = head_dim**-0.5
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, new_k, preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # [B, H, 1, max_len]
+        valid = jnp.arange(max_len)[None, None, None, :] <= i
+        scores = jnp.where(valid, scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, new_v)
 
 
 class SwiGLU(nn.Module):
@@ -116,12 +177,13 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
     mlp_cls: type[nn.Module] | None = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         x = x + Attention(
             self.num_heads, self.head_dim, self.dtype,
-            attention_fn=self.attention_fn, name="attn",
+            attention_fn=self.attention_fn, decode=self.decode, name="attn",
         )(RMSNorm(name="attn_norm")(x), positions)
         mlp = (self.mlp_cls or SwiGLU)(self.d_ff, self.dtype, name="mlp")
         return x + mlp(RMSNorm(name="mlp_norm")(x))
@@ -175,6 +237,7 @@ class TransformerLM(nn.Module):
     attention_fn: AttentionFn | None = None
     remat: bool = False
     mlp_cls: type[nn.Module] | None = None
+    decode: bool = False  # KV-cached single-token autoregressive mode
 
     @nn.compact
     def __call__(
@@ -210,7 +273,7 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
                 attention_fn=self.attention_fn, mlp_cls=mlp_cls,
-                name=f"layer_{i}",
+                decode=self.decode, name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)
         if cfg.tied_embeddings:
