@@ -111,7 +111,7 @@ def main(argv: list[str] | None = None) -> int:
     )
     def state_factory():
         return create_train_state(
-            model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx
+            model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx,
             mesh=mesh, zero=args.zero,
         )
 
