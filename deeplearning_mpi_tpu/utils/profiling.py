@@ -171,8 +171,11 @@ def measure_collective_latency(
 
     n = mesh.shape[axis]
     if n == 1:
+        # bus_gbps is 0.0, not inf: no bytes cross any link on a 1-device
+        # axis, and inf would serialize as invalid JSON downstream (bench.py
+        # prints this dict).
         return {"all_reduce_ms_mean": 0.0, "all_reduce_ms_min": 0.0,
-                "axis_size": 1.0, "bus_gbps": float("inf")}
+                "axis_size": 1.0, "bus_gbps": 0.0}
 
     @jax.jit
     def allreduce(x):
