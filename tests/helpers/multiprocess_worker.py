@@ -1,0 +1,158 @@
+"""Worker process for the multi-process rendezvous tests.
+
+Each OS process runs this script with the torchrun-equivalent topology flags
+(``--coordinator/--num_processes/--process_id`` — the contract
+``runtime/bootstrap.py`` ingests, mirroring torchrun's
+MASTER_ADDR/WORLD_SIZE/RANK, ``pytorch/unet/run.sh:100-104``). The process:
+
+1. rendezvouses via ``bootstrap.init`` → ``jax.distributed.initialize``
+   (the branch no single-process test can reach);
+2. runs the hello_world transport checks over the multi-process CPU mesh —
+   the moral equivalent of the reference's N-Gloo-process smoke test
+   (``pytorch/hello_world/hello_world.py:33-44``);
+3. trains 2 DP steps of a small ResNet on synthetic data through
+   ``ShardedLoader`` (whose ``local_row_ranges`` now sees
+   ``process_count > 1`` — each process supplies only its own rows);
+4. saves a multi-host orbax checkpoint (every process participates,
+   process 0 coordinates) and restores it;
+5. writes param/metric digests to ``--out_dir/proc<i>.json`` for the parent
+   test to cross-check bit-identity across processes.
+
+Env setup (JAX_PLATFORMS/XLA_FLAGS/gloo collectives) must happen before jax
+import — done at the top of main().
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num_processes", type=int, required=True)
+    ap.add_argument("--process_id", type=int, required=True)
+    ap.add_argument("--local_devices", type=int, default=2)
+    ap.add_argument("--out_dir", required=True)
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.local_devices}"
+    )
+    import jax
+
+    # Cross-process CPU collectives need a real transport: gloo — the exact
+    # backend the reference's CPU fallback uses (pytorch/hello_world/
+    # hello_world.py:44). ICI fills this role on real TPU slices.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    import numpy as np
+
+    from deeplearning_mpi_tpu.runtime import bootstrap
+    from deeplearning_mpi_tpu.runtime.hello_world import run_hello_world
+    from deeplearning_mpi_tpu.runtime.mesh import create_mesh
+
+    topo = bootstrap.init(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+        platform="cpu",
+    )
+    assert topo.num_processes == args.num_processes, topo
+    assert topo.process_id == args.process_id, topo
+    assert topo.global_device_count == args.num_processes * args.local_devices
+
+    result: dict = {"topology": {
+        "process_id": topo.process_id,
+        "num_processes": topo.num_processes,
+        "global_devices": topo.global_device_count,
+    }}
+
+    hello = run_hello_world()
+    assert hello.ok, hello
+    result["hello_world"] = {
+        "n_devices": hello.n_devices,
+        "broadcast_ok": hello.broadcast_ok,
+        "ring_ok": hello.ring_ok,
+        "psum_ok": hello.psum_ok,
+    }
+
+    # --- 2 DP train steps on a multi-process mesh ---------------------------
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.data.cifar10 import SyntheticCIFAR10, eval_transform
+    from deeplearning_mpi_tpu.data.loader import ShardedLoader
+    from deeplearning_mpi_tpu.models import resnet18
+    from deeplearning_mpi_tpu.parallel import shard_state
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    mesh = create_mesh()
+    model = resnet18(num_classes=10, stem="cifar")
+    tx = build_optimizer("sgd", 0.1, momentum=0.9)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, 32, 32, 3)), tx
+    )
+    state = shard_state(state, mesh)
+
+    ds = SyntheticCIFAR10(64, seed=7)
+    loader = ShardedLoader(
+        ds, 16, mesh, shuffle=True, seed=3, transform=eval_transform,
+        num_workers=2,
+    )
+    assert jax.process_count() > 1  # the path under test: loader sharding by
+    # process (data/loader.py local_row_ranges with process_count > 1)
+    rows = sum(b - a for a, b in loader.local_row_ranges)
+    assert rows == 16 // args.num_processes, loader.local_row_ranges
+
+    step = make_train_step("classification")
+    losses = []
+    for i, batch in zip(range(2), loader.epoch(0)):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    result["losses"] = losses
+
+    # Param digest: replicated params must be bit-identical on every process.
+    flat, _ = jax.tree.flatten(state.params)
+    digest = hashlib.sha256()
+    for leaf in flat:
+        digest.update(np.ascontiguousarray(jax.device_get(leaf)).tobytes())
+    result["params_sha256"] = digest.hexdigest()
+
+    # --- multi-host orbax save + restore ------------------------------------
+    from deeplearning_mpi_tpu.train.checkpoint import Checkpointer
+
+    ckpt_dir = Path(args.out_dir) / "ckpt"
+    ckpt = Checkpointer(ckpt_dir)
+    ckpt.save(state, epoch=0)
+    fresh = create_train_state(
+        model, jax.random.key(1), jnp.zeros((1, 32, 32, 3)), tx
+    )
+    fresh = shard_state(fresh, mesh)
+    restored = ckpt.restore(fresh, epoch=0)
+    ckpt.close()
+    same = jax.tree.all(
+        jax.tree.map(
+            lambda a, b: bool(np.array_equal(jax.device_get(a), jax.device_get(b))),
+            state.params,
+            restored.params,
+        )
+    )
+    assert same, "restored params differ from saved params"
+    assert int(restored.step) == int(state.step)
+    result["restore_ok"] = True
+
+    out = Path(args.out_dir) / f"proc{args.process_id}.json"
+    out.write_text(json.dumps(result))
+    bootstrap.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Multi-process rendezvous tests: real OS processes over a real transport.
+
+The reference's distributed path is only honestly exercised by running N
+actual processes (torchrun spawns them; gloo is the hardware-free transport
+— ``pytorch/hello_world/hello_world.py:33-44``, SURVEY.md §4). The
+single-process virtual-device mesh the rest of this suite uses never
+executes ``jax.distributed.initialize`` (``runtime/bootstrap.py``), the
+loader's ``process_count > 1`` sharding, or a multi-host orbax save. These
+tests do: the parent spawns 2 workers (each with 2 virtual CPU devices → a
+4-device global mesh), which rendezvous at a coordinator, run hello_world,
+train 2 DP steps, checkpoint, and dump digests the parent cross-checks.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = Path(__file__).parent / "helpers" / "multiprocess_worker.py"
+REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_workers(n: int, out_dir: Path, local_devices: int = 2,
+                   timeout: float = 300.0) -> list[dict]:
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, str(WORKER),
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num_processes", str(n),
+                "--process_id", str(i),
+                "--local_devices", str(local_devices),
+                "--out_dir", str(out_dir),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO,
+        )
+        for i in range(n)
+    ]
+    outputs = [p.communicate(timeout=timeout)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
+    return [
+        json.loads((out_dir / f"proc{i}.json").read_text()) for i in range(n)
+    ]
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_two_process_rendezvous_train_and_checkpoint(tmp_path):
+    """2 processes × 2 virtual devices: rendezvous, hello_world, 2 DP steps
+    with bit-identical replicated params, multi-host orbax save/restore."""
+    results = _spawn_workers(2, tmp_path)
+
+    for i, r in enumerate(results):
+        assert r["topology"] == {
+            "process_id": i, "num_processes": 2, "global_devices": 4,
+        }
+        assert r["hello_world"]["n_devices"] == 4
+        assert r["hello_world"]["broadcast_ok"]
+        assert r["hello_world"]["ring_ok"]
+        assert r["hello_world"]["psum_ok"]
+        assert r["restore_ok"]
+
+    # DDP-parity invariant: after identical-seed init + all-reduced grads,
+    # every process holds bit-identical replicated params (the state DDP
+    # reaches via construction broadcast + synchronized updates).
+    assert results[0]["params_sha256"] == results[1]["params_sha256"]
+    # And both processes observed the same global loss sequence.
+    assert results[0]["losses"] == pytest.approx(results[1]["losses"])
+    assert len(results[0]["losses"]) == 2
