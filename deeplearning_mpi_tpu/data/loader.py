@@ -24,6 +24,10 @@ replicate"). This loader folds the epoch into the shuffle key.
 from __future__ import annotations
 
 import math
+import os
+import queue as queue_mod
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterator, Protocol
 
 import jax
@@ -61,6 +65,14 @@ class ShardedLoader:
         process-local numpy batch (augmentations live here). Seeded by
         (seed, epoch) identically on every process so replicated shards stay
         bit-identical.
+      num_workers: fetch threads per batch. The reference keeps its chips fed
+        with 15 DataLoader worker *processes* (``pytorch/resnet/main.py:100``);
+        here the heavy per-example work (PIL decode, disk reads, numpy
+        resize) releases the GIL, so a thread pool gives the same overlap
+        without pickling examples across process boundaries. 0 = synchronous
+        (deterministic single-thread path for debugging). Default: half the
+        host's cores, capped at 16 (the reference's ``os.cpu_count()//2``
+        heuristic, ``pytorch/unet/train.py:92``).
     """
 
     def __init__(
@@ -74,6 +86,7 @@ class ShardedLoader:
         drop_last: bool = True,
         transform: Callable[[dict[str, np.ndarray], np.random.Generator], dict[str, np.ndarray]]
         | None = None,
+        num_workers: int | None = None,
     ) -> None:
         dp_degree = math.prod(mesh.shape[a] for a in data_axes(mesh))
         if global_batch_size % dp_degree != 0:
@@ -88,6 +101,9 @@ class ShardedLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.transform = transform
+        if num_workers is None:
+            num_workers = min(16, (os.cpu_count() or 2) // 2)
+        self.num_workers = num_workers
         # Global row ranges this process must supply, from the sharding itself
         # (sorted, de-duplicated): correct for pure DP (disjoint slices),
         # replication across model/seq axes (full range), and anything mixed.
@@ -126,8 +142,56 @@ class ShardedLoader:
             return n // self.global_batch_size
         return -(-n // self.global_batch_size)
 
+    def _assemble(
+        self,
+        order: np.ndarray,
+        start: int,
+        epoch: int,
+        fetch_pool: ThreadPoolExecutor | None = None,
+    ) -> dict[str, np.ndarray]:
+        """Fetch + stack + transform one process-local host batch.
+
+        Thread-safe and order-independent: the augmentation rng is seeded per
+        (seed, epoch, batch-start), identical on every process — replicated
+        shards stay bit-identical no matter which worker assembles the batch.
+        """
+        window = order[start : start + self.global_batch_size]
+        local_idx = np.concatenate([window[a:b] for a, b in self.local_row_ranges])
+        if fetch_pool is not None and len(local_idx) >= 2 * self.num_workers:
+            # Chunked parallel fetch: per-example disk/decode work (the bulk
+            # of a real dataset's cost) releases the GIL, so chunks overlap.
+            chunks = np.array_split(local_idx, self.num_workers)
+            parts = list(
+                fetch_pool.map(lambda c: [self.dataset[int(i)] for i in c], chunks)
+            )
+            examples = [ex for part in parts for ex in part]
+        else:
+            examples = [self.dataset[int(i)] for i in local_idx]
+        stacked = {k: np.stack([ex[k] for ex in examples]) for k in examples[0]}
+        if self.transform is not None:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch, 1, start])
+            )
+            stacked = self.transform(stacked, rng)
+        if not self.drop_last:
+            # Validity mask: 0 marks wrap-padded duplicate rows (flat
+            # positions >= dataset size), so eval can exclude them from
+            # metric means instead of double-counting the pad source rows.
+            flat_pos = np.concatenate(
+                [np.arange(start + a, start + b) for a, b in self.local_row_ranges]
+            )
+            stacked["__valid__"] = (flat_pos < len(self.dataset)).astype(np.float32)
+        return stacked
+
     def epoch(self, epoch: int) -> Iterator[Batch]:
-        """Yield this epoch's batches as globally-sharded device arrays."""
+        """Yield this epoch's batches as globally-sharded device arrays.
+
+        With ``num_workers > 0``, batch assembly is pipelined: up to two
+        batches are being fetched/decoded/augmented by the thread pool while
+        the consumer (and the device, via async dispatch) works on the
+        current one — the overlap the reference gets from DataLoader worker
+        processes (``pytorch/resnet/main.py:100-110``).
+        """
         order = self._epoch_order(epoch)
         if len(order) == 0:
             raise ValueError(
@@ -135,28 +199,9 @@ class ShardedLoader:
                 f"{self.global_batch_size}; lower the batch size or use drop_last=False"
             )
         shardings: dict[int, jax.sharding.NamedSharding] = {}
-        # Same stream on every process: replicated shards must stay identical.
-        rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch, 1]))
 
-        n_real = len(self.dataset)
-        for start in range(0, len(order), self.global_batch_size):
-            window = order[start : start + self.global_batch_size]
-            local_idx = np.concatenate(
-                [window[a:b] for a, b in self.local_row_ranges]
-            )
-            examples = [self.dataset[int(i)] for i in local_idx]
-            stacked = {k: np.stack([ex[k] for ex in examples]) for k in examples[0]}
-            if self.transform is not None:
-                stacked = self.transform(stacked, rng)
-            if not self.drop_last:
-                # Validity mask: 0 marks wrap-padded duplicate rows (flat
-                # positions >= dataset size), so eval can exclude them from
-                # metric means instead of double-counting the pad source rows.
-                flat_pos = np.concatenate(
-                    [np.arange(start + a, start + b) for a, b in self.local_row_ranges]
-                )
-                stacked["__valid__"] = (flat_pos < n_real).astype(np.float32)
-            yield {
+        def to_device(stacked: dict[str, np.ndarray]) -> Batch:
+            return {
                 k: jax.make_array_from_process_local_data(
                     shardings.setdefault(v.ndim, batch_sharding(self.mesh, ndim=v.ndim)),
                     v,
@@ -164,25 +209,88 @@ class ShardedLoader:
                 for k, v in stacked.items()
             }
 
+        starts = range(0, len(order), self.global_batch_size)
+        if self.num_workers <= 0:
+            for start in starts:
+                yield to_device(self._assemble(order, start, epoch))
+            return
+        import collections
+
+        # Pools are scoped to this epoch's generator: closed when it is
+        # exhausted or abandoned (GeneratorExit runs the with-exit), so a
+        # loader never pins threads beyond its active iteration. Two pools so
+        # a batch-assembly worker can fan example fetches out without
+        # deadlocking against its own pool.
+        with ThreadPoolExecutor(
+            max_workers=3, thread_name_prefix="loader-batch"
+        ) as batch_pool, ThreadPoolExecutor(
+            max_workers=self.num_workers, thread_name_prefix="loader-fetch"
+        ) as fetch_pool:
+            pending: collections.deque = collections.deque()
+            ahead = 2  # batches in flight beyond the one being consumed
+            for start in starts:
+                pending.append(
+                    batch_pool.submit(self._assemble, order, start, epoch, fetch_pool)
+                )
+                if len(pending) > ahead:
+                    yield to_device(pending.popleft().result())
+            while pending:
+                yield to_device(pending.popleft().result())
+
     def __iter__(self) -> Iterator[Batch]:
         return self.epoch(0)
 
 
 def prefetch(iterator: Iterator[Any], size: int = 2) -> Iterator[Any]:
-    """Software pipelining: assemble ``size`` batches ahead of the consumer.
+    """Background-thread prefetch: a producer thread runs the source iterator
+    ``size`` items ahead of the consumer through a bounded queue.
 
     The reference overlaps host data work with device compute via DataLoader
     worker processes + ``pin_memory`` (``pytorch/resnet/main.py:100-110``).
-    With JAX's async dispatch the device runs ahead of the host already;
-    pulling the iterator ``size`` items ahead additionally hides host-side
-    batch assembly + H2D transfer behind the current step's compute.
+    Here the producer thread performs batch assembly + H2D transfer (both
+    GIL-releasing) concurrently with the consumer's step dispatch, so the
+    device never waits on the host pipeline as long as batch prep is faster
+    than a step. Exceptions in the source iterator propagate to the consumer;
+    abandoning the generator stops the producer.
     """
-    import collections
+    q: queue_mod.Queue[Any] = queue_mod.Queue(maxsize=max(size, 1))
+    sentinel = object()
+    stop = threading.Event()
+    error: list[BaseException] = []
 
-    queue: collections.deque[Any] = collections.deque()
-    for item in iterator:
-        queue.append(item)
-        if len(queue) > size:
-            yield queue.popleft()
-    while queue:
-        yield queue.popleft()
+    def producer() -> None:
+        try:
+            for item in iterator:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in the consumer
+            error.append(e)
+        finally:
+            # The sentinel MUST arrive (or the consumer has left): block with
+            # a stop-aware retry, never drop it — a dropped sentinel would
+            # hang the consumer's final q.get().
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue_mod.Full:
+                    continue
+
+    thread = threading.Thread(target=producer, daemon=True, name="prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if error:
+                    raise error[0]
+                return
+            yield item
+    finally:
+        stop.set()
