@@ -66,12 +66,18 @@ def run_hello_world(mesh: Mesh | None = None, payload: float = 42.0) -> HelloWor
         n_received = collectives.all_reduce_sum(
             jnp.asarray(received == payload, jnp.float32), AXIS_DATA
         )
-        # 2) full ring round-trip: n shifts return the original value.
-        v = x
-        for _ in range(n):
+        # 2) ring transport. The single-shift check is the load-bearing one:
+        # after ONE shift device i must hold device (i-1)'s value — an
+        # identity ppermute would fail it (a full round-trip alone is also
+        # satisfied by identity, which is why it is not sufficient evidence;
+        # round-1 verdict finding). The full round-trip then checks the ring
+        # composes.
+        v = collectives.ring_shift(x, AXIS_DATA)
+        one_shift_ok = v == (idx - 1) % n
+        for _ in range(n - 1):
             v = collectives.ring_shift(v, AXIS_DATA)
         n_round_tripped = collectives.all_reduce_sum(
-            jnp.asarray(v == x, jnp.float32), AXIS_DATA
+            jnp.asarray(one_shift_ok & (v == x), jnp.float32), AXIS_DATA
         )
         # 3) psum of indices.
         total = collectives.all_reduce_sum(x, AXIS_DATA)

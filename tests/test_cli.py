@@ -55,11 +55,41 @@ class TestTrainResnetCLI:
         assert "Epoch 0: loss" in _read_logs(tmp_path / "logs")
 
 
+UNET_ARGS = [
+    "--synthetic", "--batch_size", "8", "--train_samples", "16",
+    "--image_size", "32", "--eval_every", "1",
+]
+
+
 class TestTrainUnetCLI:
     def test_one_epoch_synthetic(self, tmp_path):
+        rc = train_unet.main(UNET_ARGS + [
+            "--num_epochs", "1",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+        logs = _read_logs(tmp_path / "logs")
+        assert "Epoch 0: loss" in logs
+        assert "dice" in logs
+
+    def test_resume_continues_from_checkpoint(self, tmp_path):
+        args = UNET_ARGS + [
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]
+        assert train_unet.main(args + ["--num_epochs", "1"]) == 0
+        assert train_unet.main(args + ["--num_epochs", "2", "--resume"]) == 0
+        logs = _read_logs(tmp_path / "logs")
+        assert "resumed from epoch 0" in logs
+        assert "Epoch 1: loss" in logs
+
+    def test_volumetric_with_remat(self, tmp_path):
+        """The 3-D UNet + gradient-checkpointing path (beyond-parity config)."""
         rc = train_unet.main([
-            "--synthetic", "--num_epochs", "1", "--batch_size", "8",
-            "--train_samples", "16", "--image_size", "32", "--eval_every", "1",
+            "--synthetic", "--volumetric", "--remat",
+            "--num_epochs", "1", "--batch_size", "8", "--train_samples", "16",
+            "--image_size", "16", "--eval_every", "1",
             "--model_dir", str(tmp_path / "ckpt"),
             "--log_dir", str(tmp_path / "logs"),
         ])
