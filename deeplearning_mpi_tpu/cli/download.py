@@ -1,0 +1,165 @@
+"""Dataset fetch tool — the out-of-band prefetch step.
+
+TPU-native equivalent of ``pytorch/resnet/download.py:1-19``: the reference
+downloads CIFAR-10 *before* launching distributed training because an in-job
+download "is not multiprocess safe" (``pytorch/resnet/main.py:90``). Same
+contract here: run this once per host (or once on a shared filesystem), then
+launch training with ``--data_dir`` pointing at the result.
+
+Two dataset layouts:
+
+- ``cifar10`` — fetches ``cifar-10-python.tar.gz`` (md5-verified), extracts
+  the standard ``cifar-10-batches-py`` pickle directory that
+  :class:`~deeplearning_mpi_tpu.data.cifar10.CIFAR10` reads.
+- ``carvana`` — Carvana-style segmentation data requires Kaggle
+  authentication, so it cannot be fetched anonymously (the reference has the
+  same gap: its dataset doc tells the user to place files by hand,
+  ``pytorch/unet/data/README.md``). This command scaffolds the expected
+  ``images/`` + ``masks/`` layout and validates any data already present
+  (every image paired with exactly one mask, matching sizes — the checks
+  ``data_loading.py:112-118`` makes at load time, surfaced at fetch time).
+
+``--check`` validates an existing directory without touching the network —
+the mode that works on air-gapped machines (like this build box, which has
+zero egress; downloads fail fast with a clear message instead of hanging).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import sys
+import tarfile
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+CIFAR10_MD5 = "c58f30108f718f92721af3b95e74349a"
+_CIFAR_MEMBERS = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+
+
+def _md5(path: Path) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def check_cifar10(data_dir: Path) -> bool:
+    """True iff the ``cifar-10-batches-py`` pickles are all present."""
+    batch_dir = data_dir / "cifar-10-batches-py"
+    missing = [m for m in _CIFAR_MEMBERS if not (batch_dir / m).is_file()]
+    if missing:
+        print(f"{batch_dir}: missing {missing}" if batch_dir.is_dir()
+              else f"{batch_dir}: not found")
+        return False
+    print(f"{batch_dir}: complete ({len(_CIFAR_MEMBERS)} batch files)")
+    return True
+
+
+def fetch_cifar10(data_dir: Path, *, timeout: float = 30.0) -> int:
+    """Download + verify + extract CIFAR-10; idempotent."""
+    if check_cifar10(data_dir):
+        return 0
+    data_dir.mkdir(parents=True, exist_ok=True)
+    print(f"fetching {CIFAR10_URL} ...")
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".tar.gz", delete=False) as tmp:
+            with urllib.request.urlopen(CIFAR10_URL, timeout=timeout) as r:
+                while chunk := r.read(1 << 20):
+                    tmp.write(chunk)
+            tmp_path = Path(tmp.name)
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        print(
+            f"download failed ({e!r}). This machine may have no network "
+            "egress — fetch cifar-10-python.tar.gz on a connected machine "
+            f"and extract it under {data_dir}, or train with --synthetic.",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        digest = _md5(tmp_path)
+        if digest != CIFAR10_MD5:
+            print(f"md5 mismatch: got {digest}, want {CIFAR10_MD5}",
+                  file=sys.stderr)
+            return 1
+        with tarfile.open(tmp_path, "r:gz") as tar:
+            tar.extractall(data_dir, filter="data")
+    finally:
+        tmp_path.unlink(missing_ok=True)
+    return 0 if check_cifar10(data_dir) else 1
+
+
+def check_carvana(data_dir: Path, *, mask_suffix: str = "") -> bool:
+    """Validate an images/ + masks/ segmentation layout.
+
+    Every image must have exactly one mask named ``<stem><mask_suffix>.*``
+    (the invariant ``SegmentationFolderDataset`` and the reference's
+    ``BasicDataset.__getitem__`` assert at train time,
+    ``pytorch/unet/data_loading.py:112-118``).
+    """
+    images, masks = data_dir / "images", data_dir / "masks"
+    for d in (images, masks):
+        if not d.is_dir():
+            print(f"{d}: not found")
+            return False
+    image_stems = sorted(p.stem for p in images.iterdir() if p.is_file())
+    if not image_stems:
+        print(f"{images}: empty")
+        return False
+    mask_stems = {p.stem for p in masks.iterdir() if p.is_file()}
+    unpaired = [s for s in image_stems if s + mask_suffix not in mask_stems]
+    if unpaired:
+        print(f"{len(unpaired)} image(s) without a mask, e.g. {unpaired[:3]}")
+        return False
+    print(f"{data_dir}: {len(image_stems)} image/mask pairs, all paired")
+    return True
+
+
+def scaffold_carvana(data_dir: Path) -> int:
+    """Create the expected layout and print where to put the data."""
+    for sub in ("images", "masks"):
+        (data_dir / sub).mkdir(parents=True, exist_ok=True)
+    print(
+        f"created {data_dir}/images and {data_dir}/masks.\n"
+        "Carvana-style data needs Kaggle auth and cannot be fetched "
+        "anonymously:\n"
+        "  kaggle competitions download -c carvana-image-masking-challenge\n"
+        "Place images in images/ and masks in masks/ with matching stems, "
+        "then re-run with --check."
+    )
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dmt-download",
+        description="One-shot dataset prefetch, run before distributed "
+        "training (parity: pytorch/resnet/download.py).",
+    )
+    ap.add_argument("dataset", choices=("cifar10", "carvana"))
+    ap.add_argument("--data_dir", default="data", help="destination directory")
+    ap.add_argument("--check", action="store_true",
+                    help="validate existing data only; never touch the network")
+    ap.add_argument("--mask_suffix", default="",
+                    help="carvana: mask filename suffix after the image stem")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args(argv)
+    data_dir = Path(args.data_dir)
+
+    if args.dataset == "cifar10":
+        if args.check:
+            return 0 if check_cifar10(data_dir) else 1
+        return fetch_cifar10(data_dir, timeout=args.timeout)
+    if args.check:
+        return 0 if check_carvana(data_dir, mask_suffix=args.mask_suffix) else 1
+    if check_carvana(data_dir, mask_suffix=args.mask_suffix):
+        return 0
+    return scaffold_carvana(data_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
