@@ -116,6 +116,70 @@ def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
     return result
 
 
+def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10) -> dict:
+    """TransformerLM train-step throughput with the compiled Pallas flash
+    kernel: tokens/s/chip + MFU. Default config = the 110M-param
+    TransformerConfig (768d x 12L) at 2k sequence, bf16."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    config = TransformerConfig()
+    model = TransformerLM(
+        config=config, dtype=jnp.bfloat16, attention_fn=flash_attention
+    )
+    tx = build_optimizer("adam", 3e-4, clip_norm=1.0)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, seq_len), jnp.int32), tx
+    )
+    step = make_train_step("lm")
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch_size, seq_len), 0, config.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    host_sync(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    host_sync(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens_per_s = batch_size * seq_len * steps / dt / n_chips
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    # Analytic train FLOPs/token: 6N for the matmul stack (fwd 2N + bwd 4N)
+    # plus causal attention scores/values (12·L·S·d_attn, halved triangle,
+    # ×3 for fwd+bwd over fwd).
+    d_attn = config.num_heads * config.head_dim
+    attn_flops = 3 * 4 * config.num_layers * seq_len * d_attn * 0.5
+    flops_per_token = 6 * n_params + attn_flops
+    tflops = tokens_per_s * flops_per_token / 1e12
+    return {
+        "seq_len": seq_len,
+        "batch_size": batch_size,
+        "n_params": n_params,
+        "step_time_ms": dt / steps * 1e3,
+        "tokens_per_s_per_chip": round(tokens_per_s, 1),
+        "achieved_tflops_per_chip": round(tflops, 1),
+        "mfu": round(tflops / V5E_PEAK_BF16_TFLOPS, 3),
+        "attention": "pallas_flash_compiled"
+        if jax.default_backend() == "tpu"
+        else "pallas_flash_interpret",
+    }
+
+
 def bench_allreduce() -> dict:
     """Gradient-sized all-reduce latency over the data axis — the BASELINE.md
     'DDP all-reduce step latency' metric (the reference's unmeasured hot path,
@@ -134,6 +198,7 @@ def main() -> None:
     parser.add_argument("--batch_32", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--skip_224", action="store_true")
+    parser.add_argument("--skip_lm", action="store_true")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                         help="force JAX platform (debug; default = real TPU)")
     args = parser.parse_args()
@@ -161,6 +226,12 @@ def main() -> None:
 
     if value is None and "cifar_32px" in details:
         value = details["cifar_32px"]["images_per_s_per_chip"]
+
+    if not args.skip_lm:
+        try:
+            details["transformer_lm_2k_flash"] = bench_lm()
+        except Exception as e:  # noqa: BLE001
+            details["transformer_lm_error"] = repr(e)
 
     try:
         details["allreduce"] = bench_allreduce()

@@ -66,28 +66,33 @@ def fetch_cifar10(data_dir: Path, *, timeout: float = 30.0) -> int:
         return 0
     data_dir.mkdir(parents=True, exist_ok=True)
     print(f"fetching {CIFAR10_URL} ...")
+    with tempfile.NamedTemporaryFile(suffix=".tar.gz", delete=False) as tmp:
+        tmp_path = Path(tmp.name)
     try:
-        with tempfile.NamedTemporaryFile(suffix=".tar.gz", delete=False) as tmp:
-            with urllib.request.urlopen(CIFAR10_URL, timeout=timeout) as r:
+        try:
+            with open(tmp_path, "wb") as f, urllib.request.urlopen(
+                CIFAR10_URL, timeout=timeout
+            ) as r:
                 while chunk := r.read(1 << 20):
-                    tmp.write(chunk)
-            tmp_path = Path(tmp.name)
-    except (urllib.error.URLError, OSError, TimeoutError) as e:
-        print(
-            f"download failed ({e!r}). This machine may have no network "
-            "egress — fetch cifar-10-python.tar.gz on a connected machine "
-            f"and extract it under {data_dir}, or train with --synthetic.",
-            file=sys.stderr,
-        )
-        return 1
-    try:
+                    f.write(chunk)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            print(
+                f"download failed ({e!r}). This machine may have no network "
+                "egress — fetch cifar-10-python.tar.gz on a connected machine "
+                f"and extract it under {data_dir}, or train with --synthetic.",
+                file=sys.stderr,
+            )
+            return 1
         digest = _md5(tmp_path)
         if digest != CIFAR10_MD5:
             print(f"md5 mismatch: got {digest}, want {CIFAR10_MD5}",
                   file=sys.stderr)
             return 1
         with tarfile.open(tmp_path, "r:gz") as tar:
-            tar.extractall(data_dir, filter="data")
+            try:
+                tar.extractall(data_dir, filter="data")
+            except TypeError:  # filter= needs py>=3.10.12/3.11.4/3.12
+                tar.extractall(data_dir)  # noqa: S202 — md5-verified archive
     finally:
         tmp_path.unlink(missing_ok=True)
     return 0 if check_cifar10(data_dir) else 1
@@ -97,25 +102,45 @@ def check_carvana(data_dir: Path, *, mask_suffix: str = "") -> bool:
     """Validate an images/ + masks/ segmentation layout.
 
     Every image must have exactly one mask named ``<stem><mask_suffix>.*``
-    (the invariant ``SegmentationFolderDataset`` and the reference's
+    with matching pixel dimensions (the invariants
+    ``SegmentationFolderDataset`` and the reference's
     ``BasicDataset.__getitem__`` assert at train time,
-    ``pytorch/unet/data_loading.py:112-118``).
+    ``pytorch/unet/data_loading.py:112-118``) — surfaced here at fetch time
+    instead of mid-epoch.
     """
     images, masks = data_dir / "images", data_dir / "masks"
     for d in (images, masks):
         if not d.is_dir():
             print(f"{d}: not found")
             return False
-    image_stems = sorted(p.stem for p in images.iterdir() if p.is_file())
-    if not image_stems:
+    image_files = sorted(p for p in images.iterdir() if p.is_file())
+    if not image_files:
         print(f"{images}: empty")
         return False
-    mask_stems = {p.stem for p in masks.iterdir() if p.is_file()}
-    unpaired = [s for s in image_stems if s + mask_suffix not in mask_stems]
+    mask_by_stem = {p.stem: p for p in masks.iterdir() if p.is_file()}
+    unpaired, mismatched = [], []
+    for img in image_files:
+        mask = mask_by_stem.get(img.stem + mask_suffix)
+        if mask is None:
+            unpaired.append(img.stem)
+            continue
+        try:
+            from PIL import Image
+
+            with Image.open(img) as im, Image.open(mask) as mk:
+                if im.size != mk.size:
+                    mismatched.append(f"{img.stem} {im.size} vs {mk.size}")
+        except OSError as e:
+            mismatched.append(f"{img.stem} unreadable: {e}")
     if unpaired:
         print(f"{len(unpaired)} image(s) without a mask, e.g. {unpaired[:3]}")
         return False
-    print(f"{data_dir}: {len(image_stems)} image/mask pairs, all paired")
+    if mismatched:
+        print(f"{len(mismatched)} image/mask size mismatch(es), "
+              f"e.g. {mismatched[:3]}")
+        return False
+    print(f"{data_dir}: {len(image_files)} image/mask pairs, all paired, "
+          "sizes match")
     return True
 
 

@@ -4,7 +4,7 @@ The reference's input pipeline leans on torch's native DataLoader machinery —
 15 worker processes on the resnet path (``pytorch/resnet/main.py:100``),
 ``os.cpu_count()//2`` on the unet path (``pytorch/unet/train.py:92``); see
 ``SURVEY.md`` §2b. The TPU-native equivalent is per-host and threaded, not
-per-rank and process-forked: ``native/fastloader.cc`` provides fused
+per-rank and process-forked: ``native_src/fastloader.cc`` provides fused
 multithreaded pad+crop+flip+normalize kernels over whole uint8 batches, and
 this module compiles it on first use (g++, cached by source hash) and exposes
 batch transforms with the exact semantics — same RNG draws, same output — as
@@ -32,7 +32,7 @@ from deeplearning_mpi_tpu.data.cifar10 import (
     train_transform as _np_train_transform,
 )
 
-_SOURCE = Path(__file__).resolve().parents[2] / "native" / "fastloader.cc"
+_SOURCE = Path(__file__).resolve().parents[1] / "native_src" / "fastloader.cc"
 _lib: ctypes.CDLL | None = None
 _lib_tried = False
 

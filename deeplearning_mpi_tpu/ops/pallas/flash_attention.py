@@ -256,8 +256,8 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Tiled flash attention over ``[B, S, H, D]`` (drop-in for
@@ -268,9 +268,27 @@ def flash_attention(
     same code path). Sequences not divisible by the (clamped) block sizes
     fall back to the dense op — correctness everywhere, tiling where it
     counts.
+
+    Default 1024×1024 blocks are from an on-chip sweep (v5e, S=4096 B4 H8
+    D64): 12.40 ms (128², the flash-paper-style default) → 6.01 (256²) →
+    2.79 (512²) → 1.46 ms (1024²) device time per fwd — 8.5× from block
+    shape alone; small tiles leave the MXU idle between the many
+    sequential-kv grid steps. VMEM cost at 1024² is ~1.8 MiB
+    (q/k/v tiles + f32 accumulator + lane-replicated m/l), comfortably
+    inside any TPU's VMEM, and clamping handles seq < 1024.
     """
     seq = q.shape[1]
-    bq, bk = min(block_q, seq), min(block_k, seq)
+
+    def fit(block: int) -> int:
+        # Shrink until the block divides seq (halving preserves MXU-friendly
+        # sizes): seq=1536 with the 1024 default tiles at 512 instead of
+        # silently regressing to the dense O(S^2) fallback.
+        b = min(block, seq)
+        while b > 8 and seq % b:
+            b //= 2
+        return b
+
+    bq, bk = fit(block_q), fit(block_k)
     if seq % bq or seq % bk:
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
