@@ -14,12 +14,16 @@ sequential ("arbitrary") so scratch carries the accumulator across kv steps.
 Causal masking is positional arithmetic in global coordinates; kv blocks
 entirely in a q block's future skip their matmuls via ``pl.when``.
 
-Backward is a custom VJP in blockwise pure JAX (``lax.scan`` over kv
-blocks): recomputes the row logsumexp online, then accumulates
-dq/dk/dv per block — O(S·block_k) live memory, never the full score
-matrix. It trades one extra QKᵀ pass (~20% backward FLOPs) for not
-threading the lse out of the kernel; the Pallas backward kernel is a
-later optimization.
+Backward is a custom VJP over two more Pallas kernels (FlashAttention-2
+style): the forward threads the per-row logsumexp out as a second output;
+then a dq kernel streams kv blocks against each resident q block and a
+dk/dv kernel streams q blocks against each resident kv block, each
+recomputing its p tile from (s − lse) and ``delta = rowsum(o·do)`` in VMEM
+— O(S) memory, no probability matrix ever touches HBM (the prior
+blockwise-JAX backward materialized ``[B,H,S,block_k]`` p tensors per scan
+step, which dominated HBM traffic at long S; an XLA-side lane-replicated
+delta costs more than both backward kernels combined, hence the in-kernel
+recompute).
 
 No reference analog (the reference has no attention — SURVEY.md §5.7).
 Conventions follow ``ops.attention.dense_attention`` (BSHD layout, f32
@@ -45,9 +49,13 @@ def _swap_sh(x: jax.Array) -> jax.Array:
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, causal: bool, scale: float, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, o_ref, *rest,
+    causal: bool, scale: float, block_q: int, block_k: int, with_lse: bool,
 ):
+    if with_lse:
+        lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        (acc_ref, m_ref, l_ref), lse_ref = rest, None
     i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -96,23 +104,53 @@ def _fwd_kernel(
         l = l_ref[:, :1]
         o = acc_ref[...] / jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = jnp.where(l > 0.0, o, 0.0).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Row logsumexp for the backward pass, lane-replicated [bq, 128]
+            # like the running stats (Mosaic block shapes need the last two
+            # dims (8,128)-aligned, so a flat [bq] store is not lowerable;
+            # the 128x storage is the standard TPU-flash trade — jax's own
+            # kernel stores l/m the same way). Only the grad path pays the
+            # write: the primal forward runs with with_lse=False. A
+            # fully-masked row gets NEG_INF, which the backward treats as
+            # "never happens" — see _tile_p_ds's masked-row note.
+            lse_ref[0, 0] = jnp.where(
+                l_ref[...] > 0.0,
+                m_ref[...] + jnp.log(jnp.maximum(l_ref[...], 1e-37)),
+                NEG_INF,
+            )
 
 
 def _fwd_pallas(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
-    """Run the kernel on BHSD-transposed inputs; returns BSHD output."""
+    with_lse: bool,
+) -> tuple[jax.Array, jax.Array | None]:
+    """Run the kernel on BHSD-transposed inputs; returns BSHD output plus
+    (when ``with_lse``, i.e. under grad) the per-row logsumexp
+    ``[B, H, S, 128]`` lane-replicated backward residual. The primal skips
+    it — the lse write would be 4x the HBM bytes of the output itself at
+    D=64 bf16."""
     batch, seq, heads, head_dim = q.shape
     bq, bk = min(block_q, seq), min(block_k, seq)
     qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
     grid = (batch, heads, seq // bq, seq // bk)
-    out = pl.pallas_call(
+    o_shape = jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype)
+    o_spec = pl.BlockSpec(
+        (1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    lse_shape = jax.ShapeDtypeStruct((batch, heads, seq, 128), jnp.float32)
+    lse_spec = pl.BlockSpec(
+        (1, 1, bq, 128), lambda b, h, i, j: (b, h, i, 0),
+        memory_space=pltpu.VMEM,
+    )
+    result = pl.pallas_call(
         functools.partial(
             _fwd_kernel,
             causal=causal, scale=head_dim**-0.5, block_q=bq, block_k=bk,
+            with_lse=with_lse,
         ),
-        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype),
+        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec(
@@ -128,10 +166,7 @@ def _fwd_pallas(
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         scratch_shapes=[
             pltpu.VMEM((bq, head_dim), jnp.float32),  # acc
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
@@ -142,109 +177,227 @@ def _fwd_pallas(
         ),
         interpret=interpret,
     )(qt, kt, vt)
-    return _swap_sh(out)
+    out, lse = result if with_lse else (result, None)
+    return _swap_sh(out), lse
 
 
-def _blockwise_lse(
-    q: jax.Array, k_blocks: jax.Array, causal: bool, block_k: int, scale: float
-) -> jax.Array:
-    """Row logsumexp over all keys, streamed kv-block-wise. BHSD q."""
-    seq = q.shape[2]
+def _tile_p_ds(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+    i, j, *, causal: bool, scale: float, block_q: int, block_k: int,
+):
+    """Shared backward tile math: returns ``(p, ds, do_f32)`` for the
+    (q block i, kv block j) tile.
 
-    def step(carry, inputs):
-        m, l = carry
-        j, k_blk = inputs
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (seq, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (seq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # Rows masked in every block seen so far self-pollute (exp(0)=1 per
-        # masked entry), but the first valid block rescales l by
-        # exp(NEG_INF - real_max) = 0, erasing the pollution — and causally
-        # every row has a valid diagonal key, so the global lse is exact.
-        p_sum = jnp.sum(jnp.exp(s - m_new[..., None]), axis=-1)
-        l_new = l * jnp.exp(m - m_new) + p_sum
-        return (m_new, l_new), None
+    Both backward kernels need identical p/ds definitions — a one-sided edit
+    here would silently give dq a different gradient than dk/dv, so the core
+    lives in one place. delta = rowsum(o·do) is recomputed per tile in VMEM
+    (bq×d VPU work); materializing it lane-replicated in HBM cost more than
+    both backward kernels combined at long S.
 
-    nk = k_blocks.shape[0]
-    batch, heads, _, _ = q.shape
-    m0 = jnp.full((batch, heads, seq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((batch, heads, seq), jnp.float32)
-    (m, l), _ = lax.scan(step, (m0, l0), (jnp.arange(nk), k_blocks))
-    return m + jnp.log(jnp.maximum(l, 1e-30))  # lse; fully-masked rows: ~NEG_INF
+    Note on masked rows: ``p = exp(s - lse)`` relies on every q row having a
+    finite lse. In square causal/full self-attention every row attends to at
+    least its diagonal key, so this always holds; a hypothetical fully-masked
+    row (lse = NEG_INF) would yield exp(0) = 1 per entry, NOT zero — padding
+    or segment masks must guard p explicitly before relying on this path.
+    """
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, :1]  # lane-replicated [bq, 128] -> [bq, 1]
+    delta = jnp.sum(o_ref[0, 0].astype(jnp.float32) * do, axis=1, keepdims=True)
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)  # [bq, bk]
+    dp = lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * scale  # [bq, bk]
+    return p, ds, do
 
 
-def _flash_bwd_impl(
-    q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, do: jax.Array,
-    causal: bool, block_k: int, interpret: bool,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Blockwise flash backward in pure JAX (BSHD in/out)."""
-    del interpret
-    batch, seq, heads, head_dim = q.shape
-    bk = min(block_k, seq)
-    nk = seq // bk
-    scale = head_dim**-0.5
-    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
-    ot, dot_ = _swap_sh(o).astype(jnp.float32), _swap_sh(do).astype(jnp.float32)
-    k_blocks = kt.reshape(batch, heads, nk, bk, head_dim).transpose(2, 0, 1, 3, 4)
-    v_blocks = vt.reshape(batch, heads, nk, bk, head_dim).transpose(2, 0, 1, 3, 4)
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc,
+    *, causal: bool, scale: float, block_q: int, block_k: int,
+):
+    """dq for one q block, streaming kv blocks (sequential last grid axis)."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    lse = _blockwise_lse(qt, k_blocks, causal, bk, scale)  # [B,H,S]
-    delta = jnp.sum(ot * dot_, axis=-1)  # [B,H,S] row dot(o, do)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def step(dq_acc, inputs):
-        j, k_blk, v_blk = inputs
-        s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qt, k_blk, preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            q_pos = lax.broadcasted_iota(jnp.int32, (seq, bk), 0)
-            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (seq, bk), 1)
-            mask = q_pos >= k_pos
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # [B,H,S,bk]; 0 for masked/empty rows
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, dot_, preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dot_, v_blk, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum(
-            "bhqk,bhkd->bhqd", ds, k_blk, preferred_element_type=jnp.float32
+    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+
+    @pl.when(run)
+    def _update():
+        _, ds, _ = _tile_p_ds(
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         )
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qt, preferred_element_type=jnp.float32)
-        return dq_acc, (dk_blk, dv_blk)
+        k = k_ref[0, 0]
+        dq_acc[...] += lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    dq0 = jnp.zeros((batch, heads, seq, head_dim), jnp.float32)
-    dq, (dk_blocks, dv_blocks) = lax.scan(
-        step, dq0, (jnp.arange(nk), k_blocks, v_blocks)
-    )
-    merge = lambda blocks: _swap_sh(  # noqa: E731  [nk,B,H,bk,D] -> BSHD
-        blocks.transpose(1, 2, 0, 3, 4).reshape(batch, heads, seq, head_dim)
-    )
-    return (
-        _swap_sh(dq).astype(q.dtype),
-        merge(dk_blocks).astype(k.dtype),
-        merge(dv_blocks).astype(v.dtype),
-    )
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, causal: bool, scale: float, block_q: int, block_k: int,
+):
+    """dk/dv for one kv block, streaming q blocks (sequential last grid axis)."""
+    j = pl.program_id(2)  # kv block
+    i = pl.program_id(3)  # q block (sequential)
+    nq = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks strictly before this kv block contribute nothing.
+    run = (i * block_q + block_q - 1 >= j * block_k) if causal else True
+
+    @pl.when(run)
+    def _update():
+        p, ds, do = _tile_p_ds(
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        )
+        q = q_ref[0, 0]
+        # p in the input dtype: bf16 inputs get the bf16 MXU rate (an f32 p
+        # would halve throughput and double the tile's VMEM footprint).
+        dv_acc[...] += lax.dot_general(
+            p.astype(v_ref.dtype), do.astype(v_ref.dtype),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(
+    q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, do: jax.Array,
+    lse: jax.Array, causal: bool, block_q: int, block_k: int, interpret: bool,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Fused flash backward: two kernels (dq; dk+dv), O(S) memory, no HBM
+    probability matrices — replaces the blockwise-JAX backward whose
+    per-scan-step ``[B,H,S,bk]`` p tensors dominate HBM traffic at long S.
+    ``lse`` comes from the forward kernel (one recompute of QKᵀ per kernel
+    instead of the two extra passes the JAX path pays)."""
+    batch, seq, heads, head_dim = q.shape
+    bq, bk = min(block_q, seq), min(block_k, seq)
+    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
+    ot, dot_ = _swap_sh(o), _swap_sh(do)
+    scale = head_dim**-0.5
+
+    # One index map per (side, grid): the dq grid is (b, h, q, kv), the dkv
+    # grid is (b, h, kv, q). q-side rows (q, o, do, lse) share a map.
+    row_specs = {
+        "q@i": lambda b, h, i, j: (b, h, i, 0),
+        "kv@j": lambda b, h, i, j: (b, h, j, 0),
+        "q@j": lambda b, h, j, i: (b, h, i, 0),
+        "kv@i": lambda b, h, j, i: (b, h, j, 0),
+    }
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype),
+        grid=(batch, heads, seq // bq, seq // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@j"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@j"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 128), row_specs["q@i"], memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), v.dtype),
+        ),
+        grid=(batch, heads, seq // bk, seq // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@j"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@j"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@j"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bq, 128), row_specs["q@j"], memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@i"], memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk, head_dim), row_specs["kv@i"], memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, head_dim), jnp.float32),
+            pltpu.VMEM((bk, head_dim), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, ot, dot_, lse)
+
+    return _swap_sh(dq), _swap_sh(dk), _swap_sh(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
+    return _fwd_pallas(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=False
+    )[0]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    o = _fwd_pallas(q, k, v, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o)
+    o, lse = _fwd_pallas(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+    )
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, do):
-    q, k, v, o = res
-    return _flash_bwd_impl(q, k, v, o, do, causal, block_k, interpret)
+    q, k, v, o, lse = res
+    return _bwd_pallas(
+        q, k, v, o, do, lse, causal, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

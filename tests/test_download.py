@@ -1,0 +1,89 @@
+"""Dataset fetch tool tests — all offline (this box has zero egress).
+
+The network path is exercised up to the failure message (parity with the
+reference's one-shot prefetch contract, ``pytorch/resnet/download.py:17-18``);
+layout validation and scaffolding are tested for real.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from deeplearning_mpi_tpu.cli import download
+
+
+class TestCifar10:
+    def test_check_missing(self, tmp_path, capsys):
+        assert not download.check_cifar10(tmp_path)
+        assert "not found" in capsys.readouterr().out
+
+    def test_check_complete(self, tmp_path):
+        batch_dir = tmp_path / "cifar-10-batches-py"
+        batch_dir.mkdir()
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            (batch_dir / name).write_bytes(b"x")
+        assert download.check_cifar10(tmp_path)
+
+    def test_fetch_fails_gracefully_offline(self, tmp_path, monkeypatch, capsys):
+        """No egress ⇒ clear error + exit 1, no temp-file litter."""
+        import tempfile
+
+        tmpdir = tmp_path / "tmp"
+        tmpdir.mkdir()
+        monkeypatch.setattr(tempfile, "tempdir", str(tmpdir))
+        rc = download.fetch_cifar10(tmp_path / "data", timeout=2.0)
+        assert rc == 1
+        assert "download failed" in capsys.readouterr().err
+        assert list(tmpdir.iterdir()) == []  # partial tarball cleaned up
+
+    def test_cli_check_mode(self, tmp_path):
+        assert download.main(["cifar10", "--check", "--data_dir", str(tmp_path)]) == 1
+
+
+def _write_pair(root, stem, img_hw=(8, 8), mask_hw=None):
+    img = np.zeros((*img_hw, 3), np.uint8)
+    mask = np.zeros(mask_hw or img_hw, np.uint8)
+    Image.fromarray(img).save(root / "images" / f"{stem}.png")
+    Image.fromarray(mask).save(root / "masks" / f"{stem}.png")
+
+
+class TestCarvana:
+    @pytest.fixture()
+    def layout(self, tmp_path):
+        (tmp_path / "images").mkdir()
+        (tmp_path / "masks").mkdir()
+        return tmp_path
+
+    def test_scaffold_then_check(self, tmp_path, capsys):
+        assert download.main(["carvana", "--data_dir", str(tmp_path)]) == 0
+        assert (tmp_path / "images").is_dir() and (tmp_path / "masks").is_dir()
+        # Empty scaffold does not validate.
+        assert download.main(["carvana", "--check", "--data_dir", str(tmp_path)]) == 1
+
+    def test_valid_pairs(self, layout):
+        for stem in ("a", "b"):
+            _write_pair(layout, stem)
+        assert download.check_carvana(layout)
+
+    def test_unpaired_image(self, layout, capsys):
+        _write_pair(layout, "a")
+        (layout / "images" / "orphan.png").write_bytes(
+            (layout / "images" / "a.png").read_bytes()
+        )
+        assert not download.check_carvana(layout)
+        assert "without a mask" in capsys.readouterr().out
+
+    def test_size_mismatch(self, layout, capsys):
+        """The data_loading.py:112-118 invariant, surfaced at fetch time."""
+        _write_pair(layout, "a", img_hw=(8, 8), mask_hw=(4, 4))
+        assert not download.check_carvana(layout)
+        assert "size mismatch" in capsys.readouterr().out
+
+    def test_mask_suffix(self, tmp_path):
+        (tmp_path / "images").mkdir()
+        (tmp_path / "masks").mkdir()
+        img = np.zeros((8, 8, 3), np.uint8)
+        Image.fromarray(img).save(tmp_path / "images" / "car1.png")
+        Image.fromarray(img[..., 0]).save(tmp_path / "masks" / "car1_mask.png")
+        assert download.check_carvana(tmp_path, mask_suffix="_mask")
+        assert not download.check_carvana(tmp_path)
