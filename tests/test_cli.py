@@ -7,6 +7,10 @@ which is exactly how its legacy ``resnet.py`` drifted. Here every CLI's
 ``--zero`` paths, so a dead entrypoint can never ship.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import json
 
 from deeplearning_mpi_tpu.cli import train_resnet, train_unet

@@ -24,13 +24,22 @@ class TestCifar10:
             (batch_dir / name).write_bytes(b"x")
         assert download.check_cifar10(tmp_path)
 
-    def test_fetch_fails_gracefully_offline(self, tmp_path, monkeypatch, capsys):
-        """No egress ⇒ clear error + exit 1, no temp-file litter."""
+    def test_fetch_failure_cleans_up(self, tmp_path, monkeypatch, capsys):
+        """Failed download ⇒ clear error + exit 1, no temp-file litter.
+        Hermetic: urlopen is patched to fail, so the test is identical on
+        connected and air-gapped machines."""
         import tempfile
+        import urllib.error
+        import urllib.request
 
         tmpdir = tmp_path / "tmp"
         tmpdir.mkdir()
         monkeypatch.setattr(tempfile, "tempdir", str(tmpdir))
+
+        def refuse(*a, **kw):
+            raise urllib.error.URLError("no route to host")
+
+        monkeypatch.setattr(urllib.request, "urlopen", refuse)
         rc = download.fetch_cifar10(tmp_path / "data", timeout=2.0)
         assert rc == 1
         assert "download failed" in capsys.readouterr().err

@@ -30,6 +30,7 @@ def test_forward_matches_dense(causal):
 
 
 @pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
+@pytest.mark.slow
 def test_grads_match_dense(causal):
     q, k, v = qkv(S=32)
 
@@ -51,6 +52,27 @@ def test_rectangular_blocks():
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=16)
     ref = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(32, 16), (16, 32)], ids=["wide_q", "wide_k"])
+def test_rectangular_block_grads(bq, bk):
+    """Gradients with block_q != block_k: locks in the two backward kernels'
+    asymmetric causal skip predicates (dq streams kv blocks, dkv streams q
+    blocks with swapped grid axes — an off-by-one near the diagonal would
+    silently zero tiles in one of them but not the other)."""
+    q, k, v = qkv(S=64)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(attn(q, k, v) ** 2)
+
+    flash = lambda q, k, v: flash_attention(  # noqa: E731
+        q, k, v, causal=True, block_q=bq, block_k=bk
+    )
+    dense = lambda q, k, v: dense_attention(q, k, v, causal=True)  # noqa: E731
+    g_ref = jax.grad(loss, argnums=(1, 2, 3))(dense, q, k, v)
+    g_out = jax.grad(loss, argnums=(1, 2, 3))(flash, q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
 def test_indivisible_seq_falls_back_to_dense():

@@ -1,5 +1,7 @@
 """KV-cache decoding + generation tests: cached decode vs the full forward."""
 
+import pytest
+
 import dataclasses
 
 import jax
@@ -19,6 +21,7 @@ def _model_and_params(seq=16, batch=2):
 
 
 class TestCachedDecode:
+    @pytest.mark.slow
     def test_stepwise_decode_matches_full_forward(self):
         """Feeding tokens one at a time through the KV cache must reproduce
         the full-sequence causal forward logits position by position."""
@@ -66,6 +69,7 @@ class TestCachedDecode:
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_iterated_full_forward(self):
         """Greedy generation through the cache == argmax-iterating the full
         (uncached) model — end-to-end equivalence of the decode path."""

@@ -1,5 +1,7 @@
 """LM training task: trainer integration, MoE aux loss, datasets, CLI."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +35,7 @@ class TestLMTask:
         stats = [trainer.run_epoch(loader, e) for e in range(3)]
         assert stats[-1]["loss"] < stats[0]["loss"]
 
+    @pytest.mark.slow
     def test_moe_lm_trains_and_evaluates(self, mesh):
         cfg = TransformerConfig.tiny_moe(num_experts=4)
         trainer, loader = _make_trainer(mesh, cfg, aux_weight=0.01)
@@ -65,6 +68,7 @@ class TestByteTextDataset:
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 class TestTrainLMCLI:
     def test_one_epoch_synthetic(self, tmp_path):
         from deeplearning_mpi_tpu.cli import train_lm

@@ -18,6 +18,7 @@ def init_model(model, shape, train=False):
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet18_cifar_forward_shape(self):
         model = resnet18(num_classes=10)
         variables = init_model(model, (2, 32, 32, 3))
@@ -25,6 +26,7 @@ class TestResNet:
         assert out.shape == (2, 10)
         assert out.dtype == jnp.float32
 
+    @pytest.mark.slow
     def test_resnet18_param_count_matches_torchvision(self):
         # torchvision resnet18 with fc->10 (pytorch/resnet/main.py:40-41) has
         # 11,689,512 - 513,000 + 5,130 = 11,181,642 parameters.
@@ -32,6 +34,7 @@ class TestResNet:
         variables = init_model(model, (1, 32, 32, 3))
         assert n_params(variables["params"]) == 11_181_642
 
+    @pytest.mark.slow
     def test_resnet50_param_count_matches_torchvision(self):
         # torchvision resnet50 (25,557,032 @1000 classes) with a 10-class head.
         model = resnet50(num_classes=10)
@@ -67,6 +70,7 @@ class TestResNet:
 
 
 class TestUNet:
+    @pytest.mark.slow
     def test_reference_smoke_config(self):
         # The reference's own smoke test: 1x3x512x512 -> 1 class
         # (pytorch/unet/model.py:84-89). NHWC here; 128px to keep CPU tests fast,
@@ -76,6 +80,7 @@ class TestUNet:
         out = model.apply(variables, jnp.zeros((1, 128, 128, 3)), train=False)
         assert out.shape == (1, 128, 128, 1)
 
+    @pytest.mark.slow
     def test_param_count_in_reference_class(self):
         # SURVEY.md §6 calls the reference UNet "31M-param class" (1024-ch
         # bottleneck). Bias-free convs shave <0.1%; assert the ballpark.
@@ -116,6 +121,7 @@ class TestRegistry:
             get_model("vgg16")
 
 
+@pytest.mark.slow
 class TestUNet3D:
     """Volumetric UNet (BASELINE.md config ladder #5 — beyond-parity)."""
 

@@ -100,6 +100,7 @@ class TestMoETransformer:
         assert float(collect_aux_loss(mutated)) > 0
 
 
+@pytest.mark.slow
 class TestExpertParallelSharding:
     def test_expert_stack_sharded_over_expert_and_model_axes(self):
         mesh = create_mesh(MeshSpec(data=2, expert=2, model=2))

@@ -1,5 +1,7 @@
 """Tensor-parallel sharding-rule tests on the virtual 8-device mesh."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -36,6 +38,7 @@ class TestTpSpec:
 
 
 class TestShardedTrainStep:
+    @pytest.mark.slow
     def test_tp_train_step_matches_replicated(self):
         """One train step with dp=4 x tp=2 sharding must match pure DP numerically."""
         mesh = tp_mesh()
@@ -80,6 +83,7 @@ class TestShardedTrainStep:
                 err_msg="TP-sharded step diverged from replicated step",
             )
 
+    @pytest.mark.slow
     def test_moments_shard_like_params(self):
         mesh = tp_mesh()
         model = resnet18(num_classes=10, num_filters=16, stem="cifar")

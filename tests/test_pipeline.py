@@ -56,6 +56,7 @@ class TestPipelineApply:
             expected = jnp.tanh(expected @ w[s] + b[s])
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_sequential(self):
         mesh = pipe_mesh(pipe=4, data=2)
         rng = np.random.default_rng(1)
@@ -123,6 +124,7 @@ class TestPipelineApply:
 
 
 class TestPipelinedLM:
+    @pytest.mark.slow
     def test_matches_dense_transformer(self):
         """PipelinedLM(S=2 stages) == TransformerLM with the same weights,
         remapped stages[block_j][s] -> layer_{s*K+j}."""
@@ -154,6 +156,7 @@ class TestPipelinedLM:
         got = jax.jit(pipelined.apply)(variables, tokens)
         np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-4)
 
+    @pytest.mark.slow
     def test_trains_with_trainer(self, mesh=None):
         from deeplearning_mpi_tpu.data import ShardedLoader, SyntheticTokens
         from deeplearning_mpi_tpu.train import Trainer, create_train_state

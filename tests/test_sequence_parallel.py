@@ -40,6 +40,7 @@ def test_matches_dense_oracle(causal, make_fn):
 
 @pytest.mark.parametrize("make_fn", [make_ring_attention_fn, make_ulysses_attention_fn],
                          ids=["ring", "ulysses"])
+@pytest.mark.slow
 def test_grads_match_dense(make_fn):
     """Backward through the collective schedule must match dense attention —
     training correctness, not just inference."""
@@ -55,6 +56,7 @@ def test_grads_match_dense(make_fn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+@pytest.mark.slow
 def test_ring_seq8_uneven_heads():
     """The ring schedule has no head-divisibility constraint: seq=8 > heads=4."""
     mesh = seq_mesh(seq=8, data=1)

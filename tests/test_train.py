@@ -46,6 +46,7 @@ def make_batch(n=16, seed=0):
 
 
 class TestTrainStep:
+    @pytest.mark.slow
     def test_step_advances_and_loss_finite(self):
         state = make_state()
         step = make_train_step("classification", donate=False)
@@ -63,6 +64,7 @@ class TestTrainStep:
         )
         assert max(jax.tree.leaves(diffs)) > 0
 
+    @pytest.mark.slow
     def test_nonfinite_loss_skips_update(self):
         state = make_state()
         step = make_train_step("classification", donate=False)
@@ -76,6 +78,7 @@ class TestTrainStep:
         # ...but the step counter still advances (batch consumed)
         assert int(new_state.step) == 1
 
+    @pytest.mark.slow
     def test_dp_equals_single_device(self, mesh):
         """The DDP-parity property: training on an 8-way sharded batch gives
         the same parameters as unsharded training on the same global batch."""
@@ -98,6 +101,7 @@ class TestTrainStep:
         for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+    @pytest.mark.slow
     def test_grad_clip_engages(self):
         tx = build_optimizer("adam", 1e-3, clip_norm=1e-6)
         state = make_state(tx=tx)
@@ -124,6 +128,7 @@ class _ListLoader:
 
 
 class TestNonFiniteHandling:
+    @pytest.mark.slow
     def test_nan_batch_excluded_from_epoch_mean(self, mesh):
         from deeplearning_mpi_tpu.train.trainer import Trainer
 
@@ -141,6 +146,7 @@ class TestNonFiniteHandling:
 
 
 class TestEvalPaddingExclusion:
+    @pytest.mark.slow
     def test_evaluate_matches_exact_dataset_metrics(self, mesh):
         from deeplearning_mpi_tpu.data.cifar10 import SyntheticCIFAR10, eval_transform
         from deeplearning_mpi_tpu.data.loader import ShardedLoader
@@ -170,6 +176,7 @@ class TestEvalPaddingExclusion:
 
 
 class TestEvalStep:
+    @pytest.mark.slow
     def test_classification_metrics(self):
         state = make_state()
         ev = make_eval_step("classification")
@@ -177,6 +184,7 @@ class TestEvalStep:
         assert 0.0 <= float(metrics["accuracy"]) <= 1.0
         assert np.isfinite(float(metrics["loss"]))
 
+    @pytest.mark.slow
     def test_segmentation_metrics(self):
         from deeplearning_mpi_tpu.models import UNet
 
@@ -195,6 +203,7 @@ class TestEvalStep:
 
 
 class TestCheckpoint:
+    @pytest.mark.slow
     def test_roundtrip(self, tmp_path):
         state = make_state()
         step = make_train_step("classification", donate=False)
@@ -213,12 +222,14 @@ class TestCheckpoint:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         ckpt.close()
 
+    @pytest.mark.slow
     def test_restore_empty_raises(self, tmp_path):
         ckpt = Checkpointer(tmp_path / "none")
         with pytest.raises(FileNotFoundError):
             ckpt.restore(make_state())
         ckpt.close()
 
+    @pytest.mark.slow
     def test_keeps_history(self, tmp_path):
         state = make_state()
         ckpt = Checkpointer(tmp_path / "ckpt", max_to_keep=2)
@@ -230,6 +241,7 @@ class TestCheckpoint:
 
 
 class TestTrainerEndToEnd:
+    @pytest.mark.slow
     def test_learns_synthetic_cifar(self, mesh, tmp_path):
         """Mini e2e: loss drops and accuracy beats chance on learnable data."""
         ds = SyntheticCIFAR10(128, seed=0)
@@ -246,6 +258,7 @@ class TestTrainerEndToEnd:
         assert final_eval["accuracy"] > 0.4  # chance = 0.1
         trainer.checkpointer.close()
 
+    @pytest.mark.slow
     def test_resume_continues(self, mesh, tmp_path):
         ds = SyntheticCIFAR10(64, seed=0)
         loader = ShardedLoader(ds, 32, mesh, shuffle=True, transform=eval_transform)

@@ -129,6 +129,7 @@ class TestTransformerLM:
             plain.apply(params, tokens), remat.apply(params, tokens), atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_grads_flow_through_loss(self, tiny_model_and_params):
         model, params = tiny_model_and_params
         tokens = jnp.asarray(

@@ -1,5 +1,7 @@
 """ZeRO-1 optimizer-state sharding over the data axis."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,6 +57,7 @@ class TestZeroSharding:
         # scalars/counters replicated
         assert state.opt_state[1][0].count.sharding.spec == P()
 
+    @pytest.mark.slow
     def test_training_matches_unsharded(self):
         """One optimizer step with ZeRO-sharded moments must produce the same
         params as the fully replicated step."""
