@@ -1,0 +1,138 @@
+"""Text generation from a trained LM checkpoint.
+
+Completes the LM workflow the trainer starts: ``dmt-train-lm`` writes orbax
+checkpoints; this CLI restores one and decodes from it with the KV-cached
+single-token decode path (``models/generate.py`` — jitted scan, no Python
+token loop). Byte-level vocab (256) in and out, matching
+``data/lm_text.ByteTextDataset``.
+
+The reference has no inference entrypoint at all (its workflow ends at
+checkpoint files, ``pytorch/resnet/main.py:136-142``); this is the
+beyond-parity completion of the LM model family.
+
+Model-shape flags must match the training run — the checkpoint stores
+arrays, not architecture (same contract as the reference's ``--resume``,
+which also rebuilds the model from flags before loading weights,
+``pytorch/resnet/main.py:36-52``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dmt-generate",
+        description="Generate text from a dmt-train-lm checkpoint.",
+    )
+    model = parser.add_argument_group("model (must match the training run)")
+    model.add_argument("--seq_len", type=int, default=512,
+                       help="accepted for flag-compatibility with "
+                       "dmt-train-lm; params are sequence-independent (RoPE)")
+    model.add_argument("--num_layers", type=int, default=4)
+    model.add_argument("--num_heads", type=int, default=8)
+    model.add_argument("--head_dim", type=int, default=32)
+    model.add_argument("--d_model", type=int, default=256)
+    model.add_argument("--d_ff", type=int, default=1024)
+    model.add_argument("--moe_experts", type=int, default=0)
+    model.add_argument("--moe_top_k", type=int, default=2)
+    model.add_argument("--dtype", default="float32",
+                       choices=("float32", "bfloat16"),
+                       help="compute dtype; match the training run "
+                       "(dmt-train-lm default: float32)")
+    parser.add_argument("--model_dir", default="saved_models")
+    parser.add_argument("--model_filename", default="lm")
+    parser.add_argument("--epoch", type=int, default=None,
+                        help="checkpoint epoch to load (default: latest)")
+    gen = parser.add_argument_group("generation")
+    gen.add_argument("--prompt", default="",
+                     help="UTF-8 prompt text (byte tokens); empty = BOS-free "
+                     "unconditional generation from byte 0")
+    gen.add_argument("--max_new_tokens", type=int, default=128)
+    gen.add_argument("--temperature", type=float, default=1.0)
+    gen.add_argument("--top_k", type=int, default=0,
+                     help="0 = full softmax; N>0 = top-N sampling")
+    gen.add_argument("--greedy", action="store_true",
+                     help="argmax decoding (temperature ignored)")
+    gen.add_argument("--random_seed", type=int, default=0)
+    run = parser.add_argument_group("runtime")
+    run.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    run.add_argument("--n_virtual_devices", type=int, default=None)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from deeplearning_mpi_tpu.runtime import bootstrap
+
+    if args.n_virtual_devices:
+        bootstrap.set_virtual_cpu_devices(args.n_virtual_devices)
+    elif args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.generate import generate
+    from deeplearning_mpi_tpu.train import Checkpointer, create_train_state
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    cfg = TransformerConfig(
+        vocab_size=256,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        head_dim=args.head_dim,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
+    )
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dtype)
+    # Optimizer only shapes the restore template (adam state matches the
+    # trainer's); its hyperparameters are irrelevant for inference. The
+    # dummy input is short on purpose: params are sequence-independent
+    # (RoPE, no position table), and a full --seq_len dense init would do
+    # O(S^2) work — fatal for long-context checkpoints.
+    template = create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+        build_optimizer("adam", 1e-3, clip_norm=1.0),
+    )
+    ckpt = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    try:
+        state = ckpt.restore(template, epoch=args.epoch)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 1
+    finally:
+        ckpt.close()
+
+    prompt_bytes = args.prompt.encode("utf-8") or b"\x00"
+    prompt = jnp.asarray(
+        np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
+    )[None, :]
+
+    out = generate(
+        model,
+        state.params,
+        prompt,
+        max_new_tokens=args.max_new_tokens,
+        rng=jax.random.key(args.random_seed),
+        temperature=0.0 if args.greedy else args.temperature,
+        top_k=0 if args.greedy else args.top_k,
+    )
+    tokens = np.asarray(out[0], np.uint8)
+    text = tokens.tobytes().decode("utf-8", errors="replace")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
