@@ -44,6 +44,36 @@ V5E_PEAK_BF16_TFLOPS = 197.0  # nominal; tools/profile_resnet.py measured 187
 RESNET50_FWD_FLOPS = {224: 4.089e9, 32: 84.0e6}
 
 
+def _timed_steps(step, state, batch, steps: int) -> dict:
+    """Shared warmup + timing scaffold for every sub-bench.
+
+    Warmup (compile + 2 hot steps), then ``steps`` timed executions, synced
+    by a device→host fetch of the scalar loss — see ``host_sync``'s
+    docstring for why ``block_until_ready`` is not a reliable sync here.
+    Returns items/s per chip and step time; callers derive their own
+    domain-specific rates (images/s, tokens/s, MFU).
+    """
+    import jax
+
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    host_sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    host_sync(metrics["loss"])  # the whole step chain must complete to produce this
+    dt = time.perf_counter() - t0
+    n_chips = jax.device_count()
+    return {
+        "steps": steps,
+        "step_time_ms": dt / steps * 1e3,
+        "steps_per_s": steps / dt,
+        "n_chips": n_chips,
+        "device": str(jax.devices()[0].device_kind),
+    }
+
 
 def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
     import jax
@@ -65,8 +95,6 @@ def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
     labels = jax.random.randint(rng, (batch_size,), 0, 10)
     batch = {"image": images, "label": labels}
 
-    from deeplearning_mpi_tpu.utils.profiling import host_sync
-
     # One AOT compile serves both the HLO flop count (mfu_hlo_counted) and
     # the timed loop — calling the compiled object directly avoids a second
     # trace/compile through the jit dispatch cache.
@@ -81,27 +109,13 @@ def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
     except Exception:  # noqa: BLE001 — cost analysis is best-effort; fall
         pass  # back to the jitted step (compiles once in the warmup loop)
 
-    # Warmup: compile + 2 steps. host_sync fetches the scalar loss — see its
-    # docstring for why block_until_ready is not a reliable sync here.
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    host_sync(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    host_sync(metrics["loss"])  # the whole step chain must complete to produce this
-    dt = time.perf_counter() - t0
-
-    n_chips = jax.device_count()
+    timing = _timed_steps(step, state, batch, steps)
     result = {
         "image_size": image_size,
         "batch_size": batch_size,
-        "steps": steps,
-        "step_time_ms": dt / steps * 1e3,
-        "images_per_s_per_chip": batch_size * steps / dt / n_chips,
-        "n_chips": n_chips,
-        "device": str(jax.devices()[0].device_kind),
+        **timing,
+        "images_per_s_per_chip": batch_size * timing["steps_per_s"]
+        / timing["n_chips"],
     }
     fwd_flops = RESNET50_FWD_FLOPS.get(image_size)
     if fwd_flops:
@@ -111,9 +125,49 @@ def bench_train_step(image_size: int, batch_size: int, steps: int = 20) -> dict:
         result["achieved_tflops_per_chip"] = round(analytic_tflops, 1)
         result["mfu"] = round(analytic_tflops / V5E_PEAK_BF16_TFLOPS, 3)
     if flops_per_step:
-        hlo_tflops = flops_per_step * steps / dt / 1e12 / n_chips
+        hlo_tflops = (
+            flops_per_step * timing["steps_per_s"] / 1e12 / timing["n_chips"]
+        )
         result["mfu_hlo_counted"] = round(hlo_tflops / V5E_PEAK_BF16_TFLOPS, 3)
     return result
+
+
+def bench_unet(image_size: int = 512, batch_size: int = 8, steps: int = 10) -> dict:
+    """UNet-2D training throughput — the second BASELINE.md headline metric
+    ("images/sec/chip (ResNet-50, UNet-2D)"). Full reference topology
+    (64..1024 channels, transpose-conv up path), bf16 compute, Adam +
+    grad-clip 1.0 (the reference trainer's optimizer, unet/train.py:160,194)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.models import UNet
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    model = UNet(dtype=jnp.bfloat16)
+    tx = build_optimizer("adam", 1e-4, clip_norm=1.0)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, image_size, image_size, 3)), tx
+    )
+    step = make_train_step("segmentation")
+    rng = jax.random.key(1)
+    batch = {
+        "image": jax.random.normal(
+            rng, (batch_size, image_size, image_size, 3), jnp.float32
+        ),
+        "mask": (
+            jax.random.uniform(rng, (batch_size, image_size, image_size)) > 0.5
+        ).astype(jnp.float32),
+    }
+    timing = _timed_steps(step, state, batch, steps)
+    return {
+        "image_size": image_size,
+        "batch_size": batch_size,
+        **timing,
+        "images_per_s_per_chip": round(
+            batch_size * timing["steps_per_s"] / timing["n_chips"], 1
+        ),
+    }
 
 
 def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10) -> dict:
@@ -130,7 +184,6 @@ def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10) -> dict:
     from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
     from deeplearning_mpi_tpu.train import create_train_state, make_train_step
     from deeplearning_mpi_tpu.train.trainer import build_optimizer
-    from deeplearning_mpi_tpu.utils.profiling import host_sync
 
     config = TransformerConfig()
     model = TransformerLM(
@@ -146,18 +199,10 @@ def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10) -> dict:
     )
     batch = {"tokens": tokens}
 
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    host_sync(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    host_sync(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    n_chips = jax.device_count()
-    tokens_per_s = batch_size * seq_len * steps / dt / n_chips
+    timing = _timed_steps(step, state, batch, steps)
+    tokens_per_s = (
+        batch_size * seq_len * timing["steps_per_s"] / timing["n_chips"]
+    )
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     # Analytic train FLOPs/token: 6N for the matmul stack (fwd 2N + bwd 4N)
     # plus causal attention scores/values (12·L·S·d_attn, halved triangle,
@@ -170,7 +215,7 @@ def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10) -> dict:
         "seq_len": seq_len,
         "batch_size": batch_size,
         "n_params": n_params,
-        "step_time_ms": dt / steps * 1e3,
+        **timing,
         "tokens_per_s_per_chip": round(tokens_per_s, 1),
         "achieved_tflops_per_chip": round(tflops, 1),
         "mfu": round(tflops / V5E_PEAK_BF16_TFLOPS, 3),
@@ -199,6 +244,7 @@ def main() -> None:
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--skip_224", action="store_true")
     parser.add_argument("--skip_lm", action="store_true")
+    parser.add_argument("--skip_unet", action="store_true")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                         help="force JAX platform (debug; default = real TPU)")
     args = parser.parse_args()
@@ -229,9 +275,15 @@ def main() -> None:
 
     if not args.skip_lm:
         try:
-            details["transformer_lm_2k_flash"] = bench_lm()
+            details["transformer_lm_2k_flash"] = bench_lm(steps=max(args.steps // 2, 5))
         except Exception as e:  # noqa: BLE001
             details["transformer_lm_error"] = repr(e)
+
+    if not args.skip_unet:
+        try:
+            details["unet2d_512px"] = bench_unet(steps=max(args.steps // 2, 5))
+        except Exception as e:  # noqa: BLE001
+            details["unet2d_error"] = repr(e)
 
     try:
         details["allreduce"] = bench_allreduce()

@@ -27,17 +27,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dmt-generate",
         description="Generate text from a dmt-train-lm checkpoint.",
     )
-    model = parser.add_argument_group("model (must match the training run)")
-    model.add_argument("--seq_len", type=int, default=512,
-                       help="accepted for flag-compatibility with "
-                       "dmt-train-lm; params are sequence-independent (RoPE)")
-    model.add_argument("--num_layers", type=int, default=4)
-    model.add_argument("--num_heads", type=int, default=8)
-    model.add_argument("--head_dim", type=int, default=32)
-    model.add_argument("--d_model", type=int, default=256)
-    model.add_argument("--d_ff", type=int, default=1024)
-    model.add_argument("--moe_experts", type=int, default=0)
-    model.add_argument("--moe_top_k", type=int, default=2)
+    from deeplearning_mpi_tpu.utils import config
+
+    # Shared definition with dmt-train-lm keeps the defaults byte-identical;
+    # --seq_len is accepted for flag-compatibility but unused here (params
+    # are sequence-independent — RoPE, no position table).
+    model = config.add_lm_model_flags(parser)
     model.add_argument("--dtype", default="float32",
                        choices=("float32", "bfloat16"),
                        help="compute dtype; match the training run "
@@ -84,6 +79,15 @@ def main(argv: list[str] | None = None) -> int:
     from deeplearning_mpi_tpu.train import Checkpointer, create_train_state
     from deeplearning_mpi_tpu.train.trainer import build_optimizer
 
+    # Fail BEFORE the (potentially minutes-long) model/optimizer init, and
+    # without Checkpointer's create=True side-effect mkdir on a typo'd path.
+    from pathlib import Path
+
+    ckpt_dir = Path(args.model_dir) / args.model_filename
+    if not ckpt_dir.is_dir():
+        print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
+        return 1
+
     cfg = TransformerConfig(
         vocab_size=256,
         num_layers=args.num_layers,
@@ -105,11 +109,21 @@ def main(argv: list[str] | None = None) -> int:
         model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
         build_optimizer("adam", 1e-3, clip_norm=1.0),
     )
-    ckpt = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    ckpt = Checkpointer(ckpt_dir)
     try:
         state = ckpt.restore(template, epoch=args.epoch)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 — orbax raises its own types for
+        # a bad --epoch or a template/checkpoint tree mismatch; one clean
+        # line beats a multi-frame traceback for a CLI.
+        print(
+            f"failed to restore from {ckpt.directory}"
+            + (f" epoch {args.epoch}" if args.epoch is not None else "")
+            + f": {e}",
+            file=sys.stderr,
+        )
         return 1
     finally:
         ckpt.close()

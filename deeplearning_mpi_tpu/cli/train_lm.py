@@ -33,13 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
         parser, num_epochs=10, batch_size=32, learning_rate=3e-4, random_seed=0,
         model_filename="lm",
     )
-    group = parser.add_argument_group("model")
-    group.add_argument("--seq_len", type=int, default=512)
-    group.add_argument("--num_layers", type=int, default=4)
-    group.add_argument("--num_heads", type=int, default=8)
-    group.add_argument("--head_dim", type=int, default=32)
-    group.add_argument("--d_model", type=int, default=256)
-    group.add_argument("--d_ff", type=int, default=1024)
+    group = config.add_lm_model_flags(parser)
     group.add_argument("--remat", action="store_true",
                        help="checkpoint each block (recompute in backward) — trades FLOPs for HBM")
     group.add_argument("--microbatches", type=int, default=4,
@@ -47,9 +41,6 @@ def build_parser() -> argparse.ArgumentParser:
     group.add_argument("--attention", default="dense",
                        choices=["dense", "flash", "ring", "ulysses"],
                        help="attention core: flash = Pallas TPU kernel; ring/ulysses = sequence-parallel over --sp")
-    group.add_argument("--moe_experts", type=int, default=0,
-                       help="experts per MLP (0 = dense); shard with --ep")
-    group.add_argument("--moe_top_k", type=int, default=2)
     group.add_argument("--moe_aux_weight", type=float, default=0.01)
     data = parser.add_argument_group("data")
     data.add_argument("--text_file", default=None,
@@ -116,7 +107,18 @@ def main(argv: list[str] | None = None) -> int:
     elif args.attention == "ulysses":
         from deeplearning_mpi_tpu.parallel import make_ulysses_attention_fn
 
-        attention_fn = make_ulysses_attention_fn(mesh)
+        if jax.default_backend() == "tpu":
+            # Per-shard attention on the Pallas kernel: after the all-to-all
+            # each device holds full-sequence shards for a head subset, the
+            # exact shape flash tiles best. Off-TPU keeps the dense inner
+            # (the Pallas interpreter is slower than XLA dense on CPU).
+            from deeplearning_mpi_tpu.ops.pallas import flash_attention
+
+            attention_fn = make_ulysses_attention_fn(
+                mesh, inner=flash_attention
+            )
+        else:
+            attention_fn = make_ulysses_attention_fn(mesh)
 
     cfg = TransformerConfig(
         vocab_size=256,

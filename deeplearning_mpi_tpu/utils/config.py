@@ -64,6 +64,28 @@ def add_training_flags(
     group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
 
 
+def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGroup":
+    """LM architecture flags shared by ``dmt-train-lm`` and ``dmt-generate``.
+
+    One definition keeps the two entrypoints' defaults byte-identical — the
+    checkpoint stores arrays, not architecture, so a silent default drift
+    between train and generate would surface as an opaque orbax tree/shape
+    mismatch at restore time. Returns the group so callers can append their
+    own entrypoint-specific flags (remat, attention, sampling, ...).
+    """
+    group = parser.add_argument_group("model")
+    group.add_argument("--seq_len", type=int, default=512)
+    group.add_argument("--num_layers", type=int, default=4)
+    group.add_argument("--num_heads", type=int, default=8)
+    group.add_argument("--head_dim", type=int, default=32)
+    group.add_argument("--d_model", type=int, default=256)
+    group.add_argument("--d_ff", type=int, default=1024)
+    group.add_argument("--moe_experts", type=int, default=0,
+                       help="0 = dense SwiGLU MLP; N>1 swaps in a routed MoE MLP per block")
+    group.add_argument("--moe_top_k", type=int, default=2)
+    return group
+
+
 def setup_runtime(args: argparse.Namespace):
     """Apply topology flags and initialize the runtime. Returns (topology, mesh).
 
