@@ -33,6 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     # --seq_len is accepted for flag-compatibility but unused here (params
     # are sequence-independent — RoPE, no position table).
     model = config.add_lm_model_flags(parser)
+    model.title = "model (MUST match the training run — the checkpoint stores arrays, not architecture)"
     model.add_argument("--dtype", default="float32",
                        choices=("float32", "bfloat16"),
                        help="compute dtype; match the training run "

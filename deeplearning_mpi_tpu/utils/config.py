@@ -74,14 +74,18 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
     own entrypoint-specific flags (remat, attention, sampling, ...).
     """
     group = parser.add_argument_group("model")
-    group.add_argument("--seq_len", type=int, default=512)
+    group.add_argument("--seq_len", type=int, default=512,
+                       help="training sequence length (params are RoPE/"
+                       "sequence-independent, so inference entrypoints "
+                       "accept but ignore it)")
     group.add_argument("--num_layers", type=int, default=4)
     group.add_argument("--num_heads", type=int, default=8)
     group.add_argument("--head_dim", type=int, default=32)
     group.add_argument("--d_model", type=int, default=256)
     group.add_argument("--d_ff", type=int, default=1024)
     group.add_argument("--moe_experts", type=int, default=0,
-                       help="0 = dense SwiGLU MLP; N>1 swaps in a routed MoE MLP per block")
+                       help="0 = dense SwiGLU MLP; N>1 swaps in a routed MoE "
+                       "MLP per block (shard with --ep when training)")
     group.add_argument("--moe_top_k", type=int, default=2)
     return group
 

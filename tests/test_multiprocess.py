@@ -6,9 +6,11 @@ actual processes (torchrun spawns them; gloo is the hardware-free transport
 single-process virtual-device mesh the rest of this suite uses never
 executes ``jax.distributed.initialize`` (``runtime/bootstrap.py``), the
 loader's ``process_count > 1`` sharding, or a multi-host orbax save. These
-tests do: the parent spawns 2 workers (each with 2 virtual CPU devices → a
-4-device global mesh), which rendezvous at a coordinator, run hello_world,
-train 2 DP steps, checkpoint, and dump digests the parent cross-checks.
+tests do: the parent spawns N workers which rendezvous at a coordinator, run
+hello_world, train 2 DP steps, checkpoint, and dump digests the parent
+cross-checks — in two topologies: 2 processes × 2 virtual devices (the
+TPU-native one-process-per-host layout) and 4 processes × 1 device (the
+reference's torchrun one-process-per-accelerator layout).
 """
 
 from __future__ import annotations
@@ -61,16 +63,29 @@ def _spawn_workers(n: int, out_dir: Path, local_devices: int = 2,
 
 @pytest.mark.slow
 @pytest.mark.multiprocess
-def test_two_process_rendezvous_train_and_checkpoint(tmp_path):
-    """2 processes × 2 virtual devices: rendezvous, hello_world, 2 DP steps
-    with bit-identical replicated params, multi-host orbax save/restore."""
-    results = _spawn_workers(2, tmp_path)
+@pytest.mark.parametrize(
+    "n_procs,local_devices",
+    [(2, 2), (4, 1)],
+    ids=["2procs_x_2dev", "4procs_x_1dev"],
+)
+def test_rendezvous_train_and_checkpoint(tmp_path, n_procs, local_devices):
+    """N OS processes: rendezvous, hello_world, 2 DP steps with bit-identical
+    replicated params, multi-host orbax save/restore.
+
+    The 4×1 shape is the one-process-per-chip layout the reference's
+    torchrun uses (one worker per GPU); 2×2 is the TPU-native
+    one-process-per-host layout with multiple local devices.
+    """
+    results = _spawn_workers(n_procs, tmp_path, local_devices=local_devices)
+    n_global = n_procs * local_devices
 
     for i, r in enumerate(results):
         assert r["topology"] == {
-            "process_id": i, "num_processes": 2, "global_devices": 4,
+            "process_id": i,
+            "num_processes": n_procs,
+            "global_devices": n_global,
         }
-        assert r["hello_world"]["n_devices"] == 4
+        assert r["hello_world"]["n_devices"] == n_global
         assert r["hello_world"]["broadcast_ok"]
         assert r["hello_world"]["ring_ok"]
         assert r["hello_world"]["psum_ok"]
@@ -79,7 +94,9 @@ def test_two_process_rendezvous_train_and_checkpoint(tmp_path):
     # DDP-parity invariant: after identical-seed init + all-reduced grads,
     # every process holds bit-identical replicated params (the state DDP
     # reaches via construction broadcast + synchronized updates).
-    assert results[0]["params_sha256"] == results[1]["params_sha256"]
-    # And both processes observed the same global loss sequence.
-    assert results[0]["losses"] == pytest.approx(results[1]["losses"])
+    hashes = {r["params_sha256"] for r in results}
+    assert len(hashes) == 1
+    # And every process observed the same global loss sequence.
+    for r in results[1:]:
+        assert r["losses"] == pytest.approx(results[0]["losses"])
     assert len(results[0]["losses"]) == 2
