@@ -89,10 +89,12 @@ def main(argv: list[str] | None = None) -> int:
     eval_ds = _Slice(dataset, len(dataset) - n_eval, len(dataset))
 
     train_loader = ShardedLoader(
-        train_ds, args.batch_size, mesh, shuffle=True, seed=args.random_seed
+        train_ds, args.batch_size, mesh, shuffle=True, seed=args.random_seed,
+        num_workers=args.num_workers,
     )
     eval_loader = ShardedLoader(
-        eval_ds, args.batch_size, mesh, shuffle=False, drop_last=False
+        eval_ds, args.batch_size, mesh, shuffle=False, drop_last=False,
+        num_workers=args.num_workers,
     )
 
     attention_fn = None

@@ -95,10 +95,12 @@ def main(argv: list[str] | None = None) -> int:
     train_loader = ShardedLoader(
         train_ds, args.batch_size, mesh,
         shuffle=True, seed=args.random_seed, transform=train_transform,
+        num_workers=args.num_workers,
     )
     eval_loader = ShardedLoader(
         eval_ds, args.batch_size, mesh,
         shuffle=False, drop_last=False, transform=eval_transform,
+        num_workers=args.num_workers,
     )
 
     model = get_model(

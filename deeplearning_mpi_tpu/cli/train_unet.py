@@ -123,12 +123,14 @@ def main(argv: list[str] | None = None) -> int:
             return full[int(self.indices[i])]
 
     train_loader = ShardedLoader(
-        _Subset(train_idx), args.batch_size, mesh, shuffle=True, seed=args.random_seed
+        _Subset(train_idx), args.batch_size, mesh, shuffle=True, seed=args.random_seed,
+        num_workers=args.num_workers,
     )
     # drop_last=False: small validation sets wrap-pad to one full batch, so
     # the batch stays divisible by the mesh's data-parallel degree.
     eval_loader = ShardedLoader(
-        _Subset(val_idx), args.batch_size, mesh, shuffle=False, drop_last=False
+        _Subset(val_idx), args.batch_size, mesh, shuffle=False, drop_last=False,
+        num_workers=args.num_workers,
     )
 
     channels = 1 if args.volumetric else 3

@@ -62,6 +62,10 @@ def add_training_flags(
     group.add_argument("--profile_dir", default=None, help="write a jax.profiler trace of a few hot steps here (TensorBoard/Perfetto)")
     group.add_argument("--max_restarts", type=int, default=0, help="auto-resume from the latest checkpoint this many times on failure (0 = fail immediately; the reference's analog is manual restart with --resume)")
     group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
+    group.add_argument("--num_workers", type=int, default=None,
+                       help="loader fetch threads per host (default: half the "
+                       "cores, capped at 16; 0 = synchronous). The reference's "
+                       "DataLoader num_workers knob (resnet/main.py:100)")
 
 
 def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGroup":
