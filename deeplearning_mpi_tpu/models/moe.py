@@ -27,6 +27,7 @@ signature as ``SwiGLU``), so a dense LM becomes an MoE LM by configuration.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any
 
@@ -38,6 +39,24 @@ import jax.numpy as jnp
 #: load-balance loss. Collect with ``collect_aux_loss``.
 AUX_COLLECTION = "moe_losses"
 AUX_NAME = "load_balance"
+
+
+def mlp_cls_from_config(config: Any) -> Any:
+    """``mlp_cls`` for a transformer config's MoE knobs; ``None`` when dense.
+
+    Shared by :class:`~deeplearning_mpi_tpu.models.transformer.TransformerLM`
+    and the pipelined LM so both build routers from the same hyperparameters
+    (``config`` is duck-typed to avoid a circular import of
+    ``TransformerConfig``).
+    """
+    if not config.moe_experts:
+        return None
+    return functools.partial(
+        MoEMLP,
+        num_experts=config.moe_experts,
+        top_k=config.moe_top_k,
+        capacity_factor=config.moe_capacity_factor,
+    )
 
 
 def collect_aux_loss(variables: dict[str, Any]) -> jax.Array:

@@ -17,6 +17,13 @@ This is a plain Python model class (not ``nn.Module``) exposing the same
 the trainer consumes (``train.state.create_train_state``), because the
 pipeline's param layout — one stacked tree instead of per-layer subtrees —
 is easier to state explicitly than to coax out of module transforms.
+
+MoE composes with PP: flax's sown collections cannot cross the
+``lax.scan``/``ppermute`` schedule, so each stage's load-balance losses are
+collected per apply and carried through the pipeline as one scalar per
+microbatch in the activation pytree; ``apply(..., mutable=...)`` re-emits
+the microbatch-mean under ``moe.AUX_COLLECTION`` so the trainer's
+``collect_aux_loss`` path is identical for pipelined and flat models.
 """
 
 from __future__ import annotations
@@ -27,6 +34,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deeplearning_mpi_tpu.models.moe import (
+    AUX_COLLECTION,
+    collect_aux_loss,
+    mlp_cls_from_config,
+)
 from deeplearning_mpi_tpu.models.transformer import (
     Block,
     RMSNorm,
@@ -44,6 +56,10 @@ class StageBlocks(nn.Module):
 
     ``remat`` checkpoints each block (recompute activations in backward) —
     composes with pipelining for the standard PP+remat memory recipe.
+    ``mlp_cls`` is the same injection point as :class:`TransformerLM`'s —
+    an MoE stage sows its load-balance losses, which the enclosing
+    :class:`PipelinedLM` collects per apply and threads through the
+    pipeline's activation pytree.
     """
 
     config: TransformerConfig
@@ -51,6 +67,7 @@ class StageBlocks(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: Any = None
     remat: bool = False
+    mlp_cls: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -59,7 +76,8 @@ class StageBlocks(nn.Module):
         for i in range(self.num_blocks):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
-                attention_fn=self.attention_fn, name=f"block_{i}",
+                attention_fn=self.attention_fn, mlp_cls=self.mlp_cls,
+                name=f"block_{i}",
             )(x, positions)
         return x
 
@@ -110,11 +128,6 @@ class PipelinedLM:
         attention_fn: Any = None,
         remat: bool = False,
     ) -> None:
-        if config.moe_experts:
-            raise NotImplementedError(
-                "PP+MoE in one model is not wired yet (sown aux losses don't "
-                "cross pipeline_apply); use MoE with dp/tp/ep meshes"
-            )
         self.config = config
         self.mesh = mesh
         self.num_stages = num_stages or mesh.shape["pipe"]
@@ -131,7 +144,7 @@ class PipelinedLM:
         self.dtype = dtype
         self.stage_mod = StageBlocks(
             config, config.num_layers // self.num_stages, dtype, attention_fn,
-            remat=remat,
+            remat=remat, mlp_cls=mlp_cls_from_config(config),
         )
         self.embed_head = EmbedHead(config, dtype)
 
@@ -167,18 +180,32 @@ class PipelinedLM:
         xs = split_microbatches(
             {"x": x, "pos": positions}, self.num_microbatches
         )
+        # Sown collections can't cross pipeline_apply's scan/ppermute
+        # schedule, so each MoE stage's load-balance losses are collected at
+        # apply time and ride the activation pytree as one scalar per
+        # microbatch (same-structure in/out contract preserved; a dense model
+        # carries the zero scalar at negligible cost).
+        xs["aux"] = jnp.zeros((self.num_microbatches,), jnp.float32)
 
         def stage_fn(stage_params, acts):
-            y = self.stage_mod.apply(
-                {"params": stage_params}, acts["x"], acts["pos"]
+            y, mutated = self.stage_mod.apply(
+                {"params": stage_params}, acts["x"], acts["pos"],
+                mutable=[AUX_COLLECTION],
             )
-            return {"x": y, "pos": acts["pos"]}
+            aux = acts["aux"] + collect_aux_loss(mutated)
+            return {"x": y, "pos": acts["pos"], "aux": aux}
 
         ys = pipeline_apply(stage_fn, params["stages"], xs, mesh=self.mesh)
+        # Mean over microbatches: each microbatch's aux is the sum over
+        # stages of its own Switch-style balance loss, so the mean keeps the
+        # trainer-facing scale identical to the unpipelined model's
+        # full-batch aux (exactly equal when routing statistics are; see
+        # tests/test_pipeline.py for the per-microbatch oracle).
+        aux_total = jnp.mean(ys.pop("aux"))
         out = merge_microbatches(ys)["x"]
         logits = self.embed_head.apply(
             {"params": params["embed_head"]}, out, method=EmbedHead.decode
         )
         if mutable:
-            return logits, {}
+            return logits, {AUX_COLLECTION: {"pipeline": aux_total}}
         return logits

@@ -23,7 +23,6 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Any, Callable
 
 import flax.linen as nn
@@ -265,14 +264,9 @@ class TransformerLM(nn.Module):
         x = embed(tokens)
         mlp_cls = self.mlp_cls
         if mlp_cls is None and cfg.moe_experts > 0:
-            from deeplearning_mpi_tpu.models.moe import MoEMLP
+            from deeplearning_mpi_tpu.models.moe import mlp_cls_from_config
 
-            mlp_cls = functools.partial(
-                MoEMLP,
-                num_experts=cfg.moe_experts,
-                top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
-            )
+            mlp_cls = mlp_cls_from_config(cfg)
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(cfg.num_layers):
             x = block_cls(

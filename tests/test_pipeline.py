@@ -181,7 +181,75 @@ class TestPipelinedLM:
         assert np.isfinite(stats[0]["loss"])
         assert stats[-1]["loss"] < stats[0]["loss"]
 
-    def test_moe_config_rejected(self):
+    def test_moe_matches_flat_moe(self):
+        """PP+MoE: logits equal the flat MoE LM with remapped weights, and the
+        pipelined aux loss equals the mean of the flat model's per-microbatch
+        aux (routing statistics are per batch row, so microbatching does not
+        change them)."""
+        from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
+
         mesh = pipe_mesh(pipe=2, data=4)
-        with pytest.raises(NotImplementedError, match="MoE"):
-            PipelinedLM(TransformerConfig.tiny_moe(), mesh)
+        cfg = TransformerConfig.tiny_moe()
+        num_micro = 2
+        pipelined = PipelinedLM(
+            cfg, mesh, num_microbatches=num_micro, dtype=jnp.float32
+        )
+        tokens = jnp.asarray(
+            np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+        )
+        variables = pipelined.init(jax.random.key(0), tokens)
+
+        p = variables["params"]
+        blocks_per_stage = cfg.num_layers // 2
+        dense_params = {
+            "embed": p["embed_head"]["embed"],
+            "final_norm": p["embed_head"]["final_norm"],
+        }
+        for s in range(2):
+            for j in range(blocks_per_stage):
+                dense_params[f"layer_{s * blocks_per_stage + j}"] = jax.tree.map(
+                    lambda leaf: leaf[s], p["stages"][f"block_{j}"]
+                )
+        flat = TransformerLM(config=cfg, dtype=jnp.float32)
+        expected = flat.apply({"params": dense_params}, tokens)
+
+        got, mutated = jax.jit(
+            lambda v, t: pipelined.apply(v, t, mutable=[AUX_COLLECTION])
+        )(variables, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-4)
+
+        # Aux oracle: the flat model applied per microbatch, averaged.
+        mb = tokens.reshape(num_micro, -1, tokens.shape[1])
+        aux_ref = np.mean([
+            float(collect_aux_loss(
+                flat.apply({"params": dense_params}, mb[i], mutable=[AUX_COLLECTION])[1]
+            ))
+            for i in range(num_micro)
+        ])
+        aux_got = float(collect_aux_loss(mutated))
+        assert aux_got > 0.0
+        np.testing.assert_allclose(aux_got, aux_ref, rtol=1e-5)
+
+    @pytest.mark.slow
+    def test_moe_router_gets_aux_gradient(self):
+        """The aux loss must backpropagate through the pipeline to the router
+        weights — the whole point of threading it through the schedule."""
+        from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
+
+        mesh = pipe_mesh(pipe=2, data=4)
+        cfg = TransformerConfig.tiny_moe()
+        pipelined = PipelinedLM(cfg, mesh, num_microbatches=2, dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(3).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+        )
+        variables = pipelined.init(jax.random.key(1), tokens)
+
+        def aux_only(params):
+            _, mutated = pipelined.apply(
+                {"params": params}, tokens, mutable=[AUX_COLLECTION]
+            )
+            return collect_aux_loss(mutated)
+
+        grads = jax.grad(aux_only)(variables["params"])
+        router_g = grads["stages"]["block_0"]["mlp"]["router"]["kernel"]
+        assert float(jnp.max(jnp.abs(router_g))) > 0.0
