@@ -300,13 +300,20 @@ def _bwd_dkv_kernel(
 def _bwd_pallas(
     q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, do: jax.Array,
     lse: jax.Array, causal: bool, block_q: int, block_k: int, interpret: bool,
+    grad_dtype: jax.typing.DTypeLike | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused flash backward: two kernels (dq; dk+dv), O(S) memory, no HBM
     probability matrices — replaces the blockwise-JAX backward whose
     per-scan-step ``[B,H,S,bk]`` p tensors dominate HBM traffic at long S.
     ``lse`` comes from the forward kernel (one recompute of QKᵀ per kernel
-    instead of the two extra passes the JAX path pays)."""
+    instead of the two extra passes the JAX path pays). ``grad_dtype``
+    overrides the output dtype (default: match the inputs) — the ring
+    schedule requests f32 so its cross-rotation accumulation never rounds a
+    partial to bf16 first."""
     batch, seq, heads, head_dim = q.shape
+    dq_dtype = grad_dtype or q.dtype
+    dk_dtype = grad_dtype or k.dtype
+    dv_dtype = grad_dtype or v.dtype
     bq, bk = min(block_q, seq), min(block_k, seq)
     qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
     ot, dot_ = _swap_sh(o), _swap_sh(do)
@@ -325,7 +332,7 @@ def _bwd_pallas(
         functools.partial(
             _bwd_dq_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
         ),
-        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), dq_dtype),
         grid=(batch, heads, seq // bq, seq // bk),
         in_specs=[
             pl.BlockSpec((1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM),
@@ -350,8 +357,8 @@ def _bwd_pallas(
             _bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), k.dtype),
-            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), v.dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), dk_dtype),
+            jax.ShapeDtypeStruct((batch, heads, seq, head_dim), dv_dtype),
         ),
         grid=(batch, heads, seq // bk, seq // bq),
         in_specs=[
@@ -377,6 +384,25 @@ def _bwd_pallas(
     )(qt, kt, vt, ot, dot_, lse)
 
     return _swap_sh(dq), _swap_sh(dk), _swap_sh(dv)
+
+
+def fit_block(block: int, seq: int) -> int:
+    """Shrink ``block`` (by halving, preserving MXU-friendly sizes) until it
+    divides ``seq``: seq=1536 with the 1024 default tiles at 512 instead of
+    silently regressing to a dense O(S²) fallback. The result may be a
+    non-divisor of ``seq`` or non-sublane-aligned (``% 8``) — callers must
+    check both (see :func:`usable_blocks`) and fall back then."""
+    b = min(block, seq)
+    while b > 8 and seq % b:
+        b //= 2
+    return b
+
+
+def usable_blocks(bq: int, bk: int, seq: int) -> bool:
+    """Whether fitted blocks can legally tile ``seq`` on Mosaic: each must
+    divide the sequence AND be a multiple of the 8-row sublane (a short
+    sequence like 20 "fits" as one 20-row block but is not tileable)."""
+    return seq % bq == 0 and seq % bk == 0 and bq % 8 == 0 and bk % 8 == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -431,19 +457,16 @@ def flash_attention(
     inside any TPU's VMEM, and clamping handles seq < 1024.
     """
     seq = q.shape[1]
-
-    def fit(block: int) -> int:
-        # Shrink until the block divides seq (halving preserves MXU-friendly
-        # sizes): seq=1536 with the 1024 default tiles at 512 instead of
-        # silently regressing to the dense O(S^2) fallback.
-        b = min(block, seq)
-        while b > 8 and seq % b:
-            b //= 2
-        return b
-
-    bq, bk = fit(block_q), fit(block_k)
-    if seq % bq or seq % bk:
+    bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
+    if not usable_blocks(bq, bk, seq):
         return dense_attention(q, k, v, causal=causal)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, causal, bq, bk, interpret)
+
+
+# Block-level entry points for the ring schedule (parallel/ring_flash.py):
+# the ring owns the cross-shard online-softmax recombination and its own
+# VJP, and drives the kernels once per K/V rotation.
+flash_fwd_block = _fwd_pallas
+flash_bwd_block = _bwd_pallas

@@ -18,6 +18,9 @@ from deeplearning_mpi_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention_fn,
     ring_attention,
 )
+from deeplearning_mpi_tpu.parallel.ring_flash import (  # noqa: F401
+    ring_flash_attention,
+)
 from deeplearning_mpi_tpu.parallel.tensor_parallel import (  # noqa: F401
     infer_state_sharding,
     infer_tp_param_sharding,

@@ -156,6 +156,9 @@ def make_ring_attention_fn(
     *,
     seq_axis: str = AXIS_SEQ,
     batch_axes: Any = (AXIS_DATA,),
+    flash: bool | None = None,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> Any:
     """AttentionFn over *global* ``[B, S, H, D]`` arrays, for model injection.
 
@@ -163,8 +166,15 @@ def make_ring_attention_fn(
     ``batch_axes`` and sequence over ``seq_axis`` — drop-in for
     ``TransformerLM(attention_fn=...)``: the model stays a plain pjit program
     and only attention switches to the explicit ring schedule.
+
+    ``flash=None`` auto-selects the inner: on TPU meshes each rotation runs
+    the Pallas flash kernel (``parallel.ring_flash`` — scores stay in VMEM);
+    elsewhere the XLA block update above (the Pallas interpreter is far
+    slower than XLA on CPU, so tests opt in explicitly).
     """
     spec = P(batch_axes, seq_axis, None, None)
+    if flash is None:
+        flash = mesh.devices.flat[0].platform == "tpu"
 
     @functools.lru_cache(maxsize=2)
     def _sharded(causal: bool):
@@ -174,6 +184,15 @@ def make_ring_attention_fn(
             check_vma=False,
         )
         def fn(q, k, v):
+            if flash:
+                from deeplearning_mpi_tpu.parallel.ring_flash import (
+                    ring_flash_attention,
+                )
+
+                return ring_flash_attention(
+                    q, k, v, causal=causal, axis_name=seq_axis,
+                    block_q=block_q, block_k=block_k,
+                )
             return ring_attention(q, k, v, causal=causal, axis_name=seq_axis)
 
         return fn

@@ -1,0 +1,232 @@
+"""Ring attention with a Pallas flash inner: ICI ring outside, MXU tiles inside.
+
+The XLA-level ring (``parallel.ring_attention``) materializes an
+``[S_local, S_local]`` score matrix in HBM per rotation — correct, but the
+same HBM-traffic wall the flash kernel exists to remove, just one ring step
+at a time. This module closes that gap (the "future work" recorded in
+``docs/PERF_ANALYSIS.md`` §8): each rotation runs the full Pallas flash
+kernel (``ops.pallas.flash_attention``) on the resident Q shard against the
+visiting K/V block, so scores only ever live in VMEM, and the per-shard
+partial outputs are recombined across rotations with the standard
+logsumexp-weighted merge ("flash decoding" style):
+
+    lse_new = logaddexp(lse, lse_b)
+    o_new   = o * exp(lse - lse_new) + o_b * exp(lse_b - lse_new)
+
+Causality never needs masks across shards: a visiting block is either
+entirely in the Q shard's past (full non-causal kernel), the diagonal
+(causal kernel in local coordinates — both shards share one global offset),
+or entirely in the future (skipped — ``lax.switch`` keeps shapes static).
+
+Backward is a custom VJP implementing the standard ring-attention backward:
+a second ring pass in which dK/dV accumulators travel *with* their K/V
+blocks (f32, one full circle, so each block returns home carrying every
+device's contribution) while dQ accumulates locally; each rotation runs the
+FlashAttention-2 backward kernels with the forward's *global* per-row
+logsumexp, which makes every per-block ``p = exp(s − lse)`` tile globally
+normalized — no second online softmax is needed.
+
+No reference analog (the reference has no attention — SURVEY.md §5.7).
+The dense op is the oracle in tests; the XLA ring is the fallback when the
+local sequence doesn't tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning_mpi_tpu.ops.attention import NEG_INF
+from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+    fit_block,
+    flash_bwd_block,
+    flash_fwd_block,
+    usable_blocks,
+)
+from deeplearning_mpi_tpu.runtime.mesh import AXIS_SEQ
+
+
+def _merge(o, lse, o_b, lse_b):
+    """Logsumexp-weighted recombination of normalized partial outputs.
+
+    ``o`` f32 ``[B, S, H, D]``, ``lse`` f32 ``[B, S, H]``; ``_b`` are one
+    block's partials. NEG_INF is finite, so never-updated rows stay exactly
+    zero through ``logaddexp`` without NaN special-casing.
+    """
+    lse_new = jnp.logaddexp(lse, lse_b)
+    w = jnp.exp(lse - lse_new)[..., None]
+    w_b = jnp.exp(lse_b - lse_new)[..., None]
+    return o * w + o_b.astype(jnp.float32) * w_b, lse_new
+
+
+def _block_fwd(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
+    """One visiting block through the flash kernel → (o_b, lse_b rows)."""
+    o_b, lse128 = flash_fwd_block(
+        q, k_blk, v_blk, causal, block_q, block_k, interpret, with_lse=True
+    )
+    # lane-replicated [B, H, S, 128] -> per-row [B, S, H]
+    return o_b, lse128[..., 0].transpose(0, 2, 1)
+
+
+def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret):
+    """All n rotations; returns (o f32 [B,S,H,D], lse f32 [B,S,H])."""
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    batch, s_local, heads, head_dim = q.shape
+    block = functools.partial(
+        _block_fwd, block_q=block_q, block_k=block_k, interpret=interpret
+    )
+    o0 = jnp.zeros((batch, s_local, heads, head_dim), jnp.float32)
+    lse0 = jnp.full((batch, s_local, heads), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def update(src, k_blk, v_blk, o, lse):
+        if not causal:
+            o_b, lse_b = block(q, k_blk, v_blk, causal=False)
+            return _merge(o, lse, o_b, lse_b)
+
+        def skip(o, lse):
+            return o, lse
+
+        def diagonal(o, lse):
+            o_b, lse_b = block(q, k_blk, v_blk, causal=True)
+            return _merge(o, lse, o_b, lse_b)
+
+        def full(o, lse):
+            o_b, lse_b = block(q, k_blk, v_blk, causal=False)
+            return _merge(o, lse, o_b, lse_b)
+
+        # src > my_idx: the visiting block is entirely in this shard's future.
+        case = jnp.where(src == my_idx, 1, jnp.where(src < my_idx, 2, 0))
+        return lax.switch(case, [skip, diagonal, full], o, lse)
+
+    def ring_step(t, carry):
+        k_blk, v_blk, o, lse = carry
+        # Issue the next transfer before this step's kernels — XLA's
+        # latency-hiding scheduler overlaps the collective-permute DMA with
+        # the flash compute (double-buffered ring, as in ring_attention).
+        k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+        o, lse = update((my_idx - t) % n, k_blk, v_blk, o, lse)
+        return k_nxt, v_nxt, o, lse
+
+    # n-1 rotations in the loop; the last block's update outside so its
+    # (discarded) transfer is never issued — 1/n of the ring's ICI volume.
+    k, v, o, lse = lax.fori_loop(0, n - 1, ring_step, (k, v, o0, lse0))
+    return update((my_idx - (n - 1)) % n, k, v, o, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret):
+    o, _ = _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret)
+    return o.astype(q.dtype)
+
+
+def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret):
+    o, lse = _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret)
+    o = o.astype(q.dtype)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    # The kernels take the lane-replicated layout; one broadcast outside the
+    # ring loop (lse is rotation-invariant — it is already global).
+    lse_bhs = lse.transpose(0, 2, 1)
+    lse128 = jnp.broadcast_to(lse_bhs[..., None], (*lse_bhs.shape, 128))
+    # grad_dtype=f32: each per-rotation partial leaves the kernel already in
+    # f32 — rounding it to bf16 first would defeat the f32 accumulators.
+    bwd = functools.partial(
+        flash_bwd_block,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        grad_dtype=jnp.float32,
+    )
+    zeros = lambda ref: jnp.zeros(ref.shape, jnp.float32)  # noqa: E731
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def update(src, k_blk, v_blk, dq, dk, dv):
+        def skip(dq, dk, dv):
+            return dq, dk, dv
+
+        def accumulate(blk_causal):
+            def go(dq, dk, dv):
+                dq_b, dk_b, dv_b = bwd(
+                    q, k_blk, v_blk, o, do, lse128, causal=blk_causal
+                )
+                return dq + dq_b, dk + dk_b, dv + dv_b
+
+            return go
+
+        if not causal:
+            return accumulate(False)(dq, dk, dv)
+        case = jnp.where(src == my_idx, 1, jnp.where(src < my_idx, 2, 0))
+        return lax.switch(
+            case, [skip, accumulate(True), accumulate(False)], dq, dk, dv
+        )
+
+    def ring_step(t, carry):
+        k_blk, v_blk, dq, dk, dv = carry
+        k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+        dq, dk, dv = update((my_idx - t) % n, k_blk, v_blk, dq, dk, dv)
+        # dK/dV accumulators travel WITH their block (f32 — n-step
+        # accumulation in bf16 would drift; the doubled ppermute bytes are
+        # the documented cost of exactness).
+        dk = lax.ppermute(dk, axis_name, perm=perm)
+        dv = lax.ppermute(dv, axis_name, perm=perm)
+        return k_nxt, v_nxt, dq, dk, dv
+
+    k_l, v_l, dq, dk, dv = lax.fori_loop(
+        0, n - 1, ring_step, (k, v, zeros(q), zeros(k), zeros(v))
+    )
+    # Last block: no K/V transfer to issue, but dK/dV still need their final
+    # hop to complete the circle home.
+    dq, dk, dv = update((my_idx - (n - 1)) % n, k_l, v_l, dq, dk, dv)
+    dk = lax.ppermute(dk, axis_name, perm=perm)
+    dv = lax.ppermute(dv, axis_name, perm=perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    axis_name: str = AXIS_SEQ,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Ring attention over sequence shards with the Pallas flash inner.
+
+    Same contract as :func:`~deeplearning_mpi_tpu.parallel.ring_attention.
+    ring_attention` (call inside shard_map on ``[B, S_local, H, D]`` shards);
+    local sequences the blocks can't tile fall back to the XLA ring.
+    """
+    seq = q.shape[1]
+    bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
+    if not usable_blocks(bq, bk, seq):
+        from deeplearning_mpi_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=causal, axis_name=axis_name)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if lax.axis_size(axis_name) == 1:
+        # Degenerate ring: the plain flash entry skips the primal lse write
+        # (the ring needs lse for its cross-rotation merge; one shard has
+        # nothing to merge).
+        from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, block_q=bq, block_k=bk, interpret=interpret
+        )
+    return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret)
