@@ -54,6 +54,7 @@ def test_rectangular_blocks():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("bq,bk", [(32, 16), (16, 32)], ids=["wide_q", "wide_k"])
 def test_rectangular_block_grads(bq, bk):
     """Gradients with block_q != block_k: locks in the two backward kernels'

@@ -41,12 +41,14 @@ class TestResNet:
         variables = init_model(model, (1, 32, 32, 3))
         assert n_params(variables["params"]) == 23_528_522
 
+    @pytest.mark.slow
     def test_cifar_stem_keeps_resolution(self):
         model = resnet18(num_classes=10, stem="cifar")
         variables = init_model(model, (1, 32, 32, 3))
         out = model.apply(variables, jnp.zeros((1, 32, 32, 3)), train=False)
         assert out.shape == (1, 10)
 
+    @pytest.mark.slow
     def test_bf16_compute_f32_params(self):
         model = resnet18(num_classes=10, dtype=jnp.bfloat16)
         variables = init_model(model, (1, 32, 32, 3))
@@ -89,6 +91,7 @@ class TestUNet:
         count = n_params(variables["params"])
         assert 30_000_000 < count < 32_000_000
 
+    @pytest.mark.slow
     def test_bilinear_variant(self):
         model = UNet(out_classes=1, bilinear=True)
         variables = init_model(model, (1, 64, 64, 3))

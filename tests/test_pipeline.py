@@ -181,6 +181,7 @@ class TestPipelinedLM:
         assert np.isfinite(stats[0]["loss"])
         assert stats[-1]["loss"] < stats[0]["loss"]
 
+    @pytest.mark.slow
     def test_moe_matches_flat_moe(self):
         """PP+MoE: logits equal the flat MoE LM with remapped weights, and the
         pipelined aux loss equals the mean of the flat model's per-microbatch

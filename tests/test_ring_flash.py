@@ -42,6 +42,7 @@ def test_matches_dense_oracle(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
 def test_grads_match_dense(causal):
     """The custom ring VJP (dK/dV riding the ring home, global-lse backward
@@ -67,7 +68,11 @@ def test_single_shard_ring_is_one_flash_call():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-@pytest.mark.parametrize("block", [16, 1024], ids=["small-block", "default-block"])
+@pytest.mark.parametrize(
+    "block",
+    [pytest.param(16, marks=pytest.mark.slow), 1024],
+    ids=["small-block", "default-block"],
+)
 def test_untileable_local_seq_falls_back_to_xla_ring(block):
     """S_local=20 cannot tile (no sublane-aligned divisor — with the default
     block it 'fits' as one 20-row block, which Mosaic would reject): the
@@ -79,6 +84,7 @@ def test_untileable_local_seq_falls_back_to_xla_ring(block):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bf16_grads_close_to_dense():
     """bf16 path: per-rotation grad partials leave the kernels in f32
     (grad_dtype) before the ring accumulation — tolerances are bf16-input
