@@ -62,6 +62,7 @@ def main(argv: list[str] | None = None) -> int:
     preflight(
         model_dir=args.model_dir, log_dir=args.log_dir,
         global_batch_size=args.batch_size, mesh=mesh,
+        grad_accum=args.grad_accum,
     )
 
     import jax
@@ -144,7 +145,7 @@ def main(argv: list[str] | None = None) -> int:
         model = TransformerLM(
             config=cfg, dtype=dtype, attention_fn=attention_fn, remat=args.remat,
         )
-    tx = build_optimizer("adam", args.learning_rate, clip_norm=1.0)
+    tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=1.0)
 
     def state_factory():
         return create_train_state(
@@ -170,7 +171,7 @@ def main(argv: list[str] | None = None) -> int:
         state, "lm", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
         aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
-        zero=args.zero,
+        grad_accum=args.grad_accum, zero=args.zero,
     )
     trainer.place_state()
     config.build_observability(args, trainer)

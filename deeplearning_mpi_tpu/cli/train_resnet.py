@@ -57,6 +57,7 @@ def main(argv: list[str] | None = None) -> int:
         data_dir=None if args.synthetic else args.data_dir,
         model_dir=args.model_dir, log_dir=args.log_dir,
         global_batch_size=args.batch_size, mesh=mesh,
+        grad_accum=args.grad_accum,
     )
 
     import jax
@@ -108,7 +109,7 @@ def main(argv: list[str] | None = None) -> int:
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
     )
     tx = build_optimizer(
-        "sgd", args.learning_rate,
+        "sgd", config.build_lr(args, train_loader),
         momentum=args.momentum, weight_decay=args.weight_decay,
     )
     def state_factory():
@@ -133,7 +134,7 @@ def main(argv: list[str] | None = None) -> int:
     trainer = Trainer(
         state, "classification", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
-        zero=args.zero,
+        grad_accum=args.grad_accum, zero=args.zero,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
     config.build_observability(args, trainer)

@@ -67,6 +67,7 @@ def main(argv: list[str] | None = None) -> int:
         data_dir=None if (args.synthetic or args.volumetric) else args.data_dir,
         model_dir=args.model_dir, log_dir=args.log_dir,
         global_batch_size=args.batch_size, mesh=mesh,
+        grad_accum=args.grad_accum,
     )
 
     import jax
@@ -140,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
         spatial_dims=3 if args.volumetric else 2,
         remat=args.remat,
     )
-    tx = build_optimizer("adam", args.learning_rate, clip_norm=args.clip_norm)
+    tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=args.clip_norm)
 
     def state_factory():
         return create_train_state(
@@ -165,7 +166,7 @@ def main(argv: list[str] | None = None) -> int:
     trainer = Trainer(
         state, "segmentation", mesh,
         logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
-        zero=args.zero,
+        grad_accum=args.grad_accum, zero=args.zero,
     )
     trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
     config.build_observability(args, trainer)

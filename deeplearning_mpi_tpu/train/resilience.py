@@ -138,6 +138,7 @@ def preflight(
     log_dir: str | None = None,
     global_batch_size: int | None = None,
     mesh: Any = None,
+    grad_accum: int = 1,
 ) -> None:
     """Fail fast with specific messages before any compilation starts.
 
@@ -166,5 +167,17 @@ def preflight(
                 f"global batch {global_batch_size} not divisible by "
                 f"data-parallel degree {dp}"
             )
+        if grad_accum > 1:
+            if global_batch_size % grad_accum:
+                problems.append(
+                    f"global batch {global_batch_size} not divisible by "
+                    f"grad_accum {grad_accum}"
+                )
+            elif (global_batch_size // grad_accum) % dp:
+                problems.append(
+                    f"per-chunk batch {global_batch_size // grad_accum} "
+                    f"(global {global_batch_size} / grad_accum {grad_accum}) "
+                    f"not divisible by data-parallel degree {dp}"
+                )
     if problems:
         raise SystemExit("preflight failed:\n  - " + "\n  - ".join(problems))

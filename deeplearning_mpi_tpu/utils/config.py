@@ -52,6 +52,16 @@ def add_training_flags(
     group.add_argument("--num_epochs", type=int, default=num_epochs)
     group.add_argument("--batch_size", type=int, default=batch_size, help="GLOBAL batch size")
     group.add_argument("--learning_rate", type=float, default=learning_rate)
+    group.add_argument("--lr_schedule", default="constant",
+                       choices=("constant", "cosine", "linear"),
+                       help="LR over steps: constant (reference parity), "
+                       "warmup+cosine decay, or warmup+linear decay")
+    group.add_argument("--warmup_steps", type=int, default=0,
+                       help="linear LR warmup from 0 (any --lr_schedule)")
+    group.add_argument("--grad_accum", type=int, default=1,
+                       help="gradient-accumulation chunks per optimizer step "
+                       "(global batch is split evenly; loss-mean semantics "
+                       "preserved)")
     group.add_argument("--random_seed", type=int, default=random_seed)
     group.add_argument("--model_dir", default=model_dir)
     group.add_argument("--model_filename", default=model_filename)
@@ -92,6 +102,22 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        "MLP per block (shard with --ep when training)")
     group.add_argument("--moe_top_k", type=int, default=2)
     return group
+
+
+def build_lr(args: argparse.Namespace, train_loader) -> object:
+    """Resolve the shared LR flags into what ``build_optimizer`` takes.
+
+    ``--lr_schedule constant`` with no warmup stays a bare float (reference
+    parity); the decaying schedules span the planned optimizer steps
+    (``loader.steps_per_epoch() * --num_epochs``).
+    """
+    from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+    return build_lr_schedule(
+        args.learning_rate, args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        decay_steps=train_loader.steps_per_epoch() * args.num_epochs,
+    )
 
 
 def setup_runtime(args: argparse.Namespace):

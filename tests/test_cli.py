@@ -28,8 +28,12 @@ RESNET_ARGS = [
 
 class TestTrainResnetCLI:
     def test_one_epoch_synthetic(self, tmp_path):
+        # --grad_accum / --lr_schedule ride along so the argparse ->
+        # build_lr -> Trainer wiring is exercised end-to-end.
         rc = train_resnet.main(RESNET_ARGS + [
             "--num_epochs", "1",
+            "--grad_accum", "2",
+            "--lr_schedule", "cosine", "--warmup_steps", "1",
             "--model_dir", str(tmp_path / "ckpt"),
             "--log_dir", str(tmp_path / "logs"),
         ])

@@ -152,6 +152,15 @@ class TestPreflight:
             preflight(global_batch_size=12, mesh=mesh)
         preflight(global_batch_size=16, mesh=mesh)  # ok
 
+    def test_grad_accum_divisibility(self, mesh):
+        # 8-device data axis: batch 32 / grad_accum 5 doesn't divide; 32/8
+        # divides the batch but leaves per-chunk 4 < dp 8.
+        with pytest.raises(SystemExit, match="grad_accum 5"):
+            preflight(global_batch_size=32, mesh=mesh, grad_accum=5)
+        with pytest.raises(SystemExit, match="per-chunk batch"):
+            preflight(global_batch_size=32, mesh=mesh, grad_accum=8)
+        preflight(global_batch_size=32, mesh=mesh, grad_accum=2)  # ok
+
 
 class TestExecuteTraining:
     """The CLI tail: donated-state rebuild on pre-checkpoint crashes."""

@@ -55,6 +55,59 @@ class TestTrainStep:
         assert np.isfinite(float(metrics["loss"]))
         assert float(metrics["finite"]) == 1.0
 
+    def test_grad_accum_matches_full_batch(self):
+        """On a batch-stat-free model, grad_accum=4 must produce the same
+        update as one full-batch step (mean of equal-sized chunk means ==
+        full-batch mean), modulo f32 summation order."""
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+
+        # Plain SGD: the update is linear in the grads, so the only allowed
+        # difference is f32 summation order. (Adam at step 1 is ~sign(g)*lr,
+        # which amplifies associativity noise on near-zero grads.)
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+        def fresh():
+            return create_train_state(
+                model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+            )
+
+        batch = {
+            "tokens": jnp.asarray(
+                np.random.default_rng(0).integers(0, 256, (8, 16)), jnp.int32
+            )
+        }
+        s1, m1 = make_train_step("lm", donate=False)(fresh(), batch)
+        s4, m4 = make_train_step("lm", donate=False, grad_accum=4)(fresh(), batch)
+        np.testing.assert_allclose(float(m4["loss"]), float(m1["loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s4.params), jax.tree.leaves(s1.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_grad_accum_batchnorm_chunks_stats(self):
+        """With BatchNorm, each chunk normalizes over its own examples (the
+        same semantics as DDP's per-replica BN stats), so chunked training is
+        deliberately NOT bit-equal to full-batch — but it must stay close and
+        must advance the EMA stats off init."""
+        batch = make_batch()
+        s1, m1 = make_train_step("classification", donate=False)(
+            make_state(), batch
+        )
+        s4, m4 = make_train_step("classification", donate=False, grad_accum=4)(
+            make_state(), batch
+        )
+        np.testing.assert_allclose(float(m4["loss"]), float(m1["loss"]), rtol=0.02)
+        init_stats = jax.tree.leaves(make_state().batch_stats)
+        moved = [
+            not np.allclose(np.asarray(x), np.asarray(y))
+            for x, y in zip(jax.tree.leaves(s4.batch_stats), init_stats)
+        ]
+        assert any(moved)
+
+    def test_grad_accum_indivisible_raises(self):
+        step = make_train_step("classification", donate=False, grad_accum=3)
+        with pytest.raises(ValueError, match="divisible"):
+            step(make_state(), make_batch(n=16))
+
     def test_params_change(self):
         state = make_state()
         step = make_train_step("classification", donate=False)
@@ -274,3 +327,54 @@ class TestTrainerEndToEnd:
         restored = ckpt2.restore(make_state(seed=5))
         assert int(restored.step) == steps_after_one_epoch
         ckpt2.close()
+
+
+class TestLRSchedule:
+    def test_constant_is_bare_float(self):
+        from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+        assert build_lr_schedule(0.1, "constant") == 0.1
+
+    def test_warmup_then_cosine(self):
+        from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+        sched = build_lr_schedule(0.1, "cosine", warmup_steps=10, decay_steps=100)
+        assert float(sched(0)) == 0.0
+        np.testing.assert_allclose(float(sched(10)), 0.1, rtol=1e-6)
+        assert float(sched(55)) < 0.1
+        np.testing.assert_allclose(float(sched(100)), 0.0, atol=1e-8)
+
+    def test_linear_and_warmup_constant(self):
+        from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+        lin = build_lr_schedule(0.2, "linear", warmup_steps=4, decay_steps=24)
+        np.testing.assert_allclose(float(lin(4)), 0.2, rtol=1e-6)
+        np.testing.assert_allclose(float(lin(14)), 0.1, rtol=1e-5)
+        const = build_lr_schedule(0.2, "constant", warmup_steps=4)
+        np.testing.assert_allclose(float(const(2)), 0.1, rtol=1e-5)
+        np.testing.assert_allclose(float(const(400)), 0.2, rtol=1e-6)
+
+    def test_decay_shorter_than_warmup_raises(self):
+        from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+        with pytest.raises(ValueError, match="decay_steps"):
+            build_lr_schedule(0.1, "cosine", warmup_steps=50, decay_steps=40)
+
+    def test_scheduled_optimizer_trains(self):
+        """End-to-end: a cosine schedule drives the SGD step (optax resolves
+        the LR from the optimizer step count inside state.tx)."""
+        from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
+
+        tx = build_optimizer(
+            "sgd",
+            build_lr_schedule(0.05, "cosine", warmup_steps=2, decay_steps=20),
+            momentum=0.9,
+        )
+        state = make_state(tx=tx)
+        step = make_train_step("classification", donate=False)
+        batch = make_batch()
+        p0 = jax.tree.leaves(state.params)[0].copy()
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert not np.allclose(np.asarray(jax.tree.leaves(state.params)[0]), np.asarray(p0))
