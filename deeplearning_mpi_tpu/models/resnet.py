@@ -11,10 +11,16 @@ TPU-first choices:
 - NHWC layout end-to-end (MXU-friendly; no layout transposes).
 - ``dtype=bfloat16`` computes convs/matmuls on the MXU at 2× f32 throughput
   while keeping parameters and BN statistics in float32.
-- BatchNorm uses **local** (per-replica) batch statistics by default —
-  exactly DDP's semantics, which never sync BN stats
-  (``pytorch/unet/model.py:10,13``; SURVEY.md §2c) — and cross-replica sync
-  BN via ``bn_cross_replica_axis='data'`` as an opt-in improvement.
+- BatchNorm statistics are **global-batch** under data parallelism — a
+  deliberate, verified deviation from DDP's never-synced local stats
+  (``pytorch/unet/model.py:10,13``; SURVEY.md §2c). Under GSPMD the program
+  keeps unsharded semantics: the batch-mean over a ``data``-sharded array
+  IS the global mean (XLA inserts the reduction), so sharded training
+  matches single-device training to reduction-reordering tolerance — the
+  stronger guarantee, pinned by the DP≡single-device test
+  (``tests/test_train.py``, atol 2e-5). DDP's local stats
+  are an artifact of its replica model; reproducing them here would mean
+  wrapping every norm in shard_map to *break* the global semantics.
 - The stem is switchable: ``stem='imagenet'`` is the torchvision-parity 7×7/2
   + maxpool (what the reference runs on CIFAR-10, ``main.py:40``);
   ``stem='cifar'`` is the standard 3×3/1 CIFAR variant, offered because on
@@ -93,7 +99,6 @@ class ResNet(nn.Module):
     num_filters: int = 64
     stem: str = "imagenet"
     dtype: jnp.dtype = jnp.float32
-    bn_cross_replica_axis: str | None = None
     bn_momentum: float = 0.9  # = 1 - torch momentum 0.1
     bn_epsilon: float = 1e-5
 
@@ -113,7 +118,6 @@ class ResNet(nn.Module):
             epsilon=self.bn_epsilon,
             dtype=self.dtype,
             param_dtype=jnp.float32,
-            axis_name=self.bn_cross_replica_axis,
         )
 
         x = x.astype(self.dtype)

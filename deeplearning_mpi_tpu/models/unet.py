@@ -13,9 +13,10 @@ Deviations from the reference, on purpose:
 - NHWC instead of NCHW (TPU-native layout; concat axis is -1 not 1).
 - Convs before BatchNorm drop their bias (redundant with BN's shift; the
   reference keeps torch's default bias=True).
-- BatchNorm uses local per-replica statistics by default — DDP parity
-  (SURVEY.md §2c) — with opt-in cross-replica sync via
-  ``bn_cross_replica_axis``.
+- BatchNorm statistics are global-batch under data parallelism (GSPMD
+  keeps unsharded semantics, so sharded ≡ single-device) — a deliberate
+  deviation from DDP's never-synced local stats; see the fuller note in
+  ``models/resnet.py``.
 
 Beyond-parity extensions (BASELINE.md config ladder #5 "3-D UNet with mixed
 precision + gradient checkpointing" — the reference is 2-D fp32 only):
@@ -75,7 +76,6 @@ class UNet(nn.Module):
     features: Sequence[int] = (64, 128, 256, 512)
     bilinear: bool = False
     dtype: jnp.dtype = jnp.float32
-    bn_cross_replica_axis: str | None = None
     bn_momentum: float = 0.9
     bn_epsilon: float = 1e-5
     spatial_dims: int = 2  # 2 = NHWC images, 3 = NDHWC volumes
@@ -104,7 +104,6 @@ class UNet(nn.Module):
             epsilon=self.bn_epsilon,
             dtype=self.dtype,
             param_dtype=jnp.float32,
-            axis_name=self.bn_cross_replica_axis,
         )
         double_cls = nn.remat(DoubleConv) if self.remat else DoubleConv
         double = functools.partial(double_cls, conv=conv, norm=norm)
