@@ -121,24 +121,18 @@ def main(argv: list[str] | None = None) -> int:
     state = state_factory()
 
     checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
-    start_epoch = 0
-    if args.resume:
-        latest = checkpointer.latest_epoch()
-        if latest is None:
-            logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
-        else:
-            state = checkpointer.restore(state)
-            start_epoch = latest + 1
-            logger.log(f"resumed from epoch {latest} (step {int(state.step)})")
-
-    trainer = Trainer(
-        state, "classification", mesh,
-        logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
-        grad_accum=args.grad_accum, zero=args.zero,
-    )
-    trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
-    config.build_observability(args, trainer)
+    # restore_for_start can SystemExit (--eval_only with no checkpoint); it
+    # must do so inside the try or the other hosts hang at their next
+    # collective (bootstrap.shutdown never runs) and orbax threads leak.
     try:
+        state, start_epoch = config.restore_for_start(args, checkpointer, state, logger)
+        trainer = Trainer(
+            state, "classification", mesh,
+            logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
+            grad_accum=args.grad_accum, zero=args.zero,
+        )
+        trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
+        config.build_observability(args, trainer)
         config.execute_training(
             trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
             state_factory=state_factory,

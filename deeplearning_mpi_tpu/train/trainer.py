@@ -397,6 +397,21 @@ class Trainer:
         )
         return stats
 
+    def report_eval(self, stats: dict[str, float], *, note: str | None = None) -> None:
+        """Record + log a standalone evaluation (the ``--eval_only`` path).
+
+        Keeps result reporting owned by the Trainer: the stats join
+        ``self.history`` (what ``fit`` returns) instead of a side channel.
+        """
+        if note:
+            self._log(note)
+        if stats:
+            self.history.append(dict(stats))
+            self._log(
+                "Eval-only: "
+                + ", ".join(f"{k} {v:.4f}" for k, v in sorted(stats.items()))
+            )
+
     def evaluate(self, loader: Any) -> dict[str, float]:
         """Collective evaluation over the full loader (all processes/devices).
 

@@ -66,6 +66,12 @@ def add_training_flags(
     group.add_argument("--model_dir", default=model_dir)
     group.add_argument("--model_filename", default=model_filename)
     group.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --model_dir (full state: step + optimizer too, unlike the reference's weights-only resume, train.py:342-345)")
+    group.add_argument("--eval_only", action="store_true",
+                       help="restore the latest checkpoint and run one "
+                       "evaluation pass over the eval split, then exit — "
+                       "no training (the reference has no standalone eval). "
+                       "The train split is still opened (the CLIs build both "
+                       "loaders up front); accepted cost for a rare mode")
     group.add_argument("--log_dir", default="logs")
     group.add_argument("--eval_every", type=int, default=10, help="epochs between evals/checkpoints (reference cadence: resnet/main.py:136)")
     group.add_argument("--dtype", default="float32", choices=("float32", "bfloat16"), help="compute dtype (params stay float32)")
@@ -113,11 +119,41 @@ def build_lr(args: argparse.Namespace, train_loader) -> object:
     """
     from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
 
+    if getattr(args, "eval_only", False):
+        # No optimizer step ever runs; a constant keeps the restore template
+        # valid without touching the loader.
+        return args.learning_rate
     return build_lr_schedule(
         args.learning_rate, args.lr_schedule,
         warmup_steps=args.warmup_steps,
         decay_steps=train_loader.steps_per_epoch() * args.num_epochs,
     )
+
+
+def restore_for_start(args, checkpointer, state, logger):
+    """Shared --resume / --eval_only restore; returns (state, start_epoch).
+
+    ``--eval_only`` is resume-or-die: evaluating a fresh random init would
+    silently report garbage metrics, so a missing checkpoint is an error.
+    ``--resume`` keeps the reference's lenient start-fresh behavior.
+    """
+    latest = checkpointer.latest_epoch()
+    if getattr(args, "eval_only", False):
+        if latest is None:
+            raise SystemExit(
+                f"--eval_only: no checkpoint under {checkpointer.directory}"
+            )
+        state = checkpointer.restore(state)
+        logger.log(f"eval-only: restored epoch {latest} (step {int(state.step)})")
+        return state, latest + 1
+    if args.resume:
+        if latest is None:
+            logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
+        else:
+            state = checkpointer.restore(state)
+            logger.log(f"resumed from epoch {latest} (step {int(state.step)})")
+            return state, latest + 1
+    return state, 0
 
 
 def setup_runtime(args: argparse.Namespace):
@@ -188,6 +224,22 @@ def execute_training(
     mid-step leaves ``trainer.state`` deleted and unusable.
     """
     from deeplearning_mpi_tpu.train.resilience import run_with_auto_resume
+
+    if getattr(args, "eval_only", False):
+        # The CLI upgraded --eval_only to a restore (resume-or-die): by here
+        # trainer.state holds checkpoint weights. One collective eval pass.
+        try:
+            if trainer.profiler is not None:
+                trainer.report_eval(
+                    {}, note="--profile_dir is a no-op with --eval_only "
+                    "(tracing hooks live in the train loop)"
+                )
+            stats = trainer.evaluate(eval_loader)
+            trainer.report_eval(stats)
+            return [stats]
+        finally:
+            if trainer.heartbeat is not None:
+                trainer.heartbeat.stop()
 
     if args.max_restarts > 0 and state_factory is None:
         # Without a factory, a pre-checkpoint crash would retry on the

@@ -22,6 +22,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's cost is dominated by XLA:CPU
+# compiles of many distinct jitted programs on this box's single core, and
+# the cache works for CPU executables too (measured: a tiny-ResNet
+# init+apply drops 21.7s -> 4.0s process wall on the second run). First run
+# populates `.jax_cache/` (gitignored); every later run — including the
+# driver's — pays only trace time for unchanged programs. A changed program
+# gets a new key, so the cache can't mask a code change.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 import pytest  # noqa: E402
 
 

@@ -29,9 +29,13 @@ RESNET_ARGS = [
 class TestTrainResnetCLI:
     def test_one_epoch_synthetic(self, tmp_path):
         # --grad_accum / --lr_schedule ride along so the argparse ->
-        # build_lr -> Trainer wiring is exercised end-to-end.
+        # build_lr -> Trainer wiring is exercised end-to-end. Batch 16 (not
+        # the shared 8): per-chunk batch must still divide the 8-way data
+        # axis, which preflight now enforces.
+        # 32 samples / batch 16 = 2 optimizer steps, so decay_steps (2)
+        # clears the warmup (1) — build_lr rejects degenerate schedules.
         rc = train_resnet.main(RESNET_ARGS + [
-            "--num_epochs", "1",
+            "--num_epochs", "1", "--batch_size", "16", "--train_samples", "32",
             "--grad_accum", "2",
             "--lr_schedule", "cosine", "--warmup_steps", "1",
             "--model_dir", str(tmp_path / "ckpt"),
@@ -52,6 +56,25 @@ class TestTrainResnetCLI:
         logs = _read_logs(tmp_path / "logs")
         assert "resumed from epoch 0" in logs
         assert "Epoch 1: loss" in logs  # picked up where it left off
+
+    def test_eval_only(self, tmp_path):
+        args = RESNET_ARGS + [
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]
+        assert train_resnet.main(args + ["--num_epochs", "1"]) == 0
+        assert train_resnet.main(args + ["--eval_only"]) == 0
+        logs = _read_logs(tmp_path / "logs")
+        assert "eval-only: restored epoch 0" in logs
+        assert "Eval-only: accuracy" in logs
+
+    def test_eval_only_without_checkpoint_fails(self, tmp_path):
+        with pytest.raises(SystemExit, match="no checkpoint"):
+            train_resnet.main(RESNET_ARGS + [
+                "--eval_only",
+                "--model_dir", str(tmp_path / "nope"),
+                "--log_dir", str(tmp_path / "logs"),
+            ])
 
     def test_zero_optimizer_sharding(self, tmp_path):
         rc = train_resnet.main(RESNET_ARGS + [

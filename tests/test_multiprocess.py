@@ -16,6 +16,7 @@ reference's torchrun one-process-per-accelerator layout).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -36,6 +37,14 @@ def _free_port() -> int:
 def _spawn_workers(n: int, out_dir: Path, local_devices: int = 2,
                    timeout: float = 300.0) -> list[dict]:
     port = _free_port()
+    # The workers run a script by path, so Python puts tests/helpers/ (not
+    # the cwd) on sys.path — the repo root must ride PYTHONPATH explicitly
+    # or the package import only works when the ambient environment happens
+    # to provide it.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH", "")) if p
+    )
     procs = [
         subprocess.Popen(
             [
@@ -50,6 +59,7 @@ def _spawn_workers(n: int, out_dir: Path, local_devices: int = 2,
             stderr=subprocess.STDOUT,
             text=True,
             cwd=REPO,
+            env=env,
         )
         for i in range(n)
     ]
