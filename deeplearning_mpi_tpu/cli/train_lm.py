@@ -132,6 +132,7 @@ def main(argv: list[str] | None = None) -> int:
         d_ff=args.d_ff,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
+        moe_routing=args.moe_routing,
     )
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.pp > 1:

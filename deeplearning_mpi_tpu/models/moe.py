@@ -56,6 +56,7 @@ def mlp_cls_from_config(config: Any) -> Any:
         num_experts=config.moe_experts,
         top_k=config.moe_top_k,
         capacity_factor=config.moe_capacity_factor,
+        routing=getattr(config, "moe_routing", "token_choice"),
     )
 
 
@@ -73,13 +74,27 @@ def collect_aux_loss(variables: dict[str, Any]) -> jax.Array:
 
 
 class MoEMLP(nn.Module):
-    """Top-k routed mixture of SwiGLU experts, fixed capacity per expert.
+    """Routed mixture of SwiGLU experts, fixed capacity per expert.
 
     Drop-in for :class:`SwiGLU` in a transformer block: same
     ``(d_ff, dtype)`` leading attributes, same ``[B, S, d] -> [B, S, d]``
     contract. Expert weights live in stacked parameters named ``experts_*``
     with a leading ``[num_experts, ...]`` dim — the path marker + shape the
     expert-parallel sharding rule keys on.
+
+    Two routing disciplines share the dispatch/combine tensor contract:
+
+    - ``routing='token_choice'`` (default, GShard/Switch): each token picks
+      its top-k experts; over-capacity tokens drop; a sown load-balance aux
+      loss (Switch eq. 4) discourages collapse.
+    - ``routing='expert_choice'`` (Zhou et al. 2022): each expert picks its
+      top-C tokens, so load is perfectly balanced BY CONSTRUCTION — no aux
+      loss is sown. Caveat for causal LMs: an expert's choice for position t
+      depends on the whole sequence (including t's future), so expert-choice
+      leaks future information through routing decisions — use it for
+      bidirectional/encoder stacks or accept the training-time leak
+      knowingly; KV-cached decoding of an EC-trained model will also see a
+      train/infer routing mismatch.
     """
 
     d_ff: int
@@ -89,32 +104,22 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     #: renormalize the selected top-k gates to sum to 1 per token.
     normalize_gates: bool = True
+    routing: str = "token_choice"
 
-    @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
-        batch, seq, d_model = x.shape
-        n_exp, k = self.num_experts, self.top_k
-        # Per-group (= per batch row) expert capacity. ceil so tiny test
-        # configs never round to zero; static because shapes are static.
-        capacity = max(1, math.ceil(k * seq * self.capacity_factor / n_exp))
-        capacity = min(capacity, seq)  # an expert can't hold more than all tokens
-
-        # --- Router (f32): probs, top-k selection -------------------------
-        router_logits = nn.Dense(
-            n_exp, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
-            name="router",
-        )(x.astype(jnp.float32))
-        probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E]
+    def _token_choice(self, probs: jax.Array, capacity: int):
+        """GShard dispatch: (combine [B,S,E,C] f32, aux scalar)."""
+        batch, seq, n_exp = probs.shape
+        k = self.top_k
         gates, expert_idx = jax.lax.top_k(probs, k)  # [B, S, k]
         if self.normalize_gates:
             gates = gates / jnp.maximum(
                 jnp.sum(gates, axis=-1, keepdims=True), 1e-9
             )
 
-        # --- Positions within each expert's capacity buffer ---------------
-        # Slot-by-slot (k is 1 or 2 in practice): tokens claim positions in
-        # routing order — sequence order within a slot, slot 0 before slot 1 —
-        # via exclusive cumsums. Over-capacity claims are dropped (GShard).
+        # Positions within each expert's capacity buffer. Slot-by-slot (k is
+        # 1 or 2 in practice): tokens claim positions in routing order —
+        # sequence order within a slot, slot 0 before slot 1 — via exclusive
+        # cumsums. Over-capacity claims are dropped (GShard).
         combine = jnp.zeros((batch, seq, n_exp, capacity), jnp.float32)
         count = jnp.zeros((batch, 1, n_exp), jnp.int32)  # claims so far per expert
         for slot in range(k):
@@ -127,16 +132,55 @@ class MoEMLP(nn.Module):
             )  # [B, S, E, C]
             combine = combine + gates[..., slot, None, None] * slot_dispatch
             count = count + jnp.sum(mask, axis=1, keepdims=True)
-        dispatch = (combine > 0.0).astype(x.dtype)  # [B, S, E, C]
 
-        # --- Load-balance aux loss (Switch Transformer eq. 4) --------------
-        # E * sum_e (fraction of tokens routed to e) * (mean router prob of e);
-        # 1.0 at perfect balance. Uses slot-0 (primary) assignments.
+        # Load-balance aux loss (Switch Transformer eq. 4):
+        # E * sum_e (fraction of tokens routed to e) * (mean router prob of
+        # e); 1.0 at perfect balance. Uses slot-0 (primary) assignments.
         primary = jax.nn.one_hot(expert_idx[..., 0], n_exp, dtype=jnp.float32)
         frac_tokens = jnp.mean(primary, axis=(0, 1))  # [E]
         mean_probs = jnp.mean(probs, axis=(0, 1))  # [E]
         aux = n_exp * jnp.sum(frac_tokens * mean_probs)
-        self.sow(AUX_COLLECTION, AUX_NAME, aux)
+        return combine, aux
+
+    def _expert_choice(self, probs: jax.Array, capacity: int):
+        """Expert-choice dispatch: (combine [B,S,E,C] f32, aux=None).
+
+        Each expert takes its top-``capacity`` tokens by router affinity —
+        every capacity slot is filled, nothing overflows, so there is no
+        balance loss to optimize.
+        """
+        _, seq, _ = probs.shape
+        affinity = probs.transpose(0, 2, 1)  # [B, E, S]
+        gates, token_idx = jax.lax.top_k(affinity, capacity)  # [B, E, C]
+        sel = jax.nn.one_hot(token_idx, seq, dtype=jnp.float32)  # [B, E, C, S]
+        dispatch = sel.transpose(0, 3, 1, 2)  # [B, S, E, C]
+        combine = dispatch * gates[:, None, :, :]  # weight by affinity
+        return combine, None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        batch, seq, d_model = x.shape
+        n_exp, k = self.num_experts, self.top_k
+        # Per-group (= per batch row) expert capacity. ceil so tiny test
+        # configs never round to zero; static because shapes are static.
+        capacity = max(1, math.ceil(k * seq * self.capacity_factor / n_exp))
+        capacity = min(capacity, seq)  # an expert can't hold more than all tokens
+
+        # --- Router (f32) --------------------------------------------------
+        router_logits = nn.Dense(
+            n_exp, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="router",
+        )(x.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E]
+        if self.routing == "expert_choice":
+            combine, aux = self._expert_choice(probs, capacity)
+        elif self.routing == "token_choice":
+            combine, aux = self._token_choice(probs, capacity)
+        else:
+            raise ValueError(f"unknown MoE routing '{self.routing}'")
+        dispatch = (combine > 0.0).astype(x.dtype)  # [B, S, E, C]
+        if aux is not None:
+            self.sow(AUX_COLLECTION, AUX_NAME, aux)
 
         # --- Expert computation (stacked SwiGLU, einsum-only) --------------
         # Stacked weights [E, ...]: leading dim shards over the mesh `expert`

@@ -215,6 +215,10 @@ class TransformerConfig:
     moe_experts: int = 0  # 0 = dense SwiGLU MLP
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    #: 'token_choice' (GShard top-k + aux loss) or 'expert_choice' (each
+    #: expert takes its top-C tokens; balanced by construction — see
+    #: MoEMLP's causality caveat before using it in a causal LM).
+    moe_routing: str = "token_choice"
 
     @staticmethod
     def tiny() -> "TransformerConfig":

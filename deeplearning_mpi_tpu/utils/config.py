@@ -107,6 +107,12 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        help="0 = dense SwiGLU MLP; N>1 swaps in a routed MoE "
                        "MLP per block (shard with --ep when training)")
     group.add_argument("--moe_top_k", type=int, default=2)
+    group.add_argument("--moe_routing", default="token_choice",
+                       choices=("token_choice", "expert_choice"),
+                       help="token_choice = GShard top-k + balance aux loss; "
+                       "expert_choice = each expert takes its top-C tokens "
+                       "(balanced by construction, but routing sees the "
+                       "whole sequence — leaks future context in causal LMs)")
     return group
 
 
@@ -119,10 +125,11 @@ def build_lr(args: argparse.Namespace, train_loader) -> object:
     """
     from deeplearning_mpi_tpu.train.trainer import build_lr_schedule
 
-    if getattr(args, "eval_only", False):
-        # No optimizer step ever runs; a constant keeps the restore template
-        # valid without touching the loader.
-        return args.learning_rate
+    # --eval_only must build the SAME schedule shape as training: a callable
+    # lr gives optax a ScaleByScheduleState(count) opt_state leaf where a
+    # bare float gives EmptyState, and the restore template must match the
+    # checkpoint's tree structure exactly (the schedule's values are
+    # irrelevant to eval — its *state shape* is not).
     return build_lr_schedule(
         args.learning_rate, args.lr_schedule,
         warmup_steps=args.warmup_steps,

@@ -58,7 +58,11 @@ class TestTrainResnetCLI:
         assert "Epoch 1: loss" in logs  # picked up where it left off
 
     def test_eval_only(self, tmp_path):
+        # A scheduled LR on BOTH runs: the schedule adds a
+        # ScaleByScheduleState leaf to opt_state, and eval_only must build
+        # the same tree shape or the orbax restore template mismatches.
         args = RESNET_ARGS + [
+            "--lr_schedule", "cosine", "--warmup_steps", "1",
             "--model_dir", str(tmp_path / "ckpt"),
             "--log_dir", str(tmp_path / "logs"),
         ]
