@@ -102,7 +102,9 @@ class MoEMLP(nn.Module):
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
-    #: renormalize the selected top-k gates to sum to 1 per token.
+    #: renormalize the selected top-k gates to sum to 1 per token
+    #: (token_choice only — expert_choice always weights by raw affinity,
+    #: the paper's formulation; there is no per-token gate set to normalize).
     normalize_gates: bool = True
     routing: str = "token_choice"
 

@@ -397,6 +397,12 @@ class Trainer:
         )
         return stats
 
+    def _log_metrics(self, kind: str, record: dict[str, Any]) -> None:
+        """Structured-metrics sidecar (``RunLogger.log_metrics``), when the
+        attached logger supports it."""
+        if self.logger is not None and hasattr(self.logger, "log_metrics"):
+            self.logger.log_metrics({"kind": kind, **record})
+
     def report_eval(self, stats: dict[str, float], *, note: str | None = None) -> None:
         """Record + log a standalone evaluation (the ``--eval_only`` path).
 
@@ -411,6 +417,7 @@ class Trainer:
                 "Eval-only: "
                 + ", ".join(f"{k} {v:.4f}" for k, v in sorted(stats.items()))
             )
+            self._log_metrics("eval_only", stats)
 
     def evaluate(self, loader: Any) -> dict[str, float]:
         """Collective evaluation over the full loader (all processes/devices).
@@ -465,6 +472,7 @@ class Trainer:
                     self.checkpointer.save(self.state, epoch=epoch)
                     last_saved = epoch
             self.history.append(stats)
+            self._log_metrics("epoch", stats)
         # Final eval + save (parity: unet/train.py:223-244) — skipped when the
         # last epoch already hit the cadence (no duplicate eval/checkpoint).
         final_epoch = num_epochs - 1
@@ -473,6 +481,12 @@ class Trainer:
             self.history[-1].update({f"eval_{k}": v for k, v in final.items()})
             self._log(
                 "Final eval: " + ", ".join(f"{k} {v:.4f}" for k, v in final.items())
+            )
+            # The final epoch's sidecar record was already written without
+            # these eval metrics; emit them as their own record.
+            self._log_metrics(
+                "final_eval",
+                {"epoch": final_epoch, **{f"eval_{k}": v for k, v in final.items()}},
             )
         if self.checkpointer is not None and last_saved != final_epoch:
             self.checkpointer.save(self.state, epoch=final_epoch)

@@ -52,6 +52,23 @@ class RunLogger:
             with self.path.open("a") as f:
                 f.write(line + "\n")
 
+    def log_metrics(self, record: Mapping[str, Any]) -> None:
+        """Append one structured metrics record to ``<run>.metrics.jsonl``.
+
+        The machine-readable sidecar of the human log: one JSON object per
+        line (timestamped), so plotting/analysis never parses the prose log.
+        The reference has no structured metrics at all (prose log only,
+        ``pytorch/unet/train.py:44-57``).
+        """
+        if not self.enabled or self.path is None:
+            return
+        line = {
+            "ts": datetime.datetime.now().isoformat(timespec="seconds"),
+            **record,
+        }
+        with self.path.with_suffix(".metrics.jsonl").open("a") as f:
+            f.write(json.dumps(line, default=float) + "\n")
+
     def log_hyperparameters(self, params: Mapping[str, Any]) -> None:
         """Startup block parity: hyperparams + world info (train.py:356-360)."""
         self.log("hyperparameters: " + json.dumps(dict(params), default=str))

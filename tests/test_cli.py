@@ -71,6 +71,15 @@ class TestTrainResnetCLI:
         logs = _read_logs(tmp_path / "logs")
         assert "eval-only: restored epoch 0" in logs
         assert "Eval-only: accuracy" in logs
+        # Structured sidecar: the training run wrote an epoch record, the
+        # eval-only run its own kind.
+        records = [
+            json.loads(line)
+            for f in sorted((tmp_path / "logs").glob("*.metrics.jsonl"))
+            for line in f.read_text().splitlines()
+        ]
+        kinds = {r["kind"] for r in records}
+        assert "epoch" in kinds and "eval_only" in kinds
 
     def test_eval_only_without_checkpoint_fails(self, tmp_path):
         with pytest.raises(SystemExit, match="no checkpoint"):

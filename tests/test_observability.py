@@ -138,6 +138,29 @@ class TestHeartbeat:
         hb.stop()
 
 
+class TestRunLoggerMetrics:
+    def test_jsonl_sidecar(self, tmp_path):
+        import json
+
+        from deeplearning_mpi_tpu.utils.logging import RunLogger
+
+        logger = RunLogger(tmp_path, echo=False, run_name="run")
+        logger.log_metrics({"kind": "epoch", "epoch": 0, "loss": 1.25})
+        logger.log_metrics({"kind": "epoch", "epoch": 1, "loss": 1.0})
+        records = [
+            json.loads(line)
+            for line in (tmp_path / "run.metrics.jsonl").read_text().splitlines()
+        ]
+        assert [r["epoch"] for r in records] == [0, 1]
+        assert records[0]["loss"] == 1.25
+        assert all("ts" in r and r["kind"] == "epoch" for r in records)
+
+    def test_disabled_without_log_dir(self):
+        from deeplearning_mpi_tpu.utils.logging import RunLogger
+
+        RunLogger(None, echo=False).log_metrics({"loss": 1.0})  # no-op, no crash
+
+
 class TestPreflight:
     def test_missing_data_dir_fails_with_message(self, tmp_path):
         with pytest.raises(SystemExit, match="data directory"):
