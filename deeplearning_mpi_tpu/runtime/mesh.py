@@ -70,6 +70,51 @@ class MeshSpec:
         return (data, self.pipe, self.expert, self.seq, self.model)
 
 
+def order_devices_for_mesh(devices: list, shape: tuple[int, ...]) -> np.ndarray:
+    """Arrange devices into the mesh array, multi-slice (DCN) aware.
+
+    Single slice (or CPU/GPU, where ``slice_index`` doesn't exist): plain
+    row-major reshape — device order from ``jax.devices()`` is already
+    ICI-topology-sorted within a slice.
+
+    Multi-slice TPU (devices carry distinct ``slice_index``): the slice
+    boundaries must land inside the leading ``(data, pipe)`` block — the two
+    DCN-friendly axes per the ``MESH_AXES`` contract (gradient all-reduce is
+    large and latency-tolerant; pipeline ppermutes cross a boundary once per
+    microbatch) — while ``expert``/``seq``/``model`` collectives
+    (latency-critical, per-layer) stay on intra-slice ICI. Concretely the
+    devices are laid out slice-major, which requires equal-size slices and
+    each slice holding a whole number of ``expert*seq*model`` inner blocks.
+    This is the placement ``jax.experimental.mesh_utils.
+    create_hybrid_device_mesh`` produces with ``dcn_mesh_shape`` over
+    (data, pipe), implemented directly so the grouping logic is
+    unit-testable without multi-slice hardware.
+    """
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    if len(groups) <= 1:
+        return np.asarray(devices).reshape(shape)
+    ordered = [groups[k] for k in sorted(groups)]
+    per_slice = len(ordered[0])
+    if any(len(g) != per_slice for g in ordered):
+        raise ValueError(
+            f"slices have unequal device counts: { {k: len(v) for k, v in groups.items()} }"
+        )
+    n_slices = len(ordered)
+    inner = math.prod(shape[2:])  # expert * seq * model — ICI-only axes
+    dcn_block = shape[0] * shape[1]  # data * pipe — may span slices
+    if per_slice % inner or dcn_block % n_slices:
+        raise ValueError(
+            f"mesh {shape} cannot map onto {n_slices} slices of {per_slice} "
+            f"devices: expert*seq*model ({inner}) must divide the per-slice "
+            f"device count and data*pipe ({dcn_block}) must be a multiple of "
+            "the slice count — only the data/pipe axes may cross DCN"
+        )
+    stacked = np.stack([np.asarray(g, dtype=object) for g in ordered])
+    return stacked.reshape(shape)
+
+
 def create_mesh(
     spec: MeshSpec | None = None,
     *,
@@ -80,13 +125,14 @@ def create_mesh(
     With no arguments this is the DDP-parity configuration: every device on
     the ``data`` axis, all other axes size 1 — the TPU-native equivalent of
     the reference's world of N DDP ranks (``pytorch/resnet/main.py:44-46``).
+    On multi-slice TPU topologies the device order is DCN-aware — see
+    :func:`order_devices_for_mesh`.
     """
     spec = spec or MeshSpec()
     if devices is None:
         devices = jax.devices()
     shape = spec.resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, MESH_AXES)
+    return Mesh(order_devices_for_mesh(devices, shape), MESH_AXES)
 
 
 def data_axes(mesh: Mesh) -> tuple[str, ...]:

@@ -58,6 +58,70 @@ class TestMesh:
         mesh = create_mesh(MeshSpec(model=2))
         assert mesh.shape[AXIS_DATA] == 4
 
+    def test_multislice_order_puts_data_across_slices(self):
+        """DCN-aware placement: the data axis advances across slices; the
+        inner (ICI) axes never leave a slice."""
+        import dataclasses
+
+        from deeplearning_mpi_tpu.runtime.mesh import order_devices_for_mesh
+
+        @dataclasses.dataclass(frozen=True)
+        class FakeDev:
+            id: int
+            slice_index: int
+
+        # 2 slices x 4 devices, interleaved in the input to prove grouping.
+        devs = [FakeDev(i, i % 2) for i in range(8)]
+        arr = order_devices_for_mesh(devs, (4, 1, 1, 1, 2))  # dp4 x tp2
+        assert arr.shape == (4, 1, 1, 1, 2)
+        # Each tp pair lives inside one slice...
+        flat_rows = arr.reshape(4, 2)
+        for row in flat_rows:
+            assert row[0].slice_index == row[1].slice_index
+        # ...and data rows 0-1 are slice 0, rows 2-3 slice 1.
+        assert [row[0].slice_index for row in flat_rows] == [0, 0, 1, 1]
+
+    def test_multislice_rejects_bad_layouts(self):
+        import dataclasses
+
+        from deeplearning_mpi_tpu.runtime.mesh import order_devices_for_mesh
+
+        @dataclasses.dataclass(frozen=True)
+        class FakeDev:
+            id: int
+            slice_index: int
+
+        devs = [FakeDev(i, i % 3) for i in range(9)]  # 3 slices x 3
+        with pytest.raises(ValueError, match="only the data/pipe axes"):
+            order_devices_for_mesh(devs, (1, 1, 1, 1, 9))  # tp across slices
+        lopsided = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 1)]
+        with pytest.raises(ValueError, match="unequal"):
+            order_devices_for_mesh(lopsided, (3, 1, 1, 1, 1))
+
+    def test_multislice_pipe_may_span_slices(self):
+        """pipe is a DCN-friendly axis (MESH_AXES contract): stages split
+        across slices with each slice holding a contiguous stage range."""
+        import dataclasses
+
+        from deeplearning_mpi_tpu.runtime.mesh import order_devices_for_mesh
+
+        @dataclasses.dataclass(frozen=True)
+        class FakeDev:
+            id: int
+            slice_index: int
+
+        devs = [FakeDev(i, i // 4) for i in range(8)]  # 2 slices x 4
+        arr = order_devices_for_mesh(devs, (1, 8, 1, 1, 1))  # pp8
+        stages = arr.reshape(8)
+        assert [d.slice_index for d in stages] == [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_single_slice_is_plain_reshape(self):
+        from deeplearning_mpi_tpu.runtime.mesh import order_devices_for_mesh
+
+        devs = jax.devices()
+        arr = order_devices_for_mesh(devs, (8, 1, 1, 1, 1))
+        assert list(arr.ravel()) == list(devs)
+
     def test_bad_shape_raises(self):
         with pytest.raises(ValueError):
             create_mesh(MeshSpec(data=3, model=2))
