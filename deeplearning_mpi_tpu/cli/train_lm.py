@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                        choices=["dense", "flash", "ring", "ulysses"],
                        help="attention core: flash = Pallas TPU kernel; ring/ulysses = sequence-parallel over --sp")
     group.add_argument("--moe_aux_weight", type=float, default=0.01)
+    group.add_argument("--loss_chunk", type=int, default=0,
+                       help="compute the head matmul + cross-entropy in "
+                       "sequence chunks of this size so [B, S, vocab] logits "
+                       "never materialize (the long-context memory lever; "
+                       "tied embeddings, dense LM only). 0 = standard loss")
     data = parser.add_argument_group("data")
     data.add_argument("--text_file", default=None,
                       help="train on this file's bytes (vocab 256); default: synthetic motifs")
@@ -136,6 +141,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.pp > 1:
+        if args.loss_chunk:
+            raise SystemExit("--loss_chunk is not wired into the pipelined LM yet")
         from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
 
         model = PipelinedLM(
@@ -145,6 +152,7 @@ def main(argv: list[str] | None = None) -> int:
     else:
         model = TransformerLM(
             config=cfg, dtype=dtype, attention_fn=attention_fn, remat=args.remat,
+            return_prehead=args.loss_chunk > 0,
         )
     tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=1.0)
 
@@ -167,7 +175,8 @@ def main(argv: list[str] | None = None) -> int:
             state, "lm", mesh,
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
-            grad_accum=args.grad_accum, zero=args.zero,
+            grad_accum=args.grad_accum, loss_chunk=args.loss_chunk,
+            zero=args.zero,
         )
         trainer.place_state()
         config.build_observability(args, trainer)

@@ -246,6 +246,13 @@ class TransformerLM(nn.Module):
     remat: bool = False
     mlp_cls: type[nn.Module] | None = None
     decode: bool = False  # KV-cached single-token autoregressive mode
+    #: return (final-norm activations, head kernel [d, V]) instead of
+    #: logits, for the chunked head+loss path (``ops.loss.chunked_lm_loss``)
+    #: that never materializes [B, S, V] logits. Tied embeddings only — the
+    #: untied head's Dense would have to be built-but-skipped, forking the
+    #: param tree. The param tree is unchanged, so checkpoints interchange
+    #: freely with the plain model.
+    return_prehead: bool = False
 
     @nn.compact
     def __call__(
@@ -279,6 +286,14 @@ class TransformerLM(nn.Module):
                 decode=self.decode, name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)
+        if self.return_prehead:
+            if not cfg.tied_embeddings:
+                raise ValueError(
+                    "return_prehead requires tied_embeddings (an untied "
+                    "lm_head would have to be built-but-skipped, forking "
+                    "the param tree)"
+                )
+            return x, embed.embedding.T
         if cfg.tied_embeddings:
             logits = embed.attend(x.astype(self.dtype))
         else:

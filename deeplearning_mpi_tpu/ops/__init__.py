@@ -3,6 +3,7 @@
 from deeplearning_mpi_tpu.ops.attention import dense_attention  # noqa: F401
 from deeplearning_mpi_tpu.ops.loss import (  # noqa: F401
     dice_loss,
+    chunked_lm_loss,
     lm_cross_entropy,
     masked_mean,
     sigmoid_binary_cross_entropy,

@@ -10,6 +10,8 @@ loss/softmax reductions need f32 accumulation for stability.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 
@@ -96,3 +98,73 @@ def lm_cross_entropy(
     """
     nll = _token_nll(logits[:, :-1], tokens[:, 1:])
     return masked_mean(nll, None if mask is None else mask[:, 1:])
+
+
+def chunked_lm_loss(
+    x: jax.Array,
+    head_kernel: jax.Array,
+    tokens: jax.Array,
+    *,
+    chunk_size: int,
+    mask: jax.Array | None = None,
+    compute_dtype: Any = None,
+) -> jax.Array:
+    """Next-token loss from pre-head activations, never materializing the
+    full logits.
+
+    ``lm_cross_entropy(x @ head_kernel, tokens)`` needs the ``[B, S, V]``
+    f32 logits resident in BOTH passes — at 32k tokens over a 32k vocab
+    that is ~4.2 GB forward plus the same again for ``dlogits``, the two
+    biggest tensors in the long-context step. Here the head matmul and the
+    cross-entropy run chunk-by-chunk over the sequence inside a
+    ``lax.scan``, with each chunk under ``jax.checkpoint`` so the backward
+    recomputes its ``[B, chunk, V]`` logits tile instead of saving it:
+    peak logits memory drops from O(S·V) to O(chunk·V) in both passes for
+    one extra head matmul per chunk in the backward.
+
+    Args: ``x`` — final-norm output ``[B, S, d]`` (any dtype);
+    ``head_kernel`` — ``[d, V]`` (tied embeddings: ``embedding.T``);
+    ``tokens`` — ``[B, S]`` int; ``mask`` (1 = real token) as in
+    :func:`lm_cross_entropy`; ``compute_dtype`` — matmul dtype (default:
+    ``x.dtype``, matching the model's head). Numerics: logits are cast to
+    f32 before the log-softmax, exactly like the dense path.
+    """
+    compute_dtype = compute_dtype or x.dtype
+    batch, seq, _ = x.shape
+    # Next-token alignment first, then chunk the S-1 prediction positions.
+    x_in = x[:, :-1].astype(compute_dtype)
+    labels = tokens[:, 1:]
+    weights = (
+        jnp.ones(labels.shape, jnp.float32)
+        if mask is None
+        else mask[:, 1:].astype(jnp.float32)
+    )
+    n_pos = seq - 1
+    chunk_size = max(1, min(chunk_size, n_pos))
+    pad = (-n_pos) % chunk_size
+    if pad:
+        x_in = jnp.pad(x_in, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))  # zero weight = excluded
+    n_chunks = (n_pos + pad) // chunk_size
+    split = lambda a: a.reshape(  # noqa: E731 — [B, S-1(+pad), ...] -> chunk-major
+        batch, n_chunks, chunk_size, *a.shape[2:]
+    ).swapaxes(0, 1)
+    kernel = head_kernel.astype(compute_dtype)
+
+    @jax.checkpoint
+    def chunk_nll_sum(x_c, labels_c, w_c):
+        logits = jnp.einsum(
+            "btd,dv->btv", x_c, kernel
+        )  # [B, chunk, V] — the only logits tile alive
+        nll = _token_nll(logits, labels_c)
+        return jnp.sum(nll * w_c)
+
+    def body(acc, chunk):
+        x_c, labels_c, w_c = chunk
+        return acc + chunk_nll_sum(x_c, labels_c, w_c), None
+
+    total, _ = jax.lax.scan(
+        body, jnp.zeros((), jnp.float32), (split(x_in), split(labels), split(weights))
+    )
+    return total / jnp.maximum(jnp.sum(weights), 1.0)

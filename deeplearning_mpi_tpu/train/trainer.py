@@ -37,6 +37,7 @@ from jax.sharding import Mesh
 from deeplearning_mpi_tpu.data.loader import prefetch
 from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
 from deeplearning_mpi_tpu.ops import (
+    chunked_lm_loss,
     dice_score,
     lm_cross_entropy,
     sigmoid_binary_cross_entropy,
@@ -54,13 +55,32 @@ LossFn = Callable[..., jax.Array]
 _INPUTS = {"classification": "image", "segmentation": "image", "lm": "tokens"}
 
 
-def _lm_loss(logits: jax.Array, batch: Batch, where: jax.Array | None = None) -> jax.Array:
+def _lm_mask(batch: Batch, where: jax.Array | None) -> jax.Array | None:
     # Combine the loader's [B] validity mask with any [B, S] token mask.
     mask = batch.get("mask")
     if where is not None:
         where_bs = jnp.broadcast_to(where[:, None], batch["tokens"].shape)
         mask = where_bs if mask is None else mask * where_bs
-    return lm_cross_entropy(logits, batch["tokens"], mask)
+    return mask
+
+
+def _lm_loss(logits: jax.Array, batch: Batch, where: jax.Array | None = None) -> jax.Array:
+    return lm_cross_entropy(logits, batch["tokens"], _lm_mask(batch, where))
+
+
+def _lm_loss_chunked(chunk_size: int) -> LossFn:
+    """LM loss over (prehead_x, head_kernel) model outputs — pair with
+    ``TransformerLM(return_prehead=True)``; full logits never materialize
+    (``ops.loss.chunked_lm_loss``)."""
+
+    def fn(outputs, batch: Batch, where: jax.Array | None = None) -> jax.Array:
+        x, head_kernel = outputs
+        return chunked_lm_loss(
+            x, head_kernel, batch["tokens"],
+            chunk_size=chunk_size, mask=_lm_mask(batch, where),
+        )
+
+    return fn
 
 
 def _task_loss(task: str) -> LossFn:
@@ -85,6 +105,7 @@ def make_train_step(
     donate: bool = True,
     aux_weight: float = 0.0,
     grad_accum: int = 1,
+    loss_chunk: int = 0,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
 
@@ -101,8 +122,16 @@ def make_train_step(
     means == full-batch mean, matching the DDP convention); BatchNorm EMA
     stats advance once per chunk, the same as running the chunks as separate
     steps.
+
+    ``loss_chunk > 0`` (LM only) switches to the chunked head+loss path —
+    pair with ``TransformerLM(return_prehead=True)``; the [B, S, V] logits
+    never materialize (``ops.loss.chunked_lm_loss``), the long-context
+    memory lever at large vocabularies.
     """
-    loss_fn = _task_loss(task)
+    loss_fn = (
+        _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
+        else _task_loss(task)
+    )
     input_key = _INPUTS[task]
 
     def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict[str, jax.Array]]:
@@ -177,15 +206,22 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(task: str) -> Callable[[TrainState, Batch], dict[str, jax.Array]]:
+def make_eval_step(
+    task: str, *, loss_chunk: int = 0
+) -> Callable[[TrainState, Batch], dict[str, jax.Array]]:
     """Build the jitted eval step: loss + task metric on one batch.
 
     Classification: top-1 accuracy (``pytorch/resnet/main.py:57-73``).
     Segmentation: sigmoid > 0.5 threshold then per-image Dice
-    (``pytorch/unet/train.py:115-140``).
+    (``pytorch/unet/train.py:115-140``). ``loss_chunk`` as in
+    :func:`make_train_step` (the model's eval outputs are then
+    (prehead, kernel), so the loss path must match).
     """
 
-    loss_fn = _task_loss(task)
+    loss_fn = (
+        _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
+        else _task_loss(task)
+    )
     input_key = _INPUTS[task]
 
     def step(state: TrainState, batch: Batch) -> dict[str, jax.Array]:
@@ -304,6 +340,7 @@ class Trainer:
         eval_every: int = 10,  # "every 10 epochs" (resnet/main.py:136, unet/train.py:213)
         aux_weight: float = 0.0,  # MoE load-balance loss weight
         grad_accum: int = 1,  # gradient-accumulation chunks per optimizer step
+        loss_chunk: int = 0,  # LM chunked head+loss (pair with return_prehead)
         profiler: Any = None,  # utils.profiling.Profiler; traces a few hot steps
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
@@ -320,9 +357,10 @@ class Trainer:
         self.time_steps = time_steps
         self.zero = zero
         self.train_step = make_train_step(
-            task, aux_weight=aux_weight, grad_accum=grad_accum
+            task, aux_weight=aux_weight, grad_accum=grad_accum,
+            loss_chunk=loss_chunk,
         )
-        self.eval_step = make_eval_step(task)
+        self.eval_step = make_eval_step(task, loss_chunk=loss_chunk)
         self.history: list[dict[str, float]] = []
         self._profiled = False
 

@@ -1,0 +1,127 @@
+"""Chunked head+loss cross-entropy vs the dense-logits oracle.
+
+The chunked path must be numerically identical to computing the full
+``[B, S, V]`` logits and calling ``lm_cross_entropy`` — values AND
+gradients — for every chunking (dividing, non-dividing, chunk > sequence)
+and with token masks. The memory claim (no full-logits tensor in either
+pass) is structural: logits only exist inside the per-chunk
+``jax.checkpoint``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.ops import chunked_lm_loss, lm_cross_entropy
+
+
+def _case(B=2, S=17, D=8, V=31, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(B, S, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.3, jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    return x, w, tokens
+
+
+@pytest.mark.parametrize("chunk", [4, 16, 5, 100], ids=["divides", "exact", "ragged", "oversize"])
+def test_matches_dense_loss(chunk):
+    x, w, tokens = _case()
+    dense = lm_cross_entropy(x @ w, tokens)
+    chunked = chunked_lm_loss(x, w, tokens, chunk_size=chunk)
+    np.testing.assert_allclose(float(chunked), float(dense), rtol=1e-6)
+
+
+def test_matches_dense_loss_with_mask():
+    x, w, tokens = _case(seed=1)
+    mask = jnp.asarray(
+        np.random.default_rng(2).integers(0, 2, tokens.shape), jnp.float32
+    )
+    dense = lm_cross_entropy(x @ w, tokens, mask)
+    chunked = chunked_lm_loss(x, w, tokens, chunk_size=5, mask=mask)
+    np.testing.assert_allclose(float(chunked), float(dense), rtol=1e-6)
+
+
+def test_grads_match_dense_loss():
+    x, w, tokens = _case(seed=3)
+
+    gx_d, gw_d = jax.grad(
+        lambda x, w: lm_cross_entropy(x @ w, tokens), argnums=(0, 1)
+    )(x, w)
+    gx_c, gw_c = jax.grad(
+        lambda x, w: chunked_lm_loss(x, w, tokens, chunk_size=5), argnums=(0, 1)
+    )(x, w)
+    np.testing.assert_allclose(np.asarray(gx_c), np.asarray(gx_d), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gw_c), np.asarray(gw_d), atol=1e-6)
+
+
+def test_model_prehead_path_matches_plain_model():
+    """TransformerLM(return_prehead=True) + chunked loss == the plain model's
+    logits through lm_cross_entropy — same params (the tree is unchanged),
+    same loss, same parameter gradients."""
+    cfg = TransformerConfig.tiny()
+    plain = TransformerLM(config=cfg, dtype=jnp.float32)
+    prehead = TransformerLM(config=cfg, dtype=jnp.float32, return_prehead=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    variables = plain.init(jax.random.key(0), tokens)
+    assert (
+        jax.tree.structure(variables)
+        == jax.tree.structure(prehead.init(jax.random.key(0), tokens))
+    )
+
+    def loss_plain(params):
+        return lm_cross_entropy(plain.apply({"params": params}, tokens), tokens)
+
+    def loss_chunked(params):
+        x, kernel = prehead.apply({"params": params}, tokens)
+        return chunked_lm_loss(x, kernel, tokens, chunk_size=4)
+
+    l_p, g_p = jax.value_and_grad(loss_plain)(variables["params"])
+    l_c, g_c = jax.value_and_grad(loss_chunked)(variables["params"])
+    np.testing.assert_allclose(float(l_c), float(l_p), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_c), jax.tree.leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-6)
+
+
+def test_untied_embeddings_rejected():
+    import dataclasses
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), tied_embeddings=False)
+    model = TransformerLM(config=cfg, dtype=jnp.float32, return_prehead=True)
+    with pytest.raises(ValueError, match="tied_embeddings"):
+        model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.mark.slow
+def test_train_step_with_loss_chunk_matches_standard():
+    """One SGD step through make_train_step(loss_chunk=...) equals the
+    standard step bit-for-near-bit (update linear in grads)."""
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    cfg = TransformerConfig.tiny()
+    tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    batch = {"tokens": tokens}
+
+    def run(model, **step_kw):
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+        )
+        step = make_train_step("lm", donate=False, **step_kw)
+        new_state, metrics = step(state, batch)
+        return float(metrics["loss"]), new_state.params
+
+    loss_std, params_std = run(TransformerLM(config=cfg, dtype=jnp.float32))
+    loss_chk, params_chk = run(
+        TransformerLM(config=cfg, dtype=jnp.float32, return_prehead=True),
+        loss_chunk=4,
+    )
+    np.testing.assert_allclose(loss_chk, loss_std, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(params_chk), jax.tree.leaves(params_std)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
