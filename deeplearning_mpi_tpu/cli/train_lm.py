@@ -46,7 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
                        help="compute the head matmul + cross-entropy in "
                        "sequence chunks of this size so [B, S, vocab] logits "
                        "never materialize (the long-context memory lever; "
-                       "tied embeddings, dense LM only). 0 = standard loss")
+                       "tied embeddings only). 0 = standard loss")
     data = parser.add_argument_group("data")
     data.add_argument("--text_file", default=None,
                       help="train on this file's bytes (vocab 256); default: synthetic motifs")
@@ -141,13 +141,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.pp > 1:
-        if args.loss_chunk:
-            raise SystemExit("--loss_chunk is not wired into the pipelined LM yet")
         from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
 
         model = PipelinedLM(
             cfg, mesh, num_microbatches=args.microbatches,
             dtype=dtype, attention_fn=attention_fn, remat=args.remat,
+            return_prehead=args.loss_chunk > 0,
         )
     else:
         model = TransformerLM(

@@ -109,6 +109,14 @@ class EmbedHead(nn.Module):
             logits = self.lm_head(x)
         return logits.astype(jnp.float32)
 
+    def prehead(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        """(final-norm activations, head kernel) for the chunked head+loss
+        path (``ops.loss.chunked_lm_loss``) — tied embeddings only, same
+        restriction and rationale as ``TransformerLM.return_prehead``."""
+        if not self.config.tied_embeddings:
+            raise ValueError("prehead requires tied_embeddings")
+        return self.final_norm(x), self.embed.embedding.T
+
     def __call__(self, tokens: jax.Array) -> jax.Array:
         # Init-only path: touches every param so one ``init`` shapes them all.
         return self.decode(self.encode(tokens))
@@ -127,7 +135,13 @@ class PipelinedLM:
         dtype: Any = jnp.bfloat16,
         attention_fn: Any = None,
         remat: bool = False,
+        return_prehead: bool = False,
     ) -> None:
+        if return_prehead and not config.tied_embeddings:
+            # Same restriction as TransformerLM.return_prehead, rejected at
+            # construction like the flat model's init-time check.
+            raise ValueError("return_prehead requires tied_embeddings")
+        self.return_prehead = return_prehead
         self.config = config
         self.mesh = mesh
         self.num_stages = num_stages or mesh.shape["pipe"]
@@ -203,9 +217,12 @@ class PipelinedLM:
         # tests/test_pipeline.py for the per-microbatch oracle).
         aux_total = jnp.mean(ys.pop("aux"))
         out = merge_microbatches(ys)["x"]
-        logits = self.embed_head.apply(
-            {"params": params["embed_head"]}, out, method=EmbedHead.decode
+        head_method = (
+            EmbedHead.prehead if self.return_prehead else EmbedHead.decode
+        )
+        outputs = self.embed_head.apply(
+            {"params": params["embed_head"]}, out, method=head_method
         )
         if mutable:
-            return logits, {AUX_COLLECTION: {"pipeline": aux_total}}
-        return logits
+            return outputs, {AUX_COLLECTION: {"pipeline": aux_total}}
+        return outputs

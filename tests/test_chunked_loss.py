@@ -125,3 +125,72 @@ def test_train_step_with_loss_chunk_matches_standard():
     np.testing.assert_allclose(loss_chk, loss_std, rtol=1e-6)
     for a, b in zip(jax.tree.leaves(params_chk), jax.tree.leaves(params_std)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pipelined_prehead_matches_flat():
+    """PipelinedLM(return_prehead=True) + chunked loss == the flat prehead
+    model (weights remapped), closing the --loss_chunk x --pp composition."""
+    from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
+    from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+    mesh = create_mesh(MeshSpec(data=4, pipe=2))
+    cfg = TransformerConfig.tiny()
+    pipelined = PipelinedLM(
+        cfg, mesh, num_microbatches=2, dtype=jnp.float32, return_prehead=True
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    variables = pipelined.init(jax.random.key(0), tokens)
+    x, kernel = jax.jit(pipelined.apply)(variables, tokens)
+    loss_pp = chunked_lm_loss(x, kernel, tokens, chunk_size=4)
+
+    p = variables["params"]
+    flat_params = {
+        "embed": p["embed_head"]["embed"],
+        "final_norm": p["embed_head"]["final_norm"],
+        "layer_0": jax.tree.map(lambda leaf: leaf[0], p["stages"]["block_0"]),
+        "layer_1": jax.tree.map(lambda leaf: leaf[1], p["stages"]["block_0"]),
+    }
+    flat = TransformerLM(config=cfg, dtype=jnp.float32, return_prehead=True)
+    xf, kf = flat.apply({"params": flat_params}, tokens)
+    loss_flat = chunked_lm_loss(xf, kf, tokens, chunk_size=4)
+    np.testing.assert_allclose(float(loss_pp), float(loss_flat), rtol=1e-5)
+
+
+def test_moe_composes_with_chunked_loss():
+    """MoE x loss_chunk: the aux collection rides mutable independently of
+    the (x, kernel) output tuple."""
+    from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
+
+    cfg = TransformerConfig.tiny_moe()
+    model = TransformerLM(config=cfg, dtype=jnp.float32, return_prehead=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(7).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    variables = model.init(jax.random.key(0), tokens)
+    (x, kernel), mutated = model.apply(
+        {"params": variables["params"]}, tokens, mutable=[AUX_COLLECTION]
+    )
+    loss = chunked_lm_loss(x, kernel, tokens, chunk_size=4)
+    assert np.isfinite(float(loss))
+    assert float(collect_aux_loss(mutated)) > 0.0
+
+    plain = TransformerLM(config=cfg, dtype=jnp.float32)
+    logits = plain.apply({"params": variables["params"]}, tokens)
+    np.testing.assert_allclose(
+        float(loss), float(lm_cross_entropy(logits, tokens)), rtol=1e-6
+    )
+
+
+def test_pipelined_untied_prehead_rejected_at_construction():
+    import dataclasses
+
+    from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
+    from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+    cfg = dataclasses.replace(TransformerConfig.tiny(), tied_embeddings=False)
+    with pytest.raises(ValueError, match="tied_embeddings"):
+        PipelinedLM(
+            cfg, create_mesh(MeshSpec(data=4, pipe=2)), return_prehead=True
+        )
