@@ -241,6 +241,47 @@ def bench_allreduce() -> dict:
     return measure_collective_latency(create_mesh(), num_floats=25_600_000)
 
 
+def _device_responsive(timeout_s: float = 120.0) -> str | None:
+    """Probe the accelerator in a subprocess; return an error string if it
+    hangs or fails.
+
+    A wedged axon tunnel makes the first JAX op block forever (observed
+    2026-07-30: a killed remote compile left the tunnel unresponsive for
+    hours — even ``jax.devices()`` hung). JAX calls can't be interrupted
+    in-process, so the probe runs in a child that can be killed; without
+    this, a dead tunnel turns the whole bench into a silent hang instead of
+    one diagnosable JSON line.
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    # jax.devices() alone detects the wedge (it hung too) without paying a
+    # remote compile on every healthy run.
+    code = "import jax; print(jax.devices())"
+    # start_new_session + killpg: the child may spawn helpers (tunnel client)
+    # that inherit the pipes; killing only the child would leave
+    # communicate() blocked on pipe EOF — the hang guard must not hang.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.communicate()
+        return f"device probe hung for {timeout_s:.0f}s (tunnel/backend unresponsive)"
+    if proc.returncode != 0:
+        return f"device probe failed: {stderr.strip()[-300:]}"
+    return None
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_224", type=int, default=128)
@@ -260,6 +301,26 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.platform != "cpu":  # default and explicit tpu both hit the device
+        probe_error = _device_responsive()
+        if probe_error is not None:
+            # Same schema as the success line (null values + error field) so
+            # single-line consumers never KeyError on the failure path.
+            print(
+                json.dumps(
+                    {
+                        "metric": "resnet50_bf16_images_per_sec_per_chip",
+                        "value": None,
+                        "unit": "images/s/chip",
+                        "vs_baseline": None,
+                        "mfu": None,
+                        "allreduce_latency_ms": None,
+                        "details": {},
+                        "error": probe_error,
+                    }
+                )
+            )
+            return
 
     details: dict = {}
     value = None
