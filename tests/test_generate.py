@@ -20,22 +20,40 @@ def _model_and_params(seq=16, batch=2):
     return model, params
 
 
+def _dense_cfg():
+    return TransformerConfig.tiny()
+
+
+def _moe_dropfree_cfg():
+    # Drop-free routing is the comparison's precondition: decode steps (S=1)
+    # never drop a token, so the full forward must not drop either —
+    # capacity_factor E/k makes every expert able to absorb all tokens, BY
+    # DERIVATION so changed tiny_moe defaults can't silently break it.
+    cfg = TransformerConfig.tiny_moe()
+    return dataclasses.replace(
+        cfg, moe_capacity_factor=cfg.moe_experts / cfg.moe_top_k
+    )
+
+
 class TestCachedDecode:
     @pytest.mark.slow
-    def test_stepwise_decode_matches_full_forward(self):
+    @pytest.mark.parametrize("make_cfg", [_dense_cfg, _moe_dropfree_cfg],
+                             ids=["dense", "moe"])
+    def test_stepwise_decode_matches_full_forward(self, make_cfg):
         """Feeding tokens one at a time through the KV cache must reproduce
         the full-sequence causal forward logits position by position."""
-        model, params = _model_and_params()
+        seq = 12
+        model = TransformerLM(config=make_cfg(), dtype=jnp.float32)
+        tokens_init = jnp.zeros((2, seq), jnp.int32)
+        params = model.init(jax.random.key(0), tokens_init)["params"]
         rng = np.random.default_rng(0)
-        tokens = jnp.asarray(rng.integers(0, 256, (2, 12)), jnp.int32)
+        tokens = jnp.asarray(rng.integers(0, 256, (2, seq)), jnp.int32)
 
         full_logits = model.apply({"params": params}, tokens)
 
         decode_model = dataclasses.replace(model, decode=True)
-        cache = decode_model.init(
-            jax.random.key(0), jnp.zeros((2, 12), jnp.int32)
-        )["cache"]
-        for i in range(12):
+        cache = decode_model.init(jax.random.key(0), tokens_init)["cache"]
+        for i in range(seq):
             step_logits, mutated = decode_model.apply(
                 {"params": params, "cache": cache},
                 tokens[:, i : i + 1],
