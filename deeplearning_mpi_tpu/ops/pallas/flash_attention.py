@@ -124,17 +124,24 @@ def _fwd_pallas(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, block_q: int, block_k: int, interpret: bool,
     with_lse: bool,
+    out_dtype: jax.typing.DTypeLike | None = None,
 ) -> tuple[jax.Array, jax.Array | None]:
     """Run the kernel on BHSD-transposed inputs; returns BSHD output plus
     (when ``with_lse``, i.e. under grad) the per-row logsumexp
     ``[B, H, S, 128]`` lane-replicated backward residual. The primal skips
     it — the lse write would be 4x the HBM bytes of the output itself at
-    D=64 bf16."""
+    D=64 bf16. ``out_dtype`` overrides the output dtype (default: match q)
+    — the ring schedule requests f32 partials so its cross-rotation
+    logsumexp merge never rounds through bf16 (mirrors ``grad_dtype`` in
+    :func:`_bwd_pallas`; the accumulator is f32 in VMEM either way, this
+    only changes the final store)."""
     batch, seq, heads, head_dim = q.shape
     bq, bk = min(block_q, seq), min(block_k, seq)
     qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
     grid = (batch, heads, seq // bq, seq // bk)
-    o_shape = jax.ShapeDtypeStruct((batch, heads, seq, head_dim), q.dtype)
+    o_shape = jax.ShapeDtypeStruct(
+        (batch, heads, seq, head_dim), out_dtype or q.dtype
+    )
     o_spec = pl.BlockSpec(
         (1, 1, bq, head_dim), lambda b, h, i, j: (b, h, i, 0),
         memory_space=pltpu.VMEM,

@@ -63,9 +63,16 @@ def _merge(o, lse, o_b, lse_b):
 
 
 def _block_fwd(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
-    """One visiting block through the flash kernel → (o_b, lse_b rows)."""
+    """One visiting block through the flash kernel → (o_b, lse_b rows).
+
+    ``out_dtype=f32``: the kernel's accumulator is f32 in VMEM; storing the
+    partial in q.dtype (bf16 in training) would round each of the n
+    rotations before the f32 logsumexp merge — the exact drift the backward
+    already avoids via ``grad_dtype=f32``. The single cast to q.dtype
+    happens once, after the final merge."""
     o_b, lse128 = flash_fwd_block(
-        q, k_blk, v_blk, causal, block_q, block_k, interpret, with_lse=True
+        q, k_blk, v_blk, causal, block_q, block_k, interpret, with_lse=True,
+        out_dtype=jnp.float32,
     )
     # lane-replicated [B, H, S, 128] -> per-row [B, S, H]
     return o_b, lse128[..., 0].transpose(0, 2, 1)

@@ -118,10 +118,17 @@ def make_train_step(
     ``grad_accum > 1`` splits the batch into that many equal chunks and
     accumulates gradients over a ``lax.scan`` before one optimizer update —
     the standard large-effective-batch recipe when the per-step batch won't
-    fit in HBM. Loss-mean semantics are preserved (mean of equal-sized chunk
-    means == full-batch mean, matching the DDP convention); BatchNorm EMA
-    stats advance once per chunk, the same as running the chunks as separate
-    steps.
+    fit in HBM. Loss-mean semantics are preserved exactly: chunks are
+    combined by their valid-element weight (for the LM task, each chunk's
+    valid-token count; elsewhere chunks are equal-sized so the weight is
+    constant), so the result equals the full-batch masked mean even when
+    per-token masks are ragged across chunks — a plain mean of chunk means
+    would up-weight chunks with few valid tokens. The MoE aux loss instead
+    combines with EQUAL chunk weights (it spans all routed tokens, masked
+    included) — and, being nonlinear in batch composition, it is the one
+    term for which chunked != full-batch by construction. BatchNorm EMA
+    stats advance once per chunk, the same as running the chunks as
+    separate steps.
 
     ``loss_chunk > 0`` (LM only) switches to the chunked head+loss path —
     pair with ``TransformerLM(return_prehead=True)``; the [B, S, V] logits
@@ -134,8 +141,26 @@ def make_train_step(
     )
     input_key = _INPUTS[task]
 
+    def chunk_weight(chunk: Batch) -> jax.Array:
+        # The chunk loss's own denominator, so the cross-chunk weighted mean
+        # reproduces the full-batch mean. Only the LM task can be ragged (a
+        # [B, S] token mask); a masked-out chunk gets weight 0 — its 0.0
+        # masked_mean is then excluded, matching the full-batch sum.
+        if task == "lm":
+            mask = chunk.get("mask")
+            if mask is not None:
+                return jnp.sum(mask[:, 1:].astype(jnp.float32))
+        return jnp.asarray(1.0, jnp.float32)
+
     def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict[str, jax.Array]]:
-        def loss_and_grads(batch_stats, chunk):
+        def loss_and_grads(batch_stats, chunk, data_scale=None, aux_scale=None):
+            # data_scale/aux_scale (grad-accum only) fold the cross-chunk
+            # weights INTO the differentiated scalar, so data loss and aux
+            # loss can carry different weights in one backward pass: the
+            # data loss combines by valid-token fraction (exact masked
+            # mean), the aux load-balance loss by equal chunk shares — it
+            # covers every routed token, masked or not, so a padding-heavy
+            # chunk must still contribute full balance gradient.
             def compute_loss(params):
                 outputs, mutated = state.apply_fn(
                     {"params": params, "batch_stats": batch_stats},
@@ -144,7 +169,10 @@ def make_train_step(
                     mutable=["batch_stats", AUX_COLLECTION],
                 )
                 loss = loss_fn(outputs, chunk)
-                total = loss + aux_weight * collect_aux_loss(mutated) if aux_weight else loss
+                total = loss if data_scale is None else data_scale * loss
+                if aux_weight:
+                    a = aux_weight if aux_scale is None else aux_scale
+                    total = total + a * collect_aux_loss(mutated)
                 return total, (loss, mutated.get("batch_stats", {}))
 
             (_, aux), grads = jax.value_and_grad(
@@ -165,19 +193,35 @@ def make_train_step(
 
             chunks = jax.tree.map(split, batch)
 
+            # Total valid-element weight over the FULL batch, known before
+            # the scan (chunks partition axis 0), so each chunk's scale is
+            # final — no post-scan division that would also (wrongly) divide
+            # the equally-weighted aux-loss gradient. maximum(1): an
+            # every-token-masked batch yields 0 grads / 0 loss, like
+            # masked_mean's own guarded denominator.
+            if task == "lm" and batch.get("mask") is not None:
+                # chunk_weight on the full batch = the sum over its chunks,
+                # keeping the mask[:, 1:] denominator convention in one place.
+                w_total = jnp.maximum(chunk_weight(batch), 1.0)
+            else:
+                w_total = float(grad_accum)
+
             def body(carry, chunk):
                 stats, grad_sum, loss_sum = carry
-                loss, new_stats, grads = loss_and_grads(stats, chunk)
+                w = chunk_weight(chunk) / w_total
+                loss, new_stats, grads = loss_and_grads(
+                    stats, chunk,
+                    data_scale=w, aux_scale=aux_weight / grad_accum,
+                )
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
-                return (new_stats, grad_sum, loss_sum + loss), None
+                return (new_stats, grad_sum, loss_sum + w * loss), None
 
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            (new_batch_stats, grad_sum, loss_sum), _ = jax.lax.scan(
-                body, (state.batch_stats, zero_grads, jnp.zeros((), jnp.float32)),
+            (new_batch_stats, grads, loss), _ = jax.lax.scan(
+                body,
+                (state.batch_stats, zero_grads, jnp.zeros((), jnp.float32)),
                 chunks,
             )
-            grads = jax.tree.map(lambda g: g / grad_accum, grad_sum)
-            loss = loss_sum / grad_accum
 
         updates, new_opt_state = state.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

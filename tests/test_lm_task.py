@@ -68,6 +68,60 @@ class TestByteTextDataset:
         np.testing.assert_array_equal(a, b)
 
 
+class TestExpertChoiceGuard:
+    """The causal trainer refuses acausal routing without an explicit ack
+    (fail-loud doctrine, ``train/resilience.py``). Fast: ``parser.error``
+    fires before any runtime setup."""
+
+    GUARD_ARGS = [
+        "--moe_experts", "4", "--moe_routing", "expert_choice",
+        "--num_epochs", "1", "--batch_size", "8", "--seq_len", "32",
+    ]
+
+    def test_refuses_without_ack(self, capsys):
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        with pytest.raises(SystemExit) as exc:
+            train_lm.main(self.GUARD_ARGS)
+        assert exc.value.code == 2
+        assert "allow_acausal_routing" in capsys.readouterr().err
+
+    def test_refuses_single_expert_too(self, capsys):
+        # The model builds a routed MoE for ANY moe_experts >= 1, and a lone
+        # expert's top-C selection still ranks the whole sequence — the
+        # guard must match the model's threshold, not the help text's "N>1".
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        with pytest.raises(SystemExit) as exc:
+            train_lm.main([
+                "--moe_experts", "1", "--moe_routing", "expert_choice",
+            ])
+        assert exc.value.code == 2
+
+    def test_token_choice_not_guarded(self):
+        # token_choice is causal-safe; the parser must accept it without the
+        # ack flag (parse only — build_parser().parse_args, no training).
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        args = train_lm.build_parser().parse_args(
+            ["--moe_experts", "4", "--moe_routing", "token_choice"]
+        )
+        assert not args.allow_acausal_routing
+
+    @pytest.mark.slow
+    def test_ack_flag_trains(self, tmp_path):
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        rc = train_lm.main(self.GUARD_ARGS + [
+            "--allow_acausal_routing",
+            "--num_layers", "1", "--num_heads", "2", "--head_dim", "4",
+            "--d_model", "8", "--d_ff", "16", "--train_sequences", "32",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+
+
 @pytest.mark.slow
 class TestTrainLMCLI:
     def test_one_epoch_synthetic(self, tmp_path):

@@ -83,6 +83,68 @@ class TestTrainStep:
         for a, b in zip(jax.tree.leaves(s4.params), jax.tree.leaves(s1.params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    def test_grad_accum_matches_full_batch_ragged_mask(self):
+        """With a RAGGED per-token mask (chunks carry very different
+        valid-token counts), chunked accumulation must still equal the
+        full-batch masked mean: chunks combine by valid-token weight, not a
+        plain mean of chunk means (which would up-weight sparse chunks)."""
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+        def fresh():
+            return create_train_state(
+                model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+            )
+
+        rng = np.random.default_rng(1)
+        mask = np.ones((8, 16), np.float32)
+        mask[0:2, 2:] = 0.0   # chunk 0: almost everything masked
+        mask[4, 8:] = 0.0     # chunk 2: half a row masked
+        batch = {
+            "tokens": jnp.asarray(rng.integers(0, 256, (8, 16)), jnp.int32),
+            "mask": jnp.asarray(mask),
+        }
+        s1, m1 = make_train_step("lm", donate=False)(fresh(), batch)
+        s4, m4 = make_train_step("lm", donate=False, grad_accum=4)(fresh(), batch)
+        np.testing.assert_allclose(float(m4["loss"]), float(m1["loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s4.params), jax.tree.leaves(s1.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_grad_accum_moe_aux_stays_close(self):
+        """aux_weight > 0 with grad_accum: the aux load-balance loss is
+        nonlinear in batch composition, so chunked is not bit-equal to
+        full-batch — but the reported data loss must match exactly (aux is
+        excluded from it) and the update must stay close and finite."""
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+
+        model = TransformerLM(
+            config=TransformerConfig.tiny_moe(num_experts=4), dtype=jnp.float32
+        )
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+        def fresh():
+            return create_train_state(
+                model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+            )
+
+        batch = {
+            "tokens": jnp.asarray(
+                np.random.default_rng(2).integers(0, 256, (8, 16)), jnp.int32
+            )
+        }
+        s1, m1 = make_train_step("lm", donate=False, aux_weight=0.01)(
+            fresh(), batch
+        )
+        s2, m2 = make_train_step("lm", donate=False, aux_weight=0.01, grad_accum=2)(
+            fresh(), batch
+        )
+        np.testing.assert_allclose(float(m2["loss"]), float(m1["loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(s2.params), jax.tree.leaves(s1.params)):
+            assert np.all(np.isfinite(np.asarray(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
     def test_grad_accum_batchnorm_chunks_stats(self):
         """With BatchNorm, each chunk normalizes over its own examples (the
         same semantics as DDP's per-replica BN stats), so chunked training is
