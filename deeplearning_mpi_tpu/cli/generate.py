@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--greedy", action="store_true",
                      help="argmax decoding (temperature ignored)")
     gen.add_argument("--random_seed", type=int, default=0)
+    gen.add_argument("--time", action="store_true",
+                     help="print decode throughput to stderr (runs the "
+                     "program twice: an untimed compile pass, then a timed "
+                     "pass on the cached executable)")
     run = parser.add_argument_group("runtime")
     run.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     run.add_argument("--n_virtual_devices", type=int, default=None)
@@ -76,7 +80,7 @@ def main(argv: list[str] | None = None) -> int:
     import numpy as np
 
     from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
-    from deeplearning_mpi_tpu.models.generate import generate
+    from deeplearning_mpi_tpu.models.generate import generate_jit
     from deeplearning_mpi_tpu.train import Checkpointer, create_train_state
     from deeplearning_mpi_tpu.train.trainer import build_optimizer
 
@@ -135,15 +139,30 @@ def main(argv: list[str] | None = None) -> int:
         np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
     )[None, :]
 
-    out = generate(
+    fn = generate_jit(
         model,
-        state.params,
-        prompt,
         max_new_tokens=args.max_new_tokens,
-        rng=jax.random.key(args.random_seed),
         temperature=0.0 if args.greedy else args.temperature,
         top_k=0 if args.greedy else args.top_k,
     )
+    rng = jax.random.key(args.random_seed)
+    out = fn(state.params, prompt, rng)
+    if args.time:
+        import time
+
+        jax.block_until_ready(out)  # first call compiled; now time the cache hit
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(state.params, prompt, rng))
+        dt = time.perf_counter() - t0
+        # The scan decodes EVERY position (prompt prefill + new tokens) at
+        # identical per-step cost, so throughput is per position — dividing
+        # by max_new_tokens alone would understate it for long prompts.
+        positions = prompt.shape[1] + args.max_new_tokens
+        print(
+            f"decode: {positions} positions ({args.max_new_tokens} new) in "
+            f"{dt:.3f}s = {positions / dt:.1f} positions/s",
+            file=sys.stderr,
+        )
     tokens = np.asarray(out[0], np.uint8)
     text = tokens.tobytes().decode("utf-8", errors="replace")
     print(text)

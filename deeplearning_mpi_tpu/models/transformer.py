@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
+from deeplearning_mpi_tpu.ops.attention import decode_attention, dense_attention
 
 # (q, k, v [B,S,H,D], causal=...) -> context [B,S,H,D]
 AttentionFn = Callable[..., jax.Array]
@@ -129,7 +129,6 @@ class Attention(nn.Module):
         )
         if self.is_initializing():
             return jnp.zeros_like(q)
-        max_len = cached_k.value.shape[1]
         if seq != 1:
             raise ValueError(
                 f"decode mode feeds one token per step, got seq={seq}; "
@@ -144,18 +143,11 @@ class Attention(nn.Module):
         )
         cached_k.value, cached_v.value = new_k, new_v
         index.value = i + 1
-        # Scores over the whole buffer, future positions masked out.
-        scale = head_dim**-0.5
-        scores = (
-            jnp.einsum(
-                "bqhd,bkhd->bhqk", q, new_k, preferred_element_type=jnp.float32
-            )
-            * scale
-        )  # [B, H, 1, max_len]
-        valid = jnp.arange(max_len)[None, None, None, :] <= i
-        scores = jnp.where(valid, scores, NEG_INF)
-        weights = jax.nn.softmax(scores, axis=-1).astype(self.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", weights, new_v)
+        # Windowed online-softmax over the filled prefix only — the dense
+        # whole-buffer-then-mask formulation read all max_len rows per token;
+        # decode_attention's dynamic trip count stops at the prefix, so
+        # per-token HBM traffic is O(i), not O(max_len).
+        return decode_attention(q, new_k, new_v, i)
 
 
 class SwiGLU(nn.Module):

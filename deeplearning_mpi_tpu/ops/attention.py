@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 NEG_INF = -1e30  # large-negative mask value; -inf breaks softmax when a row is fully masked
 
@@ -66,3 +67,80 @@ def dense_attention(
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_buf: jax.Array,
+    v_buf: jax.Array,
+    index: jax.Array,
+    *,
+    block: int = 512,
+) -> jax.Array:
+    """One KV-cached decode step: online-softmax attention over the filled
+    prefix of the cache, never touching unfilled blocks.
+
+    ``q`` is ``[B, 1, H, D]`` (the single new token, RoPE applied);
+    ``k_buf``/``v_buf`` are the ``[B, max_len, H, D]`` cache buffers with
+    positions ``0..index`` (inclusive) filled. The dense formulation scores
+    the WHOLE buffer and masks — O(max_len) HBM reads per token no matter
+    how short the prefix. Here the buffer is walked in ``block``-sized
+    chunks under a ``lax.fori_loop`` whose trip count is
+    ``ceil((index+1)/block)`` — a *traced* bound (XLA lowers it to a while
+    loop), so blocks past the prefix are neither read nor scored: decode
+    attention HBM traffic scales with the tokens generated so far, not the
+    buffer size ("flash-decoding" schedule, single chip). The flash-style
+    ``(acc, m, l)`` accumulator keeps softmax exact across chunks in f32.
+
+    Not differentiable (dynamic trip count) — decode is inference-only.
+    """
+    batch, q_len, heads, head_dim = q.shape
+    if q_len != 1:
+        raise ValueError(f"decode_attention takes one query token, got {q_len}")
+    length = k_buf.shape[1]
+    # Blocks stay full-size whatever the buffer length (a CLI cache is
+    # prompt+max_new — arbitrary): the final block's start is clamped back
+    # so it never runs off the buffer, and rows it re-reads from the
+    # previous block are masked out of the softmax. Shrinking the block to
+    # a divisor instead can collapse to near-scalar slices (e.g. 2500 % 512
+    # chains down to 4) and lose to the dense path it replaces.
+    b = min(block, length)
+    n_blocks = (index + b) // b  # ceil((index+1)/b), traced
+    scale = head_dim**-0.5
+    q32 = q[:, 0].astype(jnp.float32) * scale  # [B, H, D]
+
+    def body(j, carry):
+        acc, m, l = carry
+        start = jnp.minimum(j * b, length - b)
+        k_blk = lax.dynamic_slice(
+            k_buf, (0, start, 0, 0), (batch, b, heads, head_dim)
+        )
+        v_blk = lax.dynamic_slice(
+            v_buf, (0, start, 0, 0), (batch, b, heads, head_dim)
+        )
+        s = jnp.einsum(
+            "bhd,bkhd->bhk", q32, k_blk.astype(jnp.float32)
+        )  # [B, H, b]
+        pos = start + jnp.arange(b, dtype=jnp.int32)
+        # Lower bound deduplicates the clamped tail's overlap with block j-1.
+        valid = (pos >= j * b) & (pos <= index)
+        s = jnp.where(valid[None, None, :], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        pv = jnp.einsum(
+            "bhk,bkhd->bhd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        return acc * alpha[..., None] + pv, m_new, l * alpha + jnp.sum(p, axis=-1)
+
+    acc, _, l = lax.fori_loop(
+        0, n_blocks, body,
+        (
+            jnp.zeros((batch, heads, head_dim), jnp.float32),
+            jnp.full((batch, heads), NEG_INF, jnp.float32),
+            jnp.zeros((batch, heads), jnp.float32),
+        ),
+    )
+    out = acc / jnp.maximum(l, 1e-37)[..., None]
+    return out[:, None].astype(q.dtype)

@@ -65,6 +65,79 @@ class TestDenseAttention:
         np.testing.assert_allclose(full[:, 4:], part, atol=1e-5)
 
 
+class TestDecodeAttention:
+    """The windowed decode step vs the dense whole-buffer-then-mask oracle."""
+
+    def _oracle(self, q, k_buf, v_buf, i):
+        from deeplearning_mpi_tpu.ops.attention import NEG_INF
+
+        scale = q.shape[-1] ** -0.5
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_buf, preferred_element_type=jnp.float32
+        ) * scale
+        valid = jnp.arange(k_buf.shape[1])[None, None, None, :] <= i
+        scores = jnp.where(valid, scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_buf.dtype), v_buf)
+
+    @pytest.mark.parametrize("index", [0, 1, 7, 8, 19, 31])
+    def test_matches_dense_oracle_at_every_fill(self, index):
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        rng = np.random.default_rng(index)
+        shape = (2, 32, 3, 8)  # [B, max_len, H, D]
+        k_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        v_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(2, 1, 3, 8)), jnp.float32)
+        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=8)
+        ref = self._oracle(q, k_buf, v_buf, index)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_unfilled_blocks_never_read(self):
+        # Poison the buffer past the prefix with NaN: the dense-then-mask
+        # formulation survives this only via masking; the windowed walk must
+        # never touch those blocks at all (0*NaN would still be NaN in the
+        # accumulator if a poisoned block were scored).
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        rng = np.random.default_rng(0)
+        # Poison from the very first unfilled row (prefix = rows 0..7), so
+        # even a single extra block read past the prefix surfaces as NaN.
+        k_buf = rng.normal(size=(1, 32, 2, 8)).astype(np.float32)
+        v_buf = rng.normal(size=(1, 32, 2, 8)).astype(np.float32)
+        k_buf[:, 8:] = np.nan
+        v_buf[:, 8:] = np.nan
+        q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
+        out = decode_attention(
+            q, jnp.asarray(k_buf), jnp.asarray(v_buf), jnp.int32(7), block=8
+        )
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    @pytest.mark.parametrize("index", [3, 15, 16, 20, 23])
+    def test_non_dividing_length_clamps_tail(self, index):
+        # 24 % 16 != 0: the last block's start clamps back to 8 and re-reads
+        # rows 8..15, which the dedup mask must exclude — blocks stay
+        # full-size for ANY buffer length instead of shrinking to a divisor.
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        rng = np.random.default_rng(3)
+        shape = (1, 24, 2, 8)
+        k_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        v_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
+        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=16)
+        ref = self._oracle(q, k_buf, v_buf, index)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_rejects_multi_token_query(self):
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        q = jnp.zeros((1, 2, 2, 8))
+        buf = jnp.zeros((1, 8, 2, 8))
+        with pytest.raises(ValueError, match="one query token"):
+            decode_attention(q, buf, buf, jnp.int32(0))
+
+
 class TestRoPE:
     def test_rotation_preserves_norm(self):
         x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 7, 2, 8)), jnp.float32)

@@ -1,0 +1,152 @@
+"""KV-cached decode micro-bench: windowed decode_attention vs the dense
+whole-buffer formulation, plus end-to-end generate throughput.
+
+Round-4 evidence for `ops.attention.decode_attention` (the flash-decoding
+schedule replacing the dense full-buffer softmax that was
+`models/transformer.py`'s one kernel-less attention path): per-token decode
+attention at several fill levels of a 2k buffer — the dense path's cost is
+constant in the fill (it always reads all max_len rows), the windowed path's
+cost tracks the filled prefix — and `generate()` tok/s on a ~110M LM at 2k
+context. Timings sync via a device→host fetch; each TPU invocation is one
+bounded compile + short loop (tunnel discipline, BASELINE.md).
+
+Usage: python tools/bench_decode.py [--max_len 2048] [--e2e] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
+                    head_dim: int, steps: int = 50) -> list[dict]:
+    """Per-token decode attention: dense-masked vs windowed, same inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.ops.attention import NEG_INF, decode_attention
+
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    q = jax.random.normal(kq, (batch, 1, heads, head_dim), dt)
+    k_buf = jax.random.normal(kk, (batch, max_len, heads, head_dim), dt)
+    v_buf = jax.random.normal(kv, (batch, max_len, heads, head_dim), dt)
+
+    @jax.jit
+    def dense(q, k_buf, v_buf, i):
+        # The formulation this tool exists to retire: score the whole
+        # buffer, mask the future (pre-round-4 _cached_attention).
+        scale = head_dim**-0.5
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_buf, preferred_element_type=jnp.float32
+        ) * scale
+        valid = jnp.arange(max_len)[None, None, None, :] <= i
+        s = jnp.where(valid, s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
+
+    windowed = jax.jit(decode_attention, static_argnames=("block",))
+
+    def clock(fn, *args) -> float:
+        fn(*args).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / steps * 1e6  # us/token
+
+    rows = []
+    for fill in fills:
+        i = jnp.int32(fill - 1)
+        us_dense = clock(dense, q, k_buf, v_buf, i)
+        us_win = clock(windowed, q, k_buf, v_buf, i)
+        rows.append({
+            "fill": fill, "max_len": max_len,
+            "dense_us_per_token": round(us_dense, 1),
+            "windowed_us_per_token": round(us_win, 1),
+            "speedup": round(us_dense / us_win, 2),
+        })
+        print(json.dumps(rows[-1]))
+    return rows
+
+
+def bench_e2e(max_len: int, *, new_tokens: int = 256) -> dict:
+    """generate() tok/s on a ~110M LM (BASELINE.md flagship shape), prompt
+    filling half the context so the windowed walk sees a realistic mix."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.generate import generate_jit
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=12, num_heads=12, head_dim=64,
+        d_model=768, d_ff=3072,
+    )
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dt)
+    new_tokens = min(new_tokens, max_len // 2)  # small --max_len smokes
+    prompt_len = max_len - new_tokens
+    prompt = jnp.zeros((1, prompt_len), jnp.int32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+
+    # Same jitted entry the CLI ships — timing eager generate() would fold
+    # per-call retracing into the window and measure a path no caller uses.
+    fn = generate_jit(model, max_new_tokens=new_tokens, temperature=0.0)
+    rng = jax.random.key(0)
+
+    def run():
+        return fn(params, prompt, rng)
+
+    jax.block_until_ready(run())  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(run())
+    dt_s = time.perf_counter() - t0
+    positions = prompt_len + new_tokens  # the scan decodes every position
+    row = {
+        "e2e_context": max_len, "new_tokens": new_tokens,
+        "positions_decoded": positions,
+        "seconds": round(dt_s, 3),
+        "positions_per_s": round(positions / dt_s, 1),
+    }
+    print(json.dumps(row))
+    return row
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--max_len", type=int, default=2048)
+    parser.add_argument("--fills", type=int, nargs="+", default=None,
+                        help="prefix lengths to time (default: max_len/8, /2, full)")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--heads", type=int, default=12)
+    parser.add_argument("--head_dim", type=int, default=64)
+    parser.add_argument("--e2e", action="store_true",
+                        help="also run the ~110M-LM generate() end-to-end")
+    parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    fills = args.fills or [args.max_len // 8, args.max_len // 2, args.max_len]
+    bench_attention(
+        args.max_len, fills,
+        batch=args.batch, heads=args.heads, head_dim=args.head_dim,
+    )
+    if args.e2e:
+        bench_e2e(args.max_len)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
