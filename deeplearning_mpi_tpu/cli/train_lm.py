@@ -131,9 +131,12 @@ def main(argv: list[str] | None = None) -> int:
 
     attention_fn = None
     if args.attention == "flash":
-        from deeplearning_mpi_tpu.ops.pallas import flash_attention
+        # The BHSD-native entry: Attention sees .layout == 'bhsd' and
+        # projects q/k/v straight into the kernel layout — no BSHD round
+        # trip in either pass (docs/PERF_ANALYSIS.md §8's transpose tax).
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
 
-        attention_fn = flash_attention
+        attention_fn = flash_attention_bhsd
     elif args.attention == "ring":
         from deeplearning_mpi_tpu.parallel import make_ring_attention_fn
 

@@ -36,7 +36,28 @@ from deeplearning_mpi_tpu.ops.attention import decode_attention, dense_attention
 AttentionFn = Callable[..., jax.Array]
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, *, base: float = 10000.0) -> jax.Array:
+def attention_fn_layout(fn: AttentionFn | None) -> str:
+    """Layout an attention fn expects: its ``layout`` attribute, followed
+    through ``functools.partial`` chains (``partial`` does not forward
+    attributes, and a partial-wrapped BHSD entry silently treated as BSHD
+    would compute attention with the S and H axes swapped — same output
+    shape, wrong numbers). Bare lambdas/closures around a BHSD entry must
+    re-attach ``.layout`` themselves."""
+    while fn is not None:
+        layout = getattr(fn, "layout", None)
+        if layout is not None:
+            return layout
+        fn = getattr(fn, "func", None)  # functools.partial unwrapping
+    return "bshd"
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    *,
+    base: float = 10000.0,
+    layout: str = "bshd",
+) -> jax.Array:
     """Rotary position embedding over ``[B, S, H, D]`` (D even).
 
     Angles and cos/sin are computed in f32 — bf16 *phase* accumulation
@@ -46,13 +67,20 @@ def apply_rope(x: jax.Array, positions: jax.Array, *, base: float = 10000.0) -> 
     round-trip here materialized ~2.4 GB/step of layout copies in the 110M
     LM benchmark (profiled; 50 MB per q/k per layer per direction), one of
     the larger single sources of HBM traffic in the whole step.
+
+    ``layout='bhsd'`` rotates ``[B, H, S, D]`` instead (the flash kernels'
+    native layout) — same math, the broadcast axis moves; elementwise, so
+    no layout copy either way.
     """
-    _, _, _, head_dim = x.shape
-    half = head_dim // 2
+    half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B, S, half]
-    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)  # [B, S, 1, half]
-    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    if layout == "bhsd":
+        cos = jnp.cos(angles)[:, None, :, :].astype(x.dtype)  # [B, 1, S, half]
+        sin = jnp.sin(angles)[:, None, :, :].astype(x.dtype)
+    else:
+        cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)  # [B, S, 1, half]
+        sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
@@ -70,6 +98,59 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
+class _ProjToBHSD(nn.Module):
+    """Q/K/V projection writing straight into ``[B, H, S, D]``.
+
+    Param-tree-identical to ``nn.Dense(H*D, use_bias=False)`` — same
+    ``kernel`` name, shape ``[d_model, H*D]``, init, and dtype policy — so
+    checkpoints interchange freely with the BSHD path and the tensor-
+    parallel column rule (which shards the kernel's last dim along head
+    boundaries) applies unchanged. The layout change lives entirely in the
+    einsum's output indexing: XLA emits one matmul whose result is laid out
+    as BHSD, where reshape-then-transpose after a Dense materializes a
+    ``[B,S,H,D]``-sized copy per projection per step.
+    """
+
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        features = self.num_heads * self.head_dim
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], features),
+            jnp.float32,
+        )
+        k = kernel.astype(self.dtype).reshape(
+            x.shape[-1], self.num_heads, self.head_dim
+        )
+        return jnp.einsum("bsm,mhd->bhsd", x.astype(self.dtype), k)
+
+
+class _ProjFromBHSD(nn.Module):
+    """Output projection consuming ``[B, H, S, D]`` context directly.
+
+    Param-tree-identical to the BSHD path's ``nn.Dense(d_model)`` out_proj
+    (kernel ``[H*D, d_model]``, head-major rows — the same ordering
+    ``ctx.reshape(B, S, H*D)`` produces), so the tensor-parallel row rule
+    applies unchanged and no ``[B,S,H,D]`` transpose precedes the matmul.
+    """
+
+    out_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ctx: jax.Array) -> jax.Array:
+        _, heads, _, head_dim = ctx.shape
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (heads * head_dim, self.out_features), jnp.float32,
+        )
+        k = kernel.astype(self.dtype).reshape(heads, head_dim, self.out_features)
+        return jnp.einsum("bhsd,hdm->bsm", ctx.astype(self.dtype), k)
+
+
 class Attention(nn.Module):
     """Multi-head self-attention with RoPE and a pluggable attention core.
 
@@ -78,6 +159,13 @@ class Attention(nn.Module):
     (``cached_key``/``cached_value`` ``[B, max_len, H, D]`` + a scalar
     ``cache_index``), and the query attends over the filled prefix — O(S)
     per generated token instead of re-running the O(S²) full sequence.
+
+    An ``attention_fn`` carrying ``.layout == 'bhsd'`` (e.g.
+    ``ops.pallas.flash_attention_bhsd``) flips the whole module to the
+    kernel-native layout: q/k/v are *projected* into ``[B, H, S, D]`` and
+    the context consumed from it, so no BSHD↔BHSD copy exists anywhere in
+    the layer — forward or backward (the ~5% step-time transpose tax
+    measured in ``docs/PERF_ANALYSIS.md`` §8).
     """
 
     num_heads: int
@@ -89,10 +177,19 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array, *, causal: bool = True) -> jax.Array:
         features = self.num_heads * self.head_dim
+        batch, seq, _ = x.shape
+        if not self.decode and attention_fn_layout(self.attention_fn) == "bhsd":
+            proj = lambda name: _ProjToBHSD(  # noqa: E731
+                self.num_heads, self.head_dim, self.dtype, name=name
+            )
+            q = apply_rope(proj("q_proj")(x), positions, layout="bhsd")
+            k = apply_rope(proj("k_proj")(x), positions, layout="bhsd")
+            v = proj("v_proj")(x)
+            ctx = self.attention_fn(q, k, v, causal=causal)  # [B, H, S, D]
+            return _ProjFromBHSD(x.shape[-1], self.dtype, name="out_proj")(ctx)
         dense = lambda name: nn.Dense(  # noqa: E731
             features, use_bias=False, dtype=self.dtype, name=name
         )
-        batch, seq, _ = x.shape
         shape = (batch, seq, self.num_heads, self.head_dim)
         q = dense("q_proj")(x).reshape(shape)
         k = dense("k_proj")(x).reshape(shape)

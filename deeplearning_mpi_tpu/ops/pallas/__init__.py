@@ -8,4 +8,5 @@ softmax over S² scores that must never be materialized in HBM.
 
 from deeplearning_mpi_tpu.ops.pallas.flash_attention import (  # noqa: F401
     flash_attention,
+    flash_attention_bhsd,
 )

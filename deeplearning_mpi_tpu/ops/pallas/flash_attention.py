@@ -125,6 +125,7 @@ def _fwd_pallas(
     causal: bool, block_q: int, block_k: int, interpret: bool,
     with_lse: bool,
     out_dtype: jax.typing.DTypeLike | None = None,
+    native_bhsd: bool = False,
 ) -> tuple[jax.Array, jax.Array | None]:
     """Run the kernel on BHSD-transposed inputs; returns BSHD output plus
     (when ``with_lse``, i.e. under grad) the per-row logsumexp
@@ -134,10 +135,16 @@ def _fwd_pallas(
     — the ring schedule requests f32 partials so its cross-rotation
     logsumexp merge never rounds through bf16 (mirrors ``grad_dtype`` in
     :func:`_bwd_pallas`; the accumulator is f32 in VMEM either way, this
-    only changes the final store)."""
-    batch, seq, heads, head_dim = q.shape
+    only changes the final store). ``native_bhsd``: inputs and output are
+    already ``[B, H, S, D]`` — no transposes at either boundary (the
+    zero-copy layout path; see :func:`flash_attention_bhsd`)."""
+    if native_bhsd:
+        batch, heads, seq, head_dim = q.shape
+        qt, kt, vt = q, k, v
+    else:
+        batch, seq, heads, head_dim = q.shape
+        qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
     bq, bk = min(block_q, seq), min(block_k, seq)
-    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
     grid = (batch, heads, seq // bq, seq // bk)
     o_shape = jax.ShapeDtypeStruct(
         (batch, heads, seq, head_dim), out_dtype or q.dtype
@@ -185,7 +192,7 @@ def _fwd_pallas(
         interpret=interpret,
     )(qt, kt, vt)
     out, lse = result if with_lse else (result, None)
-    return _swap_sh(out), lse
+    return (out if native_bhsd else _swap_sh(out)), lse
 
 
 def _tile_p_ds(
@@ -308,6 +315,7 @@ def _bwd_pallas(
     q: jax.Array, k: jax.Array, v: jax.Array, o: jax.Array, do: jax.Array,
     lse: jax.Array, causal: bool, block_q: int, block_k: int, interpret: bool,
     grad_dtype: jax.typing.DTypeLike | None = None,
+    native_bhsd: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused flash backward: two kernels (dq; dk+dv), O(S) memory, no HBM
     probability matrices — replaces the blockwise-JAX backward whose
@@ -316,14 +324,19 @@ def _bwd_pallas(
     instead of the two extra passes the JAX path pays). ``grad_dtype``
     overrides the output dtype (default: match the inputs) — the ring
     schedule requests f32 so its cross-rotation accumulation never rounds a
-    partial to bf16 first."""
-    batch, seq, heads, head_dim = q.shape
+    partial to bf16 first. ``native_bhsd``: all tensors (and the returned
+    grads) are ``[B, H, S, D]`` — no boundary transposes."""
+    if native_bhsd:
+        batch, heads, seq, head_dim = q.shape
+        qt, kt, vt, ot, dot_ = q, k, v, o, do
+    else:
+        batch, seq, heads, head_dim = q.shape
+        qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
+        ot, dot_ = _swap_sh(o), _swap_sh(do)
     dq_dtype = grad_dtype or q.dtype
     dk_dtype = grad_dtype or k.dtype
     dv_dtype = grad_dtype or v.dtype
     bq, bk = min(block_q, seq), min(block_k, seq)
-    qt, kt, vt = _swap_sh(q), _swap_sh(k), _swap_sh(v)
-    ot, dot_ = _swap_sh(o), _swap_sh(do)
     scale = head_dim**-0.5
 
     # One index map per (side, grid): the dq grid is (b, h, q, kv), the dkv
@@ -390,6 +403,8 @@ def _bwd_pallas(
         interpret=interpret,
     )(qt, kt, vt, ot, dot_, lse)
 
+    if native_bhsd:
+        return dq, dk, dv
     return _swap_sh(dq), _swap_sh(dk), _swap_sh(dv)
 
 
@@ -412,24 +427,27 @@ def usable_blocks(bq: int, bk: int, seq: int) -> bool:
     return seq % bq == 0 and seq % bk == 0 and bq % 8 == 0 and bk % 8 == 0
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, native_bhsd=False):
     return _fwd_pallas(
-        q, k, v, causal, block_q, block_k, interpret, with_lse=False
+        q, k, v, causal, block_q, block_k, interpret, with_lse=False,
+        native_bhsd=native_bhsd,
     )[0]
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, native_bhsd=False):
     o, lse = _fwd_pallas(
-        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True,
+        native_bhsd=native_bhsd,
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, block_q, block_k, interpret, native_bhsd, res, do):
     q, k, v, o, lse = res
     return _bwd_pallas(
-        q, k, v, o, do, lse, causal, block_q, block_k, interpret
+        q, k, v, o, do, lse, causal, block_q, block_k, interpret,
+        native_bhsd=native_bhsd,
     )
 
 
@@ -470,6 +488,48 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, causal, bq, bk, interpret)
+
+
+def flash_attention_bhsd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """:func:`flash_attention` over ``[B, H, S, D]`` — the kernels' native
+    layout, with NO transposes at either boundary (forward or backward).
+
+    The BSHD entry pays six ``[B,S,H,D]``-sized XLA transposes per
+    layer-step (q/k/v in, o out, then the mirror set in the backward) just
+    to move between the model's layout and the kernel grid's — measured at
+    ~5% of the 110M-LM step (``docs/PERF_ANALYSIS.md`` §8). A model that
+    *projects* straight into BHSD (``models.transformer.Attention`` via
+    ``jnp.einsum('bsm,mhd->bhsd', ...)`` — the transpose fuses into the
+    projection matmul's output layout) and consumes BHSD context the same
+    way never materializes a layout copy at all. The ``.layout`` attribute
+    below is the signal :class:`~deeplearning_mpi_tpu.models.transformer.
+    Attention` keys on to switch its projection layout.
+
+    Sequences the blocks can't tile fall back to the dense op (transposing
+    around it — correctness everywhere, the fallback is short-sequence).
+    """
+    seq = q.shape[2]
+    bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
+    if not usable_blocks(bq, bk, seq):
+        bshd = dense_attention(_swap_sh(q), _swap_sh(k), _swap_sh(v), causal=causal)
+        return _swap_sh(bshd)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, bq, bk, interpret, True)
+
+
+#: models.transformer.Attention reads this to project q/k/v directly into
+#: the kernel's layout (no BSHD round-trip).
+flash_attention_bhsd.layout = "bhsd"
 
 
 # Block-level entry points for the ring schedule (parallel/ring_flash.py):

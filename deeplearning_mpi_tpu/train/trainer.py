@@ -106,8 +106,20 @@ def make_train_step(
     aux_weight: float = 0.0,
     grad_accum: int = 1,
     loss_chunk: int = 0,
+    state_shardings: Any = None,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
+
+    ``state_shardings`` (a TrainState-shaped sharding pytree, e.g. from
+    ``parallel.infer_state_sharding``) pins the OUTPUT state's placement.
+    Without it, GSPMD's output-sharding propagation may reshard leaves the
+    placement rules replicate (observed: 1-D norm scales picked up the
+    ``model`` axis on a TP mesh), which both drifts the state off its
+    canonical placement (save/restore then sees different shardings than a
+    fresh template) and triggers one extra compile on the second step —
+    the drifted output's shardings become a new input signature. Pure-DP
+    callers can skip it: with every non-data axis size 1 there is nothing
+    for propagation to drift onto.
 
     Grad clipping and the optimizer live in ``state.tx`` (optax chain), so one
     step function serves every workload. ``donate=True`` donates the input
@@ -247,7 +259,12 @@ def make_train_step(
             {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)},
         )
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return jax.jit(
+        step,
+        donate_argnums=(0,) if donate else (),
+        # None leaves the metrics dict unconstrained (tiny scalars).
+        out_shardings=None if state_shardings is None else (state_shardings, None),
+    )
 
 
 def make_eval_step(
@@ -400,10 +417,10 @@ class Trainer:
         self.heartbeat = heartbeat
         self.time_steps = time_steps
         self.zero = zero
-        self.train_step = make_train_step(
-            task, aux_weight=aux_weight, grad_accum=grad_accum,
-            loss_chunk=loss_chunk,
+        self._step_kwargs = dict(
+            aux_weight=aux_weight, grad_accum=grad_accum, loss_chunk=loss_chunk,
         )
+        self.train_step = make_train_step(task, **self._step_kwargs)
         self.eval_step = make_eval_step(task, loss_chunk=loss_chunk)
         self.history: list[dict[str, float]] = []
         self._profiled = False
@@ -584,10 +601,28 @@ class Trainer:
         kernels and their optimizer moments shard over ``model``
         (megatron-style TP via GSPMD); ``zero=True`` additionally shards
         optimizer state over ``data``.
+
+        When any placement rule engages (sharded axes or ZeRO), the train
+        step is rebuilt with its output pinned to this placement — see
+        ``make_train_step(state_shardings=...)`` for why letting GSPMD
+        propagation choose drifts the state and double-compiles.
         """
         from deeplearning_mpi_tpu.parallel import shard_state
+        from deeplearning_mpi_tpu.parallel.tensor_parallel import (
+            infer_state_sharding,
+        )
 
         self.state = shard_state(self.state, self.mesh, zero=self.zero)
+        if self.zero or any(
+            self.mesh.shape[a] > 1 for a in self.mesh.axis_names if a != "data"
+        ):
+            self.train_step = make_train_step(
+                self.task,
+                state_shardings=infer_state_sharding(
+                    self.state, self.mesh, zero=self.zero
+                ),
+                **self._step_kwargs,
+            )
 
     # Back-compat alias for the DP-only name.
     replicate_state = place_state

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Container smoke test: build the TPU-VM image and prove its entry points are
+# alive WITHOUT TPU hardware (CPU platform + virtual devices) — the analog of
+# actually running the reference's image (ref pytorch/unet/Dockerfile:1-54),
+# which its repo never demonstrates either.
+#
+#   ./docker/smoke.sh            # build + smoke (needs a docker daemon)
+#   ./docker/smoke.sh --no-build # smoke an already-built dmt-tpu image
+#
+# What it checks, in order:
+#   1. `docker build` completes (pyproject deps resolve, package installs);
+#   2. `dmt-hello-world --platform cpu --n_virtual_devices 4` exits 0 and
+#      prints broadcast/ring/psum OK — collectives on a 4-device mesh inside
+#      the container;
+#   3. `dmt-train-lm` runs one tiny epoch writing logs + checkpoint under
+#      /workspace — the preflight dir layout baked into the image is real.
+#
+# CI/dev-env note (round-4): the build machine this repo is developed on has
+# no docker daemon (`docker info` fails), so this script is the committed,
+# runnable definition of "the image works" for any host that does — it is NOT
+# a substitute run log. Run it wherever docker exists before shipping the
+# image.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+IMAGE=dmt-tpu
+
+if ! docker info >/dev/null 2>&1; then
+    echo "docker daemon unavailable on this host — cannot smoke the image" >&2
+    exit 2
+fi
+
+if [[ "${1:-}" != "--no-build" ]]; then
+    docker build -t "$IMAGE" -f docker/Dockerfile .
+fi
+
+echo "--- hello_world (4 virtual CPU devices) ---"
+docker run --rm "$IMAGE" \
+    dmt-hello-world --platform cpu --n_virtual_devices 4
+
+echo "--- tiny LM epoch (logs + checkpoint in /workspace) ---"
+docker run --rm "$IMAGE" \
+    dmt-train-lm --platform cpu --num_epochs 1 --batch_size 8 \
+    --seq_len 32 --num_layers 1 --num_heads 2 --head_dim 4 \
+    --d_model 8 --d_ff 16 --train_sequences 16
+
+echo "container smoke OK"

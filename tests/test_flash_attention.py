@@ -116,3 +116,65 @@ def test_ulysses_with_flash_inner():
     out = fn(q, k, v, causal=True)
     ref = dense_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestBHSDNativeEntry:
+    """flash_attention_bhsd: the zero-transpose layout path."""
+
+    def _bhsd(self, B=2, S=64, H=2, D=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return tuple(
+            jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+            for _ in range(3)
+        )
+
+    @pytest.mark.parametrize("causal", [True, False], ids=["causal", "full"])
+    def test_forward_matches_bshd_entry(self, causal):
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        q, k, v = self._bhsd()
+        swap = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
+        out = flash_attention_bhsd(
+            q, k, v, causal=causal, block_q=16, block_k=16
+        )
+        ref = flash_attention(
+            swap(q), swap(k), swap(v), causal=causal, block_q=16, block_k=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(swap(out)), np.asarray(ref), atol=1e-6
+        )
+
+    @pytest.mark.slow
+    def test_grads_match_dense_oracle(self):
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        q, k, v = self._bhsd(S=32)
+        swap = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
+
+        def loss_bhsd(q, k, v):
+            return jnp.sum(flash_attention_bhsd(q, k, v, block_q=16, block_k=16) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(swap(q), swap(k), swap(v)) ** 2)
+
+        g_out = jax.grad(loss_bhsd, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_untileable_seq_falls_back_to_dense(self):
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        q, k, v = self._bhsd(S=20)  # 20 rows: not sublane-tileable
+        swap = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
+        out = flash_attention_bhsd(q, k, v, causal=True)
+        ref = dense_attention(swap(q), swap(k), swap(v), causal=True)
+        np.testing.assert_allclose(
+            np.asarray(swap(out)), np.asarray(ref), atol=2e-5
+        )
+
+    def test_layout_attribute(self):
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        assert flash_attention_bhsd.layout == "bhsd"
+        assert getattr(flash_attention, "layout", "bshd") == "bshd"
