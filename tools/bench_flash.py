@@ -20,6 +20,22 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def _clock(fn, args, steps: int) -> float:
+    """Shared timing harness: one warmup/compile call, device-honest sync
+    via a device→host fetch, mean over ``steps``. Both bench modes MUST use
+    this — divergent sync discipline would make their numbers incomparable.
+    """
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    out = fn(*args)
+    host_sync(out.ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    host_sync(out.ravel()[:1])
+    return (time.perf_counter() - t0) / steps
+
+
 def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
               causal: bool, bwd: bool, steps: int = 10) -> dict:
     import jax
@@ -27,7 +43,6 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
 
     from deeplearning_mpi_tpu.ops.attention import dense_attention
     from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
-    from deeplearning_mpi_tpu.utils.profiling import host_sync
 
     kq, kk, kv = jax.random.split(jax.random.key(0), 3)
     shape = (batch, seq, heads, head_dim)
@@ -40,13 +55,7 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
     dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=causal))
 
     def time_fn(fn):
-        out = fn(q, k, v)
-        host_sync(out.ravel()[:1])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(q, k, v)
-        host_sync(out.ravel()[:1])
-        return (time.perf_counter() - t0) / steps
+        return _clock(fn, (q, k, v), steps)
 
     result: dict = {"seq": seq, "batch": batch, "heads": heads,
                     "head_dim": head_dim, "causal": causal}
@@ -88,6 +97,76 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
     return result
 
 
+def bench_ring_inner(seq: int, *, batch: int, heads: int, head_dim: int,
+                     steps: int = 10) -> dict:
+    """Per-rotation inner comparison: the ring-flash schedule's Pallas block
+    pass vs the XLA ring's dense block pass, one device.
+
+    A real ring needs >=2 chips (this box tunnels one), but the two ring
+    schedules differ ONLY in their inner per-rotation computation — the
+    ppermute pattern, rotation count, and ICI bytes are identical
+    (`parallel/ring_flash.py` vs `parallel/ring_attention.py`). So the
+    per-rotation inner is the measurable single-chip quantity that decides
+    between them: resident-Q flash kernel against a visiting K/V block
+    (scores stay in VMEM) vs blockwise dense attention (an
+    [S_local, S_local] f32 score matrix in HBM per rotation). Multiply by
+    (ring size - 1) + diagonal for a whole-forward estimate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.ops.attention import dense_attention
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+        fit_block,
+        flash_fwd_block,
+        usable_blocks,
+    )
+
+    # Same tiling guard as every production caller (ring_flash.py applies
+    # it before driving these kernels): a non-dividing seq would silently
+    # compute only the first grid's rows and time a fraction of the work.
+    bq, bk = fit_block(1024, seq), fit_block(1024, seq)
+    if not usable_blocks(bq, bk, seq):
+        return {"mode": "ring_inner", "s_local": seq,
+                "error": f"seq {seq} not tileable (blocks {bq}x{bk}); "
+                "production ring_flash falls back to the XLA ring here"}
+
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    shape = (batch, seq, heads, head_dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k_blk = jax.random.normal(kk, shape, jnp.bfloat16)
+    v_blk = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    # Ring-flash inner: full non-causal kernel + the lse the merge consumes
+    # (the off-diagonal "visiting block fully in the past" case — the
+    # dominant one at ring size n: n-1 of n rotations).
+    interpret = jax.default_backend() != "tpu"  # CPU smoke runs the interpreter
+    flash_inner = jax.jit(lambda q, k, v: flash_fwd_block(
+        q, k, v, False, bq, bk, interpret, with_lse=True,
+        out_dtype=jnp.float32,
+    )[0])
+    # XLA-ring inner: blockwise dense with global offsets (non-causal block).
+    dense_inner = jax.jit(lambda q, k, v: dense_attention(
+        q, k, v, causal=False
+    ))
+
+    def time_fn(fn):
+        return _clock(fn, (q, k_blk, v_blk), steps)
+
+    result = {"mode": "ring_inner", "s_local": seq, "batch": batch,
+              "heads": heads, "head_dim": head_dim,
+              "block_q": bq, "block_k": bk}
+    t_flash = time_fn(flash_inner)
+    result["ring_flash_inner_ms"] = round(t_flash * 1e3, 3)
+    try:
+        t_dense = time_fn(dense_inner)
+        result["xla_ring_inner_ms"] = round(t_dense * 1e3, 3)
+        result["speedup"] = round(t_dense / t_flash, 2)
+    except Exception as e:  # noqa: BLE001 — the [S,S] scores OOM first
+        result["xla_ring_inner_error"] = repr(e)[:120]
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, nargs="+", default=[2048, 4096, 8192])
@@ -96,12 +175,26 @@ def main() -> None:
     ap.add_argument("--head_dim", type=int, default=64)
     ap.add_argument("--non_causal", action="store_true")
     ap.add_argument("--bwd", action="store_true")
+    ap.add_argument("--ring_inner", action="store_true",
+                    help="compare the two ring schedules' per-rotation inner "
+                    "pass (the single-chip-measurable part; see "
+                    "bench_ring_inner docstring)")
     args = ap.parse_args()
+    if args.ring_inner and (args.bwd or args.non_causal):
+        ap.error("--ring_inner measures the fwd per-rotation inner only; "
+                 "--bwd/--non_causal do not apply (the off-diagonal ring "
+                 "block is non-causal by construction)")
     for seq in args.seqs:
-        print(json.dumps(bench_one(
-            seq, batch=args.batch, heads=args.heads, head_dim=args.head_dim,
-            causal=not args.non_causal, bwd=args.bwd,
-        )))
+        if args.ring_inner:
+            print(json.dumps(bench_ring_inner(
+                seq, batch=args.batch, heads=args.heads,
+                head_dim=args.head_dim,
+            )))
+        else:
+            print(json.dumps(bench_one(
+                seq, batch=args.batch, heads=args.heads, head_dim=args.head_dim,
+                causal=not args.non_causal, bwd=args.bwd,
+            )))
 
 
 if __name__ == "__main__":
