@@ -91,12 +91,15 @@ class SyntheticCIFAR10:
 
 
 def train_transform(
-    batch: dict[str, np.ndarray], rng: np.random.Generator
+    batch: dict[str, np.ndarray], rng: np.random.Generator, *, flip: bool = True
 ) -> dict[str, np.ndarray]:
     """RandomCrop(32, padding=4) + RandomHorizontalFlip + normalize.
 
     Vectorized parity with the reference's torchvision train transform
     (``pytorch/resnet/main.py:82-87``), applied to a whole uint8 batch.
+    ``flip=False`` drops the horizontal flip for datasets whose classes are
+    not mirror-invariant (e.g. digits/characters — a mirrored 3 is not a 3);
+    CIFAR classes are, so the default matches the reference.
     """
     images = batch["image"]
     n, h, w, c = images.shape
@@ -105,8 +108,9 @@ def train_transform(
     xs = rng.integers(0, 9, size=n)
     windows = np.lib.stride_tricks.sliding_window_view(padded, (h, w), axis=(1, 2))
     cropped = windows[np.arange(n), ys, xs].transpose(0, 2, 3, 1)
-    flip = rng.random(n) < 0.5
-    cropped[flip] = cropped[flip, :, ::-1]
+    if flip:
+        flipped = rng.random(n) < 0.5
+        cropped[flipped] = cropped[flipped, :, ::-1]
     return {"image": _normalize(cropped), "label": batch["label"]}
 
 

@@ -13,17 +13,20 @@ to the 32×32×3 shape the CIFAR trainer consumes.
 
 Everything except the dataset is the reference recipe and this framework's
 standard stack: ResNet-18 with the CIFAR stem, SGD lr 0.1 / momentum 0.9 /
-weight decay 1e-5, batch 128 (``pytorch/resnet/main.py:40-41,113-114,
-162-164``), an 80/20 split, ``ShardedLoader`` + ``Trainer`` + ``RunLogger``
-with eval cadence — so a green run demonstrates the full training machinery
-reaching high accuracy on held-out real data, not a synthetic overfit.
+weight decay 1e-5, batch 128, eval every 10 epochs
+(``pytorch/resnet/main.py:40-41,113-114,136,162-164``), an 80/20 split,
+``ShardedLoader`` + ``Trainer`` + ``RunLogger`` — so a green run
+demonstrates the full training machinery reaching high accuracy on held-out
+real data, not a synthetic overfit. One augmentation deviation, on purpose:
+the reference's RandomHorizontalFlip is disabled (``flip=False``) because
+digits are not mirror-invariant — a flipped 3 is not a 3.
 
-    python tools/accuracy_run.py --platform cpu --num_epochs 20 \
-        --log_dir docs/runs/digits_logs
+    python tools/accuracy_run.py --platform cpu \
+        --log_dir docs/runs/digits_resnet18
 
 Exits non-zero if final held-out top-1 accuracy < --min_accuracy (default
-0.90; the config reliably reaches ~0.95+ — digits is an easy task, which is
-the point: the machinery, not the model, is under test).
+0.90 — digits is an easy task, which is the point: the machinery, not the
+model, is under test).
 """
 
 from __future__ import annotations
@@ -67,9 +70,11 @@ class DigitsAsImages:
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--num_epochs", type=int, default=20)
+    parser.add_argument("--num_epochs", type=int, default=40)
     parser.add_argument("--batch_size", type=int, default=128)
-    parser.add_argument("--eval_every", type=int, default=5)
+    # The reference's cadence (every 10 epochs, pytorch/resnet/main.py:136)
+    # — also the Trainer default.
+    parser.add_argument("--eval_every", type=int, default=10)
     parser.add_argument("--min_accuracy", type=float, default=0.90)
     parser.add_argument("--log_dir", default="logs")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
@@ -104,12 +109,17 @@ def main(argv: list[str] | None = None) -> int:
         model, jax.random.key(0), jnp.zeros((1, 32, 32, 3)), tx
     )
 
+    import functools
+
+    test_ds = DigitsAsImages(train=False)
     train_loader = ShardedLoader(
         DigitsAsImages(train=True), args.batch_size, mesh,
-        shuffle=True, seed=0, transform=train_transform,
+        shuffle=True, seed=0,
+        # flip=False: digits are not mirror-invariant (see module docstring).
+        transform=functools.partial(train_transform, flip=False),
     )
     eval_loader = ShardedLoader(
-        DigitsAsImages(train=False), args.batch_size, mesh,
+        test_ds, args.batch_size, mesh,
         shuffle=False, drop_last=False, transform=eval_transform,
     )
 
@@ -118,18 +128,20 @@ def main(argv: list[str] | None = None) -> int:
         logger=logger, eval_every=args.eval_every,
     )
     trainer.place_state()
-    trainer.fit(train_loader, args.num_epochs, eval_loader=eval_loader)
+    # fit() always evaluates on the final epoch (cadence hit or the explicit
+    # final-eval branch), so the gate reads history — no duplicate eval pass.
+    history = trainer.fit(train_loader, args.num_epochs, eval_loader=eval_loader)
 
-    final = trainer.evaluate(eval_loader)
+    accuracy = history[-1].get("eval_accuracy")
+    if accuracy is None:
+        logger.log("FAILED: no final eval recorded")
+        return 1
     logger.log(
-        f"FINAL held-out: accuracy {final['accuracy']:.4f}, "
-        f"loss {final['loss']:.4f} "
-        f"({len(DigitsAsImages(train=False))} real test digits)"
+        f"FINAL held-out: accuracy {accuracy:.4f} "
+        f"({len(test_ds)} real test digits)"
     )
-    if final["accuracy"] < args.min_accuracy:
-        logger.log(
-            f"FAILED: accuracy {final['accuracy']:.4f} < {args.min_accuracy}"
-        )
+    if accuracy < args.min_accuracy:
+        logger.log(f"FAILED: accuracy {accuracy:.4f} < {args.min_accuracy}")
         return 1
     return 0
 

@@ -115,7 +115,6 @@ def bench_ring_inner(seq: int, *, batch: int, heads: int, head_dim: int,
     import jax
     import jax.numpy as jnp
 
-    from deeplearning_mpi_tpu.ops.attention import dense_attention
     from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
         fit_block,
         flash_fwd_block,
@@ -145,10 +144,24 @@ def bench_ring_inner(seq: int, *, batch: int, heads: int, head_dim: int,
         q, k, v, False, bq, bk, interpret, with_lse=True,
         out_dtype=jnp.float32,
     )[0])
-    # XLA-ring inner: blockwise dense with global offsets (non-causal block).
-    dense_inner = jax.jit(lambda q, k, v: dense_attention(
-        q, k, v, causal=False
-    ))
+    # XLA-ring inner: the PRODUCTION per-rotation update
+    # (ring_attention._block_update — online-softmax merge into f32 running
+    # accumulators), not a plain dense_attention: the decision number must
+    # time exactly what the schedule being decided against executes.
+    from deeplearning_mpi_tpu.parallel.ring_attention import _block_update
+
+    def _xla_inner(q, k, v):
+        acc0 = (
+            jnp.zeros(q.shape, jnp.float32),
+            jnp.zeros(q.shape[:2] + (q.shape[2],), jnp.float32),
+            jnp.full(q.shape[:2] + (q.shape[2],), -1e30, jnp.float32),
+        )
+        o, l, m = _block_update(
+            q, k, v, acc0, causal=False, q_offset=seq, kv_offset=0
+        )
+        return o
+
+    dense_inner = jax.jit(_xla_inner)
 
     def time_fn(fn):
         return _clock(fn, (q, k_blk, v_blk), steps)
