@@ -35,7 +35,10 @@ def _free_port() -> int:
 
 
 def _spawn_workers(n: int, out_dir: Path, local_devices: int = 2,
-                   timeout: float = 300.0, mode: str = "dp") -> list[dict]:
+                   timeout: float = 600.0, mode: str = "dp") -> list[dict]:
+    # 600 s: the workers finish in ~60-120 s alone, but this box has ONE CPU
+    # core — a concurrent heavy process (another test lane, a training run)
+    # stretches 4-worker topologies past 300 s and flaked the 4x1 lane once.
     port = _free_port()
     # The workers run a script by path, so Python puts tests/helpers/ (not
     # the cwd) on sys.path — the repo root must ride PYTHONPATH explicitly
