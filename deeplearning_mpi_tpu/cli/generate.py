@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     run = parser.add_argument_group("runtime")
     run.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     run.add_argument("--n_virtual_devices", type=int, default=None)
+    run.add_argument("--tp", type=int, default=1,
+                     help="tensor-parallel degree for inference: params "
+                     "(and the matmuls) shard over a model axis of this "
+                     "size — serve a checkpoint too big for one device's "
+                     "HBM with the same megatron rules training uses")
     return parser
 
 
@@ -92,6 +97,23 @@ def main(argv: list[str] | None = None) -> int:
     if not ckpt_dir.is_dir():
         print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
         return 1
+    mesh = None
+    if args.tp > 1:
+        # Mesh + device check up front (same fail-fast rule as the ckpt_dir
+        # check above): a too-large --tp must not cost the user the full
+        # init + restore first.
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        if len(jax.devices()) < args.tp:
+            print(
+                f"--tp {args.tp} needs {args.tp} devices, have "
+                f"{len(jax.devices())}",
+                file=sys.stderr,
+            )
+            return 1
+        mesh = create_mesh(
+            MeshSpec(data=1, model=args.tp), devices=jax.devices()[:args.tp]
+        )
 
     cfg = TransformerConfig(
         vocab_size=256,
@@ -115,6 +137,16 @@ def main(argv: list[str] | None = None) -> int:
         model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
         build_optimizer("adam", 1e-3, clip_norm=1.0),
     )
+    if mesh is not None:
+        # Shard the TEMPLATE (training's megatron rules, via the same
+        # shard_state helper): orbax restores each array directly into the
+        # template's sharding, so the checkpoint is born sharded — never
+        # materialized replicated on one device first, which is the whole
+        # point of serving with --tp. The decode scan's cache/activations
+        # pick up their shardings from GSPMD propagation.
+        from deeplearning_mpi_tpu.parallel import shard_state
+
+        template = shard_state(template, mesh)
     ckpt = Checkpointer(ckpt_dir)
     try:
         state = ckpt.restore(template, epoch=args.epoch)
