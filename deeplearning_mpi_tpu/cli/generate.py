@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--temperature", type=float, default=1.0)
     gen.add_argument("--top_k", type=int, default=0,
                      help="0 = full softmax; N>0 = top-N sampling")
+    gen.add_argument("--top_p", type=float, default=1.0,
+                     help="nucleus sampling: restrict to the smallest token "
+                     "set whose probability mass reaches P (1.0 = off; "
+                     "composes with --top_k)")
     gen.add_argument("--greedy", action="store_true",
                      help="argmax decoding (temperature ignored)")
     gen.add_argument("--random_seed", type=int, default=0)
@@ -176,6 +180,7 @@ def main(argv: list[str] | None = None) -> int:
         max_new_tokens=args.max_new_tokens,
         temperature=0.0 if args.greedy else args.temperature,
         top_k=0 if args.greedy else args.top_k,
+        top_p=1.0 if args.greedy else args.top_p,
     )
     rng = jax.random.key(args.random_seed)
     out = fn(state.params, prompt, rng)

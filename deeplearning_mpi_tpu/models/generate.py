@@ -26,12 +26,17 @@ def sample_logits(
     *,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Sample token ids from ``[B, V]`` logits.
 
     ``temperature == 0`` is greedy argmax; ``top_k > 0`` restricts sampling
-    to the k highest-probability tokens (static decisions — part of the
-    compiled program, not traced values).
+    to the k highest-probability tokens; ``top_p < 1`` restricts it to the
+    smallest set of tokens whose probability mass reaches ``top_p``
+    (nucleus sampling — the keep-set size adapts to how peaked the
+    distribution is, where top-k's is fixed). Both filters compose (applied
+    top_k then top_p, each only ever removing tokens). All three are static
+    decisions — part of the compiled program, not traced values.
     """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -39,6 +44,21 @@ def sample_logits(
     if top_k > 0:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        # Keep a token while the mass BEFORE it is < top_p (exclusive
+        # cumsum), so the kept set is the smallest whose total reaches
+        # top_p. The top token is pinned explicitly: at top_p <= 0 the
+        # exclusive rule would keep NOTHING (all logits -> -inf, categorical
+        # then silently returns id 0), so a degenerate setting means
+        # "argmax only" instead of garbage.
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        keep = keep.at[..., 0].set(True)
+        threshold = jnp.min(
+            jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= threshold, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -51,6 +71,7 @@ def generate(
     rng: jax.Array,
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
 
@@ -83,7 +104,8 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         next_tok = sample_logits(
-            logits[:, 0], sub, temperature=temperature, top_k=top_k
+            logits[:, 0], sub, temperature=temperature, top_k=top_k,
+            top_p=top_p,
         )
         return (mutated["cache"], next_tok, rng), tok
 

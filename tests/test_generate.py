@@ -133,3 +133,49 @@ class TestSampleLogits:
                 logits, jax.random.key(seed), temperature=1.0, top_k=2
             )
             assert int(out[0]) in (0, 1)
+
+    def test_top_p_keeps_smallest_nucleus(self):
+        # softmax([2, 1, 0, -9]) ≈ [.70, .26, .095*, ...]: top-1 mass .70
+        # clears p=.5 alone; p=.9 needs the top-2 (mass .96); the tail never
+        # qualifies at either setting.
+        logits = jnp.asarray([[2.0, 1.0, 0.0, -9.0]])
+        for seed in range(20):
+            only_top = sample_logits(
+                logits, jax.random.key(seed), temperature=1.0, top_p=0.5
+            )
+            assert int(only_top[0]) == 0
+            top_two = sample_logits(
+                logits, jax.random.key(seed), temperature=1.0, top_p=0.9
+            )
+            assert int(top_two[0]) in (0, 1)
+
+    def test_top_p_zero_degenerates_to_argmax(self):
+        # top_p <= 0 pins the top token instead of masking everything to
+        # -inf (which would make categorical silently emit id 0).
+        logits = jnp.asarray([[0.5, 3.0, 1.0, 0.0]])
+        for seed in range(10):
+            out = sample_logits(
+                logits, jax.random.key(seed), temperature=1.0, top_p=0.0
+            )
+            assert int(out[0]) == 1
+
+    def test_top_p_one_is_identity(self):
+        logits = jnp.asarray([[0.3, 0.1, -0.2, 0.0]])
+        for seed in range(5):
+            a = sample_logits(logits, jax.random.key(seed), temperature=1.0)
+            b = sample_logits(
+                logits, jax.random.key(seed), temperature=1.0, top_p=1.0
+            )
+            assert int(a[0]) == int(b[0])
+
+    def test_top_p_composes_with_top_k(self):
+        # top_k=3 drops index 2 (0.5); over the survivors softmax ≈
+        # [.49, .066, —, .443], so top_p=.4 keeps only the argmax (its
+        # exclusive cumulative mass 0 < .4, the runner-up's .49 is not).
+        logits = jnp.asarray([[3.0, 1.0, 0.5, 2.9]])
+        for seed in range(20):
+            out = sample_logits(
+                logits, jax.random.key(seed), temperature=1.0,
+                top_k=3, top_p=0.4,
+            )
+            assert int(out[0]) == 0
