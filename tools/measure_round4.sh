@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Round-4 on-chip measurement runbook, executable form (BASELINE.md
+# "Round-4 measurement debt"). Run on a machine whose TPU tunnel is ALIVE.
+#
+# Bounding strategy: a 120 s probe gates entry AND re-runs between steps
+# (cheap, kills nothing mid-compile), and each step carries a GENEROUS
+# timeout — long enough that only a truly wedged tunnel ever hits it.
+# That ordering matters: killing a live remote compile is what wedged the
+# tunnel for hours before (BASELINE.md tunnel notes), so the timeouts are
+# a last resort against an already-dead tunnel, not a scheduler.
+#
+# A failed step does not stop the following ones (partial results beat a
+# wedge) but DOES fail the script's exit status — automation must not read
+# "ran to the end" as "numbers are ready". Results go to stdout (JSON
+# lines); append them to BASELINE.md "Established baselines" and
+# docs/PERF_ANALYSIS.md §8.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+probe() {
+    timeout -k 10 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+step() {  # step <name> <timeout_s> <cmd...>
+    local name=$1 t=$2; shift 2
+    echo "== $name =="
+    if ! probe; then
+        echo "TUNNEL DEAD before '$name' — skipping remaining steps" >&2
+        rc=2
+        exit $rc
+    fi
+    if ! timeout -k 30 "$t" "$@"; then
+        echo "STEP FAILED: $name" >&2
+        rc=1
+    fi
+}
+
+step "1. full bench (per-workload lines + combined final line)" 1800 \
+    python bench.py
+step "2. decode: windowed vs dense at 2k + e2e generate" 1200 \
+    python tools/bench_decode.py --e2e
+step "3. ring schedules' per-rotation inner at 8k local seq" 1200 \
+    python tools/bench_flash.py --ring_inner --seqs 8192
+step "4. 64k-token single-chip step (flash + remat + chunked loss)" 1800 \
+    python -m deeplearning_mpi_tpu.cli.train_lm \
+    --seq_len 65536 --attention flash --remat --loss_chunk 2048 \
+    --batch_size 1 --num_epochs 1 --train_sequences 2 \
+    --model_dir /tmp/m4_ckpt --log_dir /tmp/m4_logs
+
+echo "== 5. (opt-in, slow compile) 32k long-context bench entry =="
+echo "   run manually if the tunnel is healthy: python bench.py --long_context"
+exit $rc
