@@ -39,11 +39,12 @@ def main() -> int:
     ap.add_argument("--process_id", type=int, required=True)
     ap.add_argument("--local_devices", type=int, default=2)
     ap.add_argument("--out_dir", required=True)
-    ap.add_argument("--mode", choices=("dp", "tp"), default="dp",
+    ap.add_argument("--mode", choices=("dp", "tp", "sp", "ep", "pp"),
+                    default="dp",
                     help="dp: replicated-param ResNet steps (DDP parity); "
-                    "tp: megatron-sharded LM steps over a model axis — the "
-                    "non-DP-axis-across-processes path (round-3 verdict "
-                    "missing #3)")
+                    "tp/sp/ep/pp: LM steps with the model / seq / expert / "
+                    "pipe mesh axis engaged — the non-DP-axes-across-"
+                    "processes paths (round-3 verdict missing #3)")
     args = ap.parse_args()
 
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -90,6 +91,12 @@ def main() -> int:
 
     if args.mode == "tp":
         _train_tp(args, result)
+        out = Path(args.out_dir) / f"proc{args.process_id}.json"
+        out.write_text(json.dumps(result))
+        bootstrap.shutdown()
+        return 0
+    if args.mode in ("sp", "ep", "pp"):
+        _train_axis(args, result, args.mode)
         out = Path(args.out_dir) / f"proc{args.process_id}.json"
         out.write_text(json.dumps(result))
         bootstrap.shutdown()
@@ -179,6 +186,114 @@ TP_LOADER = dict(batch=16, shuffle_seed=9)
 TP_OPT = dict(lr=1e-3, clip_norm=1.0)
 TP_INIT_SEED = 0
 TP_STEPS = 2
+
+
+def _train_axis(args, result: dict, mode: str) -> None:
+    """2 LM train steps with the ``seq`` (ring attention), ``expert`` (MoE
+    dispatch), or ``pipe`` (GPipe schedule) mesh axis spanning the
+    OS-process boundary.
+
+    With one local device per process, every ppermute rotation (sp, and the
+    GPipe stage-to-stage transfer in pp) / expert all-to-all combine (ep)
+    rides the gloo transport between real processes — the remaining non-DP
+    axes the single-process suite cannot honestly exercise. The parent
+    cross-checks the loss sequence against a single-process single-device
+    oracle (dense attention / EP=1 / pp=1 degenerate schedule): axis
+    sharding is a placement decision, so the math must not move.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.data import ShardedLoader, SyntheticTokens
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.parallel import make_ring_attention_fn, shard_state
+    from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    n = jax.device_count()
+    aux_weight = 0.0
+    if mode == "sp":
+        mesh = create_mesh(MeshSpec(data=n // 2, seq=2))
+        cfg = TransformerConfig(**TP_LM)
+        model = TransformerLM(
+            config=cfg, dtype=jnp.float32,
+            attention_fn=make_ring_attention_fn(mesh),
+        )
+    elif mode == "pp":
+        from deeplearning_mpi_tpu.models.pipeline_lm import PipelinedLM
+
+        mesh = create_mesh(MeshSpec(data=n // 2, pipe=2))
+        cfg = TransformerConfig(**TP_LM)  # num_layers=2 -> 1 layer per stage
+        model = PipelinedLM(
+            cfg, mesh, num_microbatches=PP_MICROBATCHES, dtype=jnp.float32
+        )
+    else:  # ep
+        mesh = create_mesh(MeshSpec(data=n // 2, expert=2))
+        cfg = TransformerConfig(**TP_LM, moe_experts=2)
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        aux_weight = AXIS_AUX_WEIGHT
+
+    from deeplearning_mpi_tpu.parallel.tensor_parallel import infer_state_sharding
+
+    # pp uses plain SGD: the GPipe schedule reorders f32 reductions, and
+    # Adam's first update is ~sign(g)*lr — associativity noise on near-zero
+    # grads flips signs and blows the oracle comparison to ~1e-3 (same
+    # effect the grad-accum equality test documents). SGD is linear in the
+    # grads, so only genuine math differences can move the loss.
+    tx = (
+        build_optimizer("sgd", PP_OPT["lr"], momentum=PP_OPT["momentum"])
+        if mode == "pp"
+        else build_optimizer("adam", TP_OPT["lr"], clip_norm=TP_OPT["clip_norm"])
+    )
+    state = shard_state(
+        create_train_state(
+            model, jax.random.key(TP_INIT_SEED),
+            jnp.zeros((1, TP_SEQ_LEN), jnp.int32), tx,
+        ),
+        mesh,
+    )
+    axis = {"sp": "seq", "ep": "expert", "pp": "pipe"}[mode]
+    assert mesh.shape[axis] == 2
+    if mode in ("ep", "pp"):
+        # Expert-/stage-stacked params must actually shard over the axis
+        # (sp shards activations, not params — nothing to check there).
+        n_sharded = sum(
+            1
+            for leaf in jax.tree.leaves(state.params)
+            if hasattr(leaf, "sharding")
+            and any(axis in (s or ()) for s in leaf.sharding.spec)
+        )
+        assert n_sharded > 0, f"{mode} sharding did not engage"
+        result[f"n_{mode}_sharded"] = n_sharded
+
+    loader = ShardedLoader(
+        SyntheticTokens(
+            TP_DATASET["n"], TP_DATASET["seq_len"], seed=TP_DATASET["seed"]
+        ),
+        TP_LOADER["batch"], mesh, shuffle=True, seed=TP_LOADER["shuffle_seed"],
+        num_workers=2,
+    )
+    step = make_train_step(
+        "lm", aux_weight=aux_weight,
+        state_shardings=infer_state_sharding(state, mesh),
+    )
+    losses = []
+    for _, batch in zip(range(TP_STEPS), loader.epoch(0)):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    result[mode] = {"losses": losses, "local_rows": sum(
+        b - a for a, b in loader.local_row_ranges
+    )}
+
+
+#: ep-mode MoE aux-loss weight — shared with the parent's oracle.
+AXIS_AUX_WEIGHT = 0.01
+#: pp-mode GPipe microbatch count — shared with the parent's oracle.
+PP_MICROBATCHES = 2
+#: pp-mode optimizer (plain SGD; see _train_axis's note) — shared with the
+#: parent's oracle like the other workload knobs.
+PP_OPT = dict(lr=1e-2, momentum=0.0)
 
 
 def _train_tp(args, result: dict) -> None:
