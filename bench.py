@@ -229,6 +229,46 @@ def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10,
     }
 
 
+def bench_decode(context: int = 2048, new_tokens: int = 128) -> dict:
+    """KV-cached decode throughput on the 110M model at 2k context — the
+    inference-side flagship number (windowed decode_attention walks only the
+    filled prefix; `tools/bench_decode.py` has the dense-vs-windowed
+    breakdown). One jitted scan over all `context` positions; prompt fills
+    the rest so the cache walk sees a realistic prefix mix. Bounded: one
+    compile + two runs. Synced by a device-to-host fetch (host_sync) like
+    every other bench here — block_until_ready has returned before remote
+    execution finished on the tunneled TPU (see host_sync's docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.models.generate import generate_jit
+    from deeplearning_mpi_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    config = TransformerConfig()
+    model = TransformerLM(config=config, dtype=jnp.bfloat16)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.zeros((1, context - new_tokens), jnp.int32)
+    fn = generate_jit(model, max_new_tokens=new_tokens, temperature=0.0)
+    rng = jax.random.key(0)
+    host_sync(fn(params, prompt, rng).ravel()[:1])  # compile + warm run
+    t0 = time.perf_counter()
+    host_sync(fn(params, prompt, rng).ravel()[:1])
+    dt = time.perf_counter() - t0
+    return {
+        "context": context,
+        "new_tokens": new_tokens,
+        "positions_decoded": context,
+        "seconds": round(dt, 3),
+        "decode_positions_per_s": round(context / dt, 1),
+    }
+
+
 def bench_allreduce() -> dict:
     """Gradient-sized all-reduce latency over the data axis — the BASELINE.md
     'DDP all-reduce step latency' metric (the reference's unmeasured hot path,
@@ -290,6 +330,7 @@ def main() -> None:
     parser.add_argument("--skip_224", action="store_true")
     parser.add_argument("--skip_lm", action="store_true")
     parser.add_argument("--skip_unet", action="store_true")
+    parser.add_argument("--skip_decode", action="store_true")
     parser.add_argument("--long_context", action="store_true",
                         help="add the 32k-seq flash+remat LM entry (slow "
                         "compile; see the comment at its call site)")
@@ -317,6 +358,7 @@ def main() -> None:
                         "lm_tokens_per_s": None,
                         "lm_mfu": None,
                         "unet_images_per_s": None,
+                        "decode_positions_per_s": None,
                         "allreduce_latency_ms": None,
                         "details": {},
                         "error": probe_error,
@@ -396,6 +438,14 @@ def main() -> None:
             steps=max(args.steps // 2, 5),
         )
 
+    decode = None
+    if not args.skip_decode:
+        decode = run(
+            "lm_decode_2k", bench_decode,
+            metric="lm_110m_decode_positions_per_sec",
+            unit="positions/s", value_key="decode_positions_per_s",
+        )
+
     allreduce = run(
         "allreduce", bench_allreduce,
         metric="allreduce_latency_ms", unit="ms", value_key="all_reduce_ms_mean",
@@ -414,6 +464,9 @@ def main() -> None:
                 "lm_tokens_per_s": (lm or {}).get("tokens_per_s_per_chip"),
                 "lm_mfu": (lm or {}).get("mfu"),
                 "unet_images_per_s": (unet or {}).get("images_per_s_per_chip"),
+                "decode_positions_per_s": (decode or {}).get(
+                    "decode_positions_per_s"
+                ),
                 "allreduce_latency_ms": (allreduce or {}).get("all_reduce_ms_mean"),
                 "details": details,
             }
