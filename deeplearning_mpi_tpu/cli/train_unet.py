@@ -41,6 +41,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--val_fraction", type=float, default=0.2,
                         help="held-out fraction (80/20 split parity, train.py:86-88)")
     parser.add_argument("--clip_norm", type=float, default=1.0)
+    parser.add_argument("--loss", default="bce",
+                        choices=("bce", "dice", "bce_dice"),
+                        help="training objective: bce = reference parity "
+                        "(train.py:160-162); dice = soft form of the "
+                        "reference's eval metric; bce_dice = their sum")
     parser.add_argument("--synthetic", action="store_true",
                         help="train on synthetic ellipse-segmentation data")
     parser.add_argument("--train_samples", type=int, default=256)
@@ -161,7 +166,7 @@ def main(argv: list[str] | None = None) -> int:
         trainer = Trainer(
             state, "segmentation", mesh,
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
-            grad_accum=args.grad_accum, zero=args.zero,
+            grad_accum=args.grad_accum, zero=args.zero, seg_loss=args.loss,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
         config.build_observability(args, trainer)

@@ -69,14 +69,19 @@ def sigmoid_binary_cross_entropy(
 
 
 def dice_loss(
-    logits: jax.Array, targets: jax.Array, *, eps: float = 1e-8
+    logits: jax.Array,
+    targets: jax.Array,
+    where: jax.Array | None = None,
+    *,
+    eps: float = 1e-8,
 ) -> jax.Array:
     """Soft Dice loss (1 - soft Dice coefficient), averaged over the batch.
 
     The reference only uses Dice as an eval metric
     (``pytorch/unet/train.py:124-140``); offering it as a training loss is a
-    standard segmentation extension. Uses the same ``eps`` smoothing as the
-    reference's metric.
+    standard segmentation extension (``dmt-train-unet --loss dice``). Uses
+    the same ``eps`` smoothing as the reference's metric. ``where`` ([B],
+    1 = real example) excludes wrap-padded eval rows, like the other losses.
     """
     probs = jax.nn.sigmoid(logits.astype(jnp.float32))
     targets = targets.astype(jnp.float32)
@@ -84,7 +89,7 @@ def dice_loss(
     intersection = jnp.sum(probs * targets, axis=reduce_axes)
     union = jnp.sum(probs, axis=reduce_axes) + jnp.sum(targets, axis=reduce_axes)
     dice = (2.0 * intersection + eps) / (union + eps)
-    return jnp.mean(1.0 - dice)
+    return masked_mean(1.0 - dice, where)
 
 
 def lm_cross_entropy(

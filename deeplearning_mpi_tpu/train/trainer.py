@@ -38,6 +38,7 @@ from deeplearning_mpi_tpu.data.loader import prefetch
 from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
 from deeplearning_mpi_tpu.ops import (
     chunked_lm_loss,
+    dice_loss,
     dice_score,
     lm_cross_entropy,
     sigmoid_binary_cross_entropy,
@@ -83,17 +84,34 @@ def _lm_loss_chunked(chunk_size: int) -> LossFn:
     return fn
 
 
-def _task_loss(task: str) -> LossFn:
+def _task_loss(task: str, *, seg_loss: str = "bce") -> LossFn:
     """Loss for a task; ``where`` ([B] validity mask or None) excludes
-    wrap-padded eval rows from the mean."""
+    wrap-padded eval rows from the mean.
+
+    ``seg_loss`` selects the segmentation objective: ``bce`` (reference
+    parity, ``pytorch/unet/train.py:160-162``), ``dice`` (the soft form of
+    the reference's eval metric), or ``bce_dice`` (their sum — the common
+    region+pixel compound objective).
+    """
     if task == "classification":
         return lambda logits, batch, where=None: softmax_cross_entropy(
             logits, batch["label"], where
         )
     if task == "segmentation":
-        return lambda logits, batch, where=None: sigmoid_binary_cross_entropy(
-            logits[..., 0], batch["mask"], where
-        )
+        if seg_loss == "bce":
+            return lambda logits, batch, where=None: sigmoid_binary_cross_entropy(
+                logits[..., 0], batch["mask"], where
+            )
+        if seg_loss == "dice":
+            return lambda logits, batch, where=None: dice_loss(
+                logits[..., 0], batch["mask"], where
+            )
+        if seg_loss == "bce_dice":
+            return lambda logits, batch, where=None: (
+                sigmoid_binary_cross_entropy(logits[..., 0], batch["mask"], where)
+                + dice_loss(logits[..., 0], batch["mask"], where)
+            )
+        raise ValueError(f"unknown seg_loss '{seg_loss}'")
     if task == "lm":
         return _lm_loss
     raise ValueError(f"unknown task '{task}'")
@@ -106,6 +124,7 @@ def make_train_step(
     aux_weight: float = 0.0,
     grad_accum: int = 1,
     loss_chunk: int = 0,
+    seg_loss: str = "bce",
     state_shardings: Any = None,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
@@ -149,7 +168,7 @@ def make_train_step(
     """
     loss_fn = (
         _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
-        else _task_loss(task)
+        else _task_loss(task, seg_loss=seg_loss)
     )
     input_key = _INPUTS[task]
 
@@ -268,7 +287,7 @@ def make_train_step(
 
 
 def make_eval_step(
-    task: str, *, loss_chunk: int = 0
+    task: str, *, loss_chunk: int = 0, seg_loss: str = "bce"
 ) -> Callable[[TrainState, Batch], dict[str, jax.Array]]:
     """Build the jitted eval step: loss + task metric on one batch.
 
@@ -281,7 +300,7 @@ def make_eval_step(
 
     loss_fn = (
         _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
-        else _task_loss(task)
+        else _task_loss(task, seg_loss=seg_loss)
     )
     input_key = _INPUTS[task]
 
@@ -402,6 +421,7 @@ class Trainer:
         aux_weight: float = 0.0,  # MoE load-balance loss weight
         grad_accum: int = 1,  # gradient-accumulation chunks per optimizer step
         loss_chunk: int = 0,  # LM chunked head+loss (pair with return_prehead)
+        seg_loss: str = "bce",  # segmentation objective: bce | dice | bce_dice
         profiler: Any = None,  # utils.profiling.Profiler; traces a few hot steps
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
@@ -419,9 +439,10 @@ class Trainer:
         self.zero = zero
         self._step_kwargs = dict(
             aux_weight=aux_weight, grad_accum=grad_accum, loss_chunk=loss_chunk,
+            seg_loss=seg_loss,
         )
         self.train_step = make_train_step(task, **self._step_kwargs)
-        self.eval_step = make_eval_step(task, loss_chunk=loss_chunk)
+        self.eval_step = make_eval_step(task, loss_chunk=loss_chunk, seg_loss=seg_loss)
         self.history: list[dict[str, float]] = []
         self._profiled = False
 

@@ -89,3 +89,14 @@ class TestDice:
         good = dice_loss(jnp.full((1, 4, 4), 10.0), target)
         bad = dice_loss(jnp.full((1, 4, 4), -10.0), target)
         assert float(good) < 0.01 < float(bad)
+
+    def test_dice_loss_where_excludes_padded_rows(self):
+        target = jnp.ones((2, 4, 4))
+        # Row 0 perfect, row 1 terrible; masking row 1 out must recover the
+        # perfect loss (the wrap-padded eval-row convention).
+        logits = jnp.stack([jnp.full((4, 4), 10.0), jnp.full((4, 4), -10.0)])
+        full = dice_loss(logits, target)
+        masked = dice_loss(logits, target, jnp.asarray([1.0, 0.0]))
+        only_good = dice_loss(logits[:1], target[:1])
+        assert float(masked) == pytest.approx(float(only_good), abs=1e-6)
+        assert float(full) > float(masked)

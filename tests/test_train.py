@@ -440,3 +440,58 @@ class TestLRSchedule:
             state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
         assert not np.allclose(np.asarray(jax.tree.leaves(state.params)[0]), np.asarray(p0))
+
+
+class TestSegLossSelector:
+    def test_variants_and_composition(self):
+        from deeplearning_mpi_tpu.train.trainer import _task_loss
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(2, 4, 4, 1)), jnp.float32)
+        batch = {
+            "mask": jnp.asarray(
+                (rng.random((2, 4, 4)) > 0.5).astype(np.float32)
+            )
+        }
+        bce = float(_task_loss("segmentation")(logits, batch))
+        dice = float(_task_loss("segmentation", seg_loss="dice")(logits, batch))
+        both = float(
+            _task_loss("segmentation", seg_loss="bce_dice")(logits, batch)
+        )
+        assert bce != pytest.approx(dice)
+        assert both == pytest.approx(bce + dice, rel=1e-6)
+        with pytest.raises(ValueError, match="seg_loss"):
+            _task_loss("segmentation", seg_loss="jaccard")
+
+    def test_dice_training_step_decreases_dice_loss(self):
+        # A tiny conv head trained under seg_loss='dice' must reduce the
+        # dice objective — the selector reaches the jitted step end to end.
+        import flax.linen as nn
+
+        from deeplearning_mpi_tpu.train import create_train_state
+        from deeplearning_mpi_tpu.train.trainer import (
+            build_optimizer,
+            make_train_step,
+        )
+
+        class Head(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                return nn.Conv(1, (3, 3), padding="SAME")(x)
+
+        rng = np.random.default_rng(1)
+        images = jnp.asarray(rng.normal(size=(8, 8, 8, 3)), jnp.float32)
+        masks = jnp.asarray(
+            (images.sum(-1) > 0).astype(np.float32)
+        )
+        batch = {"image": images, "mask": masks}
+        state = create_train_state(
+            Head(), jax.random.key(0), jnp.zeros((1, 8, 8, 3)),
+            build_optimizer("adam", 1e-2),
+        )
+        step = make_train_step("segmentation", donate=False, seg_loss="dice")
+        losses = []
+        for _ in range(10):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]
