@@ -161,6 +161,7 @@ def main(argv: list[str] | None = None) -> int:
         vocab_size=256,
         num_layers=args.num_layers,
         num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads or None,
         head_dim=args.head_dim,
         d_model=args.d_model,
         d_ff=args.d_ff,

@@ -30,7 +30,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning_mpi_tpu.ops.attention import decode_attention, dense_attention
+from deeplearning_mpi_tpu.ops.attention import (
+    decode_attention,
+    dense_attention,
+    repeat_kv,
+)
 
 # (q, k, v [B,S,H,D], causal=...) -> context [B,S,H,D]
 AttentionFn = Callable[..., jax.Array]
@@ -156,9 +160,10 @@ class Attention(nn.Module):
 
     ``decode=True`` switches to single-token autoregressive mode: K/V for
     each new token are appended to a ``cache`` collection
-    (``cached_key``/``cached_value`` ``[B, max_len, H, D]`` + a scalar
-    ``cache_index``), and the query attends over the filled prefix — O(S)
-    per generated token instead of re-running the O(S²) full sequence.
+    (``cached_key``/``cached_value`` ``[B, max_len, Hkv, D]`` where ``Hkv``
+    is ``num_kv_heads`` — fewer than ``num_heads`` under GQA — plus a
+    scalar ``cache_index``), and the query attends over the filled prefix —
+    O(S) per generated token instead of re-running the O(S²) full sequence.
 
     An ``attention_fn`` carrying ``.layout == 'bhsd'`` (e.g.
     ``ops.pallas.flash_attention_bhsd``) flips the whole module to the
@@ -173,34 +178,51 @@ class Attention(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
     decode: bool = False
+    #: grouped-query attention: number of shared K/V heads (None = num_heads,
+    #: plain MHA). K/V are projected and CACHED at this head count — the KV
+    #: cache and decode HBM reads shrink by num_heads/num_kv_heads — and the
+    #: full-sequence cores receive ``repeat_kv``'d tensors (see
+    #: ops.attention.repeat_kv for why that trade is per-phase correct).
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array, *, causal: bool = True) -> jax.Array:
         features = self.num_heads * self.head_dim
         batch, seq, _ = x.shape
-        if not self.decode and attention_fn_layout(self.attention_fn) == "bhsd":
-            proj = lambda name: _ProjToBHSD(  # noqa: E731
-                self.num_heads, self.head_dim, self.dtype, name=name
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(
+                f"num_kv_heads ({kv_heads}) must divide num_heads ({self.num_heads})"
             )
-            q = apply_rope(proj("q_proj")(x), positions, layout="bhsd")
-            k = apply_rope(proj("k_proj")(x), positions, layout="bhsd")
-            v = proj("v_proj")(x)
-            ctx = self.attention_fn(q, k, v, causal=causal)  # [B, H, S, D]
+        rep = self.num_heads // kv_heads
+        if not self.decode and attention_fn_layout(self.attention_fn) == "bhsd":
+            proj = lambda heads, name: _ProjToBHSD(  # noqa: E731
+                heads, self.head_dim, self.dtype, name=name
+            )
+            q = apply_rope(proj(self.num_heads, "q_proj")(x), positions, layout="bhsd")
+            k = apply_rope(proj(kv_heads, "k_proj")(x), positions, layout="bhsd")
+            v = proj(kv_heads, "v_proj")(x)
+            ctx = self.attention_fn(
+                q, repeat_kv(k, rep, axis=1), repeat_kv(v, rep, axis=1),
+                causal=causal,
+            )  # [B, H, S, D]
             return _ProjFromBHSD(x.shape[-1], self.dtype, name="out_proj")(ctx)
-        dense = lambda name: nn.Dense(  # noqa: E731
-            features, use_bias=False, dtype=self.dtype, name=name
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=False, dtype=self.dtype, name=name
         )
-        shape = (batch, seq, self.num_heads, self.head_dim)
-        q = dense("q_proj")(x).reshape(shape)
-        k = dense("k_proj")(x).reshape(shape)
-        v = dense("v_proj")(x).reshape(shape)
+        kv_shape = (batch, seq, kv_heads, self.head_dim)
+        q = dense(features, "q_proj")(x).reshape(
+            batch, seq, self.num_heads, self.head_dim
+        )
+        k = dense(kv_heads * self.head_dim, "k_proj")(x).reshape(kv_shape)
+        v = dense(kv_heads * self.head_dim, "v_proj")(x).reshape(kv_shape)
         q = apply_rope(q, positions)
         k = apply_rope(k, positions)
         if self.decode:
             ctx = self._cached_attention(q, k, v)
         else:
             attn = self.attention_fn or dense_attention
-            ctx = attn(q, k, v, causal=causal)
+            ctx = attn(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal)
         ctx = ctx.reshape(batch, seq, features)
         # "out_proj" triggers tensor_parallel's row-parallel (input-dim) rule.
         return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="out_proj")(ctx)
@@ -212,14 +234,15 @@ class Attention(nn.Module):
         first apply with a ``[B, max_len, ...]``-shaped input establishing
         ``max_len``; decode steps then feed one token at a time (seq == 1).
         """
-        batch, seq, heads, head_dim = q.shape
+        batch, seq, _, head_dim = q.shape
+        kv_heads = k.shape[2]  # < q heads under GQA: the cache stores Hkv
         cached_k = self.variable(
             "cache", "cached_key",
-            lambda: jnp.zeros((batch, seq, heads, head_dim), self.dtype),
+            lambda: jnp.zeros((batch, seq, kv_heads, head_dim), self.dtype),
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            lambda: jnp.zeros((batch, seq, heads, head_dim), self.dtype),
+            lambda: jnp.zeros((batch, seq, kv_heads, head_dim), self.dtype),
         )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -271,12 +294,14 @@ class Block(nn.Module):
     attention_fn: AttentionFn | None = None
     mlp_cls: type[nn.Module] | None = None
     decode: bool = False
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         x = x + Attention(
             self.num_heads, self.head_dim, self.dtype,
-            attention_fn=self.attention_fn, decode=self.decode, name="attn",
+            attention_fn=self.attention_fn, decode=self.decode,
+            num_kv_heads=self.num_kv_heads, name="attn",
         )(RMSNorm(name="attn_norm")(x), positions)
         mlp = (self.mlp_cls or SwiGLU)(self.d_ff, self.dtype, name="mlp")
         return x + mlp(RMSNorm(name="mlp_norm")(x))
@@ -294,6 +319,10 @@ class TransformerConfig:
     vocab_size: int = 32_000
     num_layers: int = 12
     num_heads: int = 12
+    #: grouped-query attention: K/V heads shared by groups of query heads
+    #: (None = num_heads, plain MHA). Must divide num_heads. The KV cache
+    #: and decode HBM traffic shrink by num_heads/num_kv_heads.
+    num_kv_heads: int | None = None
     head_dim: int = 64
     d_model: int = 768
     d_ff: int = 2048
@@ -372,7 +401,8 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
                 attention_fn=self.attention_fn, mlp_cls=mlp_cls,
-                decode=self.decode, name=f"layer_{i}",
+                decode=self.decode, num_kv_heads=cfg.num_kv_heads,
+                name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)
         if self.return_prehead:

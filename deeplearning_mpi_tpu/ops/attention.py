@@ -26,6 +26,23 @@ from jax import lax
 NEG_INF = -1e30  # large-negative mask value; -inf breaks softmax when a row is fully masked
 
 
+def repeat_kv(x: jax.Array, n_rep: int, *, axis: int = -2) -> jax.Array:
+    """Repeat each KV head ``n_rep`` times along the head axis (GQA → MHA).
+
+    Grouped-query attention stores K/V at ``num_kv_heads < num_heads``; the
+    full-sequence cores (dense, flash, ring) expect matching head counts, so
+    the model repeats K/V immediately before calling them. That is the right
+    trade for *training*: full-sequence attention is MXU-bound, and GQA's win
+    there is the smaller K/V projections — while *decode* is HBM-bound, so
+    :func:`decode_attention` consumes the grouped buffers natively instead
+    of repeating (reads ``num_kv_heads``, not ``num_heads``, rows per
+    position). ``axis=-2`` is the BSHD head axis; BHSD callers pass 1.
+    """
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=axis)
+
+
 def dense_attention(
     q: jax.Array,
     k: jax.Array,
@@ -81,8 +98,12 @@ def decode_attention(
     prefix of the cache, never touching unfilled blocks.
 
     ``q`` is ``[B, 1, H, D]`` (the single new token, RoPE applied);
-    ``k_buf``/``v_buf`` are the ``[B, max_len, H, D]`` cache buffers with
-    positions ``0..index`` (inclusive) filled. The dense formulation scores
+    ``k_buf``/``v_buf`` are the ``[B, max_len, Hkv, D]`` cache buffers with
+    positions ``0..index`` (inclusive) filled. ``Hkv`` may be a divisor of
+    ``H`` (grouped-query attention): the grouped buffers are read as-is —
+    never repeated to ``H`` — so decode HBM traffic per token scales with
+    ``Hkv``, compounding GQA's cache-size saving with the windowed read.
+    The dense formulation scores
     the WHOLE buffer and masks — O(max_len) HBM reads per token no matter
     how short the prefix. Here the buffer is walked in ``block``-sized
     chunks under a ``lax.fori_loop`` whose trip count is
@@ -97,7 +118,12 @@ def decode_attention(
     batch, q_len, heads, head_dim = q.shape
     if q_len != 1:
         raise ValueError(f"decode_attention takes one query token, got {q_len}")
-    length = k_buf.shape[1]
+    length, kv_heads = k_buf.shape[1], k_buf.shape[2]
+    if heads % kv_heads:
+        raise ValueError(
+            f"query heads ({heads}) must be a multiple of KV heads ({kv_heads})"
+        )
+    group = heads // kv_heads
     # Blocks stay full-size whatever the buffer length (a CLI cache is
     # prompt+max_new — arbitrary): the final block's start is clamped back
     # so it never runs off the buffer, and rows it re-reads from the
@@ -107,29 +133,32 @@ def decode_attention(
     b = min(block, length)
     n_blocks = (index + b) // b  # ceil((index+1)/b), traced
     scale = head_dim**-0.5
-    q32 = q[:, 0].astype(jnp.float32) * scale  # [B, H, D]
+    # [B, Hkv, G, D]: query heads grouped by the KV head they share.
+    q32 = (q[:, 0].astype(jnp.float32) * scale).reshape(
+        batch, kv_heads, group, head_dim
+    )
 
     def body(j, carry):
         acc, m, l = carry
         start = jnp.minimum(j * b, length - b)
         k_blk = lax.dynamic_slice(
-            k_buf, (0, start, 0, 0), (batch, b, heads, head_dim)
+            k_buf, (0, start, 0, 0), (batch, b, kv_heads, head_dim)
         )
         v_blk = lax.dynamic_slice(
-            v_buf, (0, start, 0, 0), (batch, b, heads, head_dim)
+            v_buf, (0, start, 0, 0), (batch, b, kv_heads, head_dim)
         )
         s = jnp.einsum(
-            "bhd,bkhd->bhk", q32, k_blk.astype(jnp.float32)
-        )  # [B, H, b]
+            "bhgd,bkhd->bhgk", q32, k_blk.astype(jnp.float32)
+        )  # [B, Hkv, G, b]
         pos = start + jnp.arange(b, dtype=jnp.int32)
         # Lower bound deduplicates the clamped tail's overlap with block j-1.
         valid = (pos >= j * b) & (pos <= index)
-        s = jnp.where(valid[None, None, :], s, NEG_INF)
+        s = jnp.where(valid[None, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         pv = jnp.einsum(
-            "bhk,bkhd->bhd", p.astype(v_blk.dtype), v_blk,
+            "bhgk,bkhd->bhgd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
         )
         return acc * alpha[..., None] + pv, m_new, l * alpha + jnp.sum(p, axis=-1)
@@ -137,10 +166,10 @@ def decode_attention(
     acc, _, l = lax.fori_loop(
         0, n_blocks, body,
         (
-            jnp.zeros((batch, heads, head_dim), jnp.float32),
-            jnp.full((batch, heads), NEG_INF, jnp.float32),
-            jnp.zeros((batch, heads), jnp.float32),
+            jnp.zeros((batch, kv_heads, group, head_dim), jnp.float32),
+            jnp.full((batch, kv_heads, group), NEG_INF, jnp.float32),
+            jnp.zeros((batch, kv_heads, group), jnp.float32),
         ),
     )
     out = acc / jnp.maximum(l, 1e-37)[..., None]
-    return out[:, None].astype(q.dtype)
+    return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)

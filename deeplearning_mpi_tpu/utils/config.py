@@ -100,6 +100,11 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        "accept but ignore it)")
     group.add_argument("--num_layers", type=int, default=4)
     group.add_argument("--num_heads", type=int, default=8)
+    group.add_argument("--num_kv_heads", type=int, default=0,
+                       help="grouped-query attention: K/V heads shared by "
+                       "groups of query heads (0 = num_heads, plain MHA); "
+                       "must divide --num_heads. Shrinks the KV cache and "
+                       "decode HBM reads by num_heads/num_kv_heads")
     group.add_argument("--head_dim", type=int, default=32)
     group.add_argument("--d_model", type=int, default=256)
     group.add_argument("--d_ff", type=int, default=1024)

@@ -24,6 +24,15 @@ def _dense_cfg():
     return TransformerConfig.tiny()
 
 
+def _gqa_cfg():
+    # Grouped KV heads: the cache stores Hkv=2 for H=4 query heads, and
+    # decode_attention consumes the grouped buffers natively — stepwise
+    # decode must still reproduce the full causal forward exactly.
+    return dataclasses.replace(
+        TransformerConfig.tiny(), num_heads=4, num_kv_heads=2
+    )
+
+
 def _moe_dropfree_cfg():
     # Drop-free routing is the comparison's precondition: decode steps (S=1)
     # never drop a token, so the full forward must not drop either —
@@ -37,8 +46,9 @@ def _moe_dropfree_cfg():
 
 class TestCachedDecode:
     @pytest.mark.slow
-    @pytest.mark.parametrize("make_cfg", [_dense_cfg, _moe_dropfree_cfg],
-                             ids=["dense", "moe"])
+    @pytest.mark.parametrize("make_cfg",
+                             [_dense_cfg, _moe_dropfree_cfg, _gqa_cfg],
+                             ids=["dense", "moe", "gqa"])
     def test_stepwise_decode_matches_full_forward(self, make_cfg):
         """Feeding tokens one at a time through the KV cache must reproduce
         the full-sequence causal forward logits position by position."""

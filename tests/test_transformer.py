@@ -137,6 +137,32 @@ class TestDecodeAttention:
         with pytest.raises(ValueError, match="one query token"):
             decode_attention(q, buf, buf, jnp.int32(0))
 
+    @pytest.mark.parametrize("index", [0, 5, 19, 31])
+    def test_gqa_matches_repeated_kv(self, index):
+        # Grouped buffers consumed natively must equal plain decode over the
+        # same buffers repeated to full head count — the repeat_kv ordering
+        # (consecutive query heads share kv head h//G) is part of the
+        # contract, so a mismatch here is a head-permutation bug.
+        from deeplearning_mpi_tpu.ops.attention import decode_attention, repeat_kv
+
+        rng = np.random.default_rng(index)
+        k_buf = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        v_buf = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(2, 1, 4, 8)), jnp.float32)  # H=4, Hkv=2
+        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=8)
+        ref = decode_attention(
+            q, repeat_kv(k_buf, 2), repeat_kv(v_buf, 2), jnp.int32(index), block=8
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_gqa_rejects_non_dividing_heads(self):
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        q = jnp.zeros((1, 1, 4, 8))
+        buf = jnp.zeros((1, 8, 3, 8))
+        with pytest.raises(ValueError, match="multiple of KV heads"):
+            decode_attention(q, buf, buf, jnp.int32(0))
+
 
 class TestRoPE:
     def test_rotation_preserves_norm(self):
@@ -311,3 +337,106 @@ class TestBHSDLayoutThreading:
         leaves = jax.tree.leaves(grads)
         assert all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
         assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
+
+
+class TestGQA:
+    """Grouped-query attention: K/V projected and cached at num_kv_heads."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+
+        base = TransformerConfig(
+            vocab_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+            head_dim=8, d_model=32, d_ff=64,
+        )
+        return dataclasses.replace(base, **kw) if kw else base
+
+    def test_kv_param_shapes_shrink(self):
+        model = TransformerLM(config=self._cfg(), dtype=jnp.float32)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.key(0), tokens)["params"]
+        attn = params["layer_0"]["attn"]
+        assert attn["q_proj"]["kernel"].shape == (32, 4 * 8)
+        assert attn["k_proj"]["kernel"].shape == (32, 2 * 8)
+        assert attn["v_proj"]["kernel"].shape == (32, 2 * 8)
+
+    def test_cache_stores_kv_heads_only(self):
+        model = TransformerLM(config=self._cfg(), dtype=jnp.float32, decode=True)
+        cache = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32))["cache"]
+        k = cache["layer_0"]["attn"]["cached_key"]
+        assert k.shape == (2, 16, 2, 8)  # Hkv=2, not H=4
+
+    def test_forward_matches_explicit_repeat(self):
+        """A GQA forward must equal an MHA forward whose K/V kernels are the
+        GQA kernels head-repeated — the repeat-ordering contract end to end."""
+        from deeplearning_mpi_tpu.ops.attention import repeat_kv
+
+        cfg = self._cfg()
+        gqa = TransformerLM(config=cfg, dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (2, 16)), jnp.int32
+        )
+        params = gqa.init(jax.random.key(0), tokens)["params"]
+
+        import dataclasses
+
+        mha = TransformerLM(
+            config=dataclasses.replace(cfg, num_kv_heads=None), dtype=jnp.float32
+        )
+        import flax.core
+
+        rep = flax.core.unfreeze(params)  # plain nested dicts, safe to rebuild
+        for layer in ("layer_0", "layer_1"):
+            attn = dict(rep[layer]["attn"])
+            for name in ("k_proj", "v_proj"):
+                kern = attn[name]["kernel"]  # [d_model, Hkv*D]
+                grouped = kern.reshape(kern.shape[0], 2, 8)
+                attn[name] = {
+                    "kernel": repeat_kv(grouped, 2, axis=1).reshape(
+                        kern.shape[0], 4 * 8
+                    )
+                }
+            rep[layer] = dict(rep[layer])
+            rep[layer]["attn"] = attn
+        out_gqa = gqa.apply({"params": params}, tokens)
+        out_mha = mha.apply({"params": rep}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_gqa), np.asarray(out_mha), atol=1e-5
+        )
+
+    def test_bhsd_layout_matches_bshd(self):
+        import functools
+
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        cfg = self._cfg(head_dim=16)
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (2, 32)), jnp.int32
+        )
+        bshd = TransformerLM(config=cfg, dtype=jnp.float32)
+        bhsd = TransformerLM(
+            config=cfg, dtype=jnp.float32,
+            attention_fn=functools.partial(
+                flash_attention_bhsd, block_q=16, block_k=16
+            ),
+        )
+        params = bshd.init(jax.random.key(0), tokens)["params"]
+        p_bhsd = bhsd.init(jax.random.key(0), tokens)["params"]
+        shapes = lambda p: [  # noqa: E731
+            x.shape for x in jax.tree.leaves(p)
+        ]
+        assert shapes(params) == shapes(p_bhsd)
+        np.testing.assert_allclose(
+            np.asarray(bshd.apply({"params": params}, tokens)),
+            np.asarray(bhsd.apply({"params": params}, tokens)),
+            atol=1e-5,
+        )
+
+    def test_non_dividing_kv_heads_raises(self):
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=1, num_heads=4, num_kv_heads=3,
+            head_dim=8, d_model=32, d_ff=64,
+        )
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="must divide"):
+            model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
