@@ -57,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--greedy", action="store_true",
                      help="argmax decoding (temperature ignored)")
     gen.add_argument("--random_seed", type=int, default=0)
+    gen.add_argument("--quantize", default="none", choices=("none", "int8"),
+                     help="int8 = weight-only quantized decode: the block "
+                     "matmul kernels are converted to int8 + per-channel "
+                     "scales after restore (checkpoints stay full-precision)"
+                     " — halves parameter HBM reads per token vs bfloat16")
     gen.add_argument("--time", action="store_true",
                      help="print decode throughput to stderr (runs the "
                      "program twice: an untimed compile pass, then a timed "
@@ -74,6 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.quantize == "int8" and (args.tp > 1 or args.moe_experts > 0):
+        # Untested compositions fail loud rather than run wrong — and BEFORE
+        # the init + restore they would otherwise pay for: sharded
+        # conversion (--tp) and routed-MoE kernels are future work.
+        print(
+            "--quantize int8 supports single-device dense models "
+            "(not --tp or --moe_experts yet)",
+            file=sys.stderr,
+        )
+        return 1
 
     from deeplearning_mpi_tpu.runtime import bootstrap
 
@@ -171,6 +187,15 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         ckpt.close()
 
+    params = state.params
+    if args.quantize == "int8":
+        import dataclasses
+
+        from deeplearning_mpi_tpu.ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+        model = dataclasses.replace(model, quantized=True)
+
     prompt_bytes = args.prompt.encode("utf-8") or b"\x00"
     prompt = jnp.asarray(
         np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
@@ -184,13 +209,13 @@ def main(argv: list[str] | None = None) -> int:
         top_p=1.0 if args.greedy else args.top_p,
     )
     rng = jax.random.key(args.random_seed)
-    out = fn(state.params, prompt, rng)
+    out = fn(params, prompt, rng)
     if args.time:
         import time
 
         jax.block_until_ready(out)  # first call compiled; now time the cache hit
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(state.params, prompt, rng))
+        out = jax.block_until_ready(fn(params, prompt, rng))
         dt = time.perf_counter() - t0
         # The scan decodes EVERY position (prompt prefill + new tokens) at
         # identical per-step cost, so throughput is per position — dividing

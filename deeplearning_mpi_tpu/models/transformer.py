@@ -89,6 +89,20 @@ def apply_rope(
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+def _dense_factory(quantized: bool, dtype: Any):
+    """Bias-free projection constructor: ``fn(features, name)`` building
+    either ``nn.Dense`` or (inference-only) ``ops.quant.QuantDense`` — one
+    definition so Attention and SwiGLU can't diverge on how quantized
+    kernels are constructed."""
+    if quantized:
+        from deeplearning_mpi_tpu.ops.quant import QuantDense
+
+        return lambda feats, name: QuantDense(feats, dtype, name=name)
+    return lambda feats, name: nn.Dense(
+        feats, use_bias=False, dtype=dtype, name=name
+    )
+
+
 class RMSNorm(nn.Module):
     """Root-mean-square norm, f32 accumulation, learned scale."""
 
@@ -184,6 +198,9 @@ class Attention(nn.Module):
     #: full-sequence cores receive ``repeat_kv``'d tensors (see
     #: ops.attention.repeat_kv for why that trade is per-phase correct).
     num_kv_heads: int | None = None
+    #: weight-only int8 projections (``ops.quant.QuantDense``); inference
+    #: only — params come from ``ops.quant.quantize_lm_params``.
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array, *, causal: bool = True) -> jax.Array:
@@ -195,6 +212,12 @@ class Attention(nn.Module):
                 f"num_kv_heads ({kv_heads}) must divide num_heads ({self.num_heads})"
             )
         rep = self.num_heads // kv_heads
+        if self.quantized and attention_fn_layout(self.attention_fn) == "bhsd":
+            raise ValueError(
+                "quantized attention supports the BSHD path only (the BHSD "
+                "kernel-native layout is a training-path optimization; "
+                "quantization is inference-only)"
+            )
         if not self.decode and attention_fn_layout(self.attention_fn) == "bhsd":
             proj = lambda heads, name: _ProjToBHSD(  # noqa: E731
                 heads, self.head_dim, self.dtype, name=name
@@ -207,9 +230,7 @@ class Attention(nn.Module):
                 causal=causal,
             )  # [B, H, S, D]
             return _ProjFromBHSD(x.shape[-1], self.dtype, name="out_proj")(ctx)
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=self.dtype, name=name
-        )
+        dense = _dense_factory(self.quantized, self.dtype)
         kv_shape = (batch, seq, kv_heads, self.head_dim)
         q = dense(features, "q_proj")(x).reshape(
             batch, seq, self.num_heads, self.head_dim
@@ -225,7 +246,7 @@ class Attention(nn.Module):
             ctx = attn(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal)
         ctx = ctx.reshape(batch, seq, features)
         # "out_proj" triggers tensor_parallel's row-parallel (input-dim) rule.
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="out_proj")(ctx)
+        return dense(x.shape[-1], "out_proj")(ctx)
 
     def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         """One decode step: append K/V to the cache, attend over the prefix.
@@ -275,13 +296,15 @@ class SwiGLU(nn.Module):
 
     d_ff: int
     dtype: Any = jnp.bfloat16
+    quantized: bool = False  # weight-only int8 kernels (inference only)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        gate = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype, name="gate_proj")(x)
-        up = nn.Dense(self.d_ff, use_bias=False, dtype=self.dtype, name="up_proj")(x)
-        hidden = nn.silu(gate) * up
-        return nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="down_proj")(hidden)
+        dense = _dense_factory(self.quantized, self.dtype)
+        hidden = nn.silu(dense(self.d_ff, "gate_proj")(x)) * dense(
+            self.d_ff, "up_proj"
+        )(x)
+        return dense(x.shape[-1], "down_proj")(hidden)
 
 
 class Block(nn.Module):
@@ -295,15 +318,25 @@ class Block(nn.Module):
     mlp_cls: type[nn.Module] | None = None
     decode: bool = False
     num_kv_heads: int | None = None
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         x = x + Attention(
             self.num_heads, self.head_dim, self.dtype,
             attention_fn=self.attention_fn, decode=self.decode,
-            num_kv_heads=self.num_kv_heads, name="attn",
+            num_kv_heads=self.num_kv_heads, quantized=self.quantized,
+            name="attn",
         )(RMSNorm(name="attn_norm")(x), positions)
-        mlp = (self.mlp_cls or SwiGLU)(self.d_ff, self.dtype, name="mlp")
+        if self.quantized:
+            if self.mlp_cls is not None:
+                raise ValueError(
+                    "quantized inference supports the dense SwiGLU MLP only "
+                    "(routed MoE kernels are not converted)"
+                )
+            mlp = SwiGLU(self.d_ff, self.dtype, quantized=True, name="mlp")
+        else:
+            mlp = (self.mlp_cls or SwiGLU)(self.d_ff, self.dtype, name="mlp")
         return x + mlp(RMSNorm(name="mlp_norm")(x))
 
 
@@ -371,6 +404,10 @@ class TransformerLM(nn.Module):
     #: param tree. The param tree is unchanged, so checkpoints interchange
     #: freely with the plain model.
     return_prehead: bool = False
+    #: weight-only int8 projections (inference only): apply with a param
+    #: tree from ``ops.quant.quantize_lm_params``. Embeddings, norms, and
+    #: the tied head stay in the compute dtype.
+    quantized: bool = False
 
     @nn.compact
     def __call__(
@@ -402,7 +439,7 @@ class TransformerLM(nn.Module):
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
                 attention_fn=self.attention_fn, mlp_cls=mlp_cls,
                 decode=self.decode, num_kv_heads=cfg.num_kv_heads,
-                name=f"layer_{i}",
+                quantized=self.quantized, name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)
         if self.return_prehead:

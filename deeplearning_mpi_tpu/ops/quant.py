@@ -1,0 +1,109 @@
+"""Weight-only int8 quantization for LM inference.
+
+No reference analog (the reference has no inference entrypoint at all —
+its workflow ends at checkpoint files, ``pytorch/resnet/main.py:136-142``);
+this is a TPU-first decode lever: batch-1 KV-cached decode is HBM-bound on
+*parameter* reads (~220 MB/token for the 110M flagship, see
+``docs/LONG_CONTEXT.md``), so storing the seven big matmul kernels per block
+as int8 + one f32 scale per output channel halves the bytes the matmuls
+stream versus bf16 — a bandwidth lever, like GQA, not a compute one.
+
+Design:
+- **Post-training, weight-only.** Checkpoints stay full-precision; a trained
+  param tree is converted on restore (``quantize_lm_params``). Activations,
+  norms, embeddings, and the tied LM head stay in the compute dtype — the
+  quality-sensitive pieces — so the conversion is a pure serving-time choice.
+- **Per-output-channel scales.** ``scale[o] = max|W[:, o]| / 127`` bounds
+  elementwise error by ``scale/2``; a single per-tensor scale would let one
+  outlier channel dominate the whole kernel's resolution.
+- **Dequant after the matmul.** int8 values are exactly representable in
+  bfloat16 (8 mantissa bits cover ±127), so
+  ``(x @ q.astype(bf16)) * scale == x @ (q * scale)`` with the scale applied
+  to the small ``[..., out]`` result instead of materializing a dequantized
+  ``[in, out]`` kernel per call — XLA streams the int8 kernel and fuses the
+  convert into the dot's operand read.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.core
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+#: the seven big matmuls per transformer block — where the parameter bytes
+#: are. Norm scales, embeddings, and router kernels stay full-precision.
+DEFAULT_TARGETS = (
+    "q_proj", "k_proj", "v_proj", "out_proj",
+    "gate_proj", "up_proj", "down_proj",
+)
+
+
+class QuantDense(nn.Module):
+    """Bias-free Dense over an int8 kernel with per-output-channel scales.
+
+    Param tree: ``kernel`` (int8, ``[in, features]``) + ``scale`` (f32,
+    ``[features]``) — exactly what :func:`quantize_lm_params` emits for an
+    ``nn.Dense(features, use_bias=False)`` it replaces. Never trained: the
+    init exists only to shape templates (zeros), real values always come
+    from conversion.
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel", lambda key, shape: jnp.zeros(shape, jnp.int8),
+            (x.shape[-1], self.features),
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (self.features,), jnp.float32
+        )
+        y = jnp.einsum(
+            "...i,io->...o", x.astype(self.dtype), kernel.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * scale).astype(self.dtype)
+
+
+def quantize_array(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize ``[in, out]`` to (int8 ``[in, out]``, f32 ``[out]`` scales).
+
+    Symmetric round-to-nearest; ``|w - q*scale| <= scale/2`` elementwise.
+    """
+    w32 = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w32), axis=0) / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_lm_params(
+    params: Any, *, targets: tuple[str, ...] = DEFAULT_TARGETS
+) -> Any:
+    """Convert a trained :class:`TransformerLM` param tree for the
+    ``quantized=True`` model: every 2-D ``kernel`` under a module named in
+    ``targets`` becomes ``{kernel: int8, scale: f32[out]}``; everything else
+    passes through unchanged (embeddings, norms, routers).
+    """
+    def visit(tree: dict) -> dict:
+        out = {}
+        for name, sub in tree.items():
+            if (
+                name in targets
+                and isinstance(sub, dict)
+                and set(sub) == {"kernel"}
+                and getattr(sub["kernel"], "ndim", 0) == 2
+            ):
+                q, scale = quantize_array(sub["kernel"])
+                out[name] = {"kernel": q, "scale": scale}
+            elif isinstance(sub, dict):
+                out[name] = visit(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return visit(flax.core.unfreeze(params))

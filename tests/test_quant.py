@@ -1,0 +1,150 @@
+"""Weight-only int8 quantization: conversion bounds, QuantDense math,
+quantized-model quality, and decode parity within the quantized model."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.ops.quant import (
+    QuantDense,
+    quantize_array,
+    quantize_lm_params,
+)
+
+
+def _tiny_lm(**cfg_kw):
+    cfg = dataclasses.replace(TransformerConfig.tiny(), **cfg_kw)
+    model = TransformerLM(config=cfg, dtype=jnp.float32)
+    params = model.init(jax.random.key(0), jnp.zeros((2, 16), jnp.int32))[
+        "params"
+    ]
+    return model, params
+
+
+class TestQuantizeArray:
+    def test_error_bounded_by_half_scale(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 32)), jnp.float32)
+        q, scale = quantize_array(w)
+        assert q.dtype == jnp.int8 and scale.shape == (32,)
+        err = np.abs(np.asarray(w) - np.asarray(q, np.float32) * np.asarray(scale))
+        assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+
+    def test_extremes_map_to_127(self):
+        w = jnp.asarray([[1.0, -3.0], [-1.0, 3.0]], jnp.float32)
+        q, scale = quantize_array(w)
+        np.testing.assert_array_equal(np.abs(np.asarray(q)), 127)
+        np.testing.assert_allclose(np.asarray(scale), [1 / 127, 3 / 127])
+
+    def test_zero_column_safe(self):
+        w = jnp.zeros((8, 4), jnp.float32)
+        q, scale = quantize_array(w)
+        assert np.all(np.asarray(q) == 0) and np.all(np.asarray(scale) > 0)
+
+
+class TestQuantDense:
+    def test_matches_dequantized_matmul(self):
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
+        q, scale = quantize_array(w)
+        module = QuantDense(8, jnp.float32)
+        out = module.apply({"params": {"kernel": q, "scale": scale}}, x)
+        ref = x @ (q.astype(jnp.float32) * scale)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestQuantizedLM:
+    def test_conversion_tree_shape(self):
+        _, params = _tiny_lm()
+        qparams = quantize_lm_params(params)
+        attn = qparams["layer_0"]["attn"]
+        for name in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            assert attn[name]["kernel"].dtype == jnp.int8
+            assert attn[name]["scale"].dtype == jnp.float32
+        mlp = qparams["layer_0"]["mlp"]
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            assert mlp[name]["kernel"].dtype == jnp.int8
+        # Embeddings and norms pass through untouched.
+        assert qparams["embed"]["embedding"].dtype == params["embed"]["embedding"].dtype
+        assert qparams["final_norm"]["scale"].dtype == jnp.float32
+
+    def test_quantized_logits_track_dense(self):
+        """int8 weights must stay close to the full-precision model: high
+        top-1 agreement and bounded logit drift on random data."""
+        model, params = _tiny_lm()
+        qmodel = dataclasses.replace(model, quantized=True)
+        qparams = quantize_lm_params(params)
+        tokens = jnp.asarray(
+            np.random.default_rng(2).integers(0, 256, (4, 32)), jnp.int32
+        )
+        dense = np.asarray(model.apply({"params": params}, tokens))
+        quant = np.asarray(qmodel.apply({"params": qparams}, tokens))
+        agree = np.mean(dense.argmax(-1) == quant.argmax(-1))
+        assert agree >= 0.9, f"top-1 agreement {agree:.3f}"
+        # Drift bounded relative to the logit spread, not absolutely.
+        spread = dense.max() - dense.min()
+        assert np.max(np.abs(dense - quant)) <= 0.1 * spread
+
+    def test_stepwise_decode_matches_quantized_forward(self):
+        """The decode-parity invariant holds WITHIN the quantized model —
+        cache + windowed decode introduce no error beyond quantization."""
+        seq = 12
+        model, params = _tiny_lm()
+        qmodel = dataclasses.replace(model, quantized=True)
+        qparams = quantize_lm_params(params)
+        tokens = jnp.asarray(
+            np.random.default_rng(3).integers(0, 256, (2, seq)), jnp.int32
+        )
+        full = qmodel.apply({"params": qparams}, tokens)
+        decode_model = dataclasses.replace(qmodel, decode=True)
+        cache = decode_model.init(
+            jax.random.key(0), jnp.zeros((2, seq), jnp.int32)
+        )["cache"]
+        for i in range(seq):
+            step, mutated = decode_model.apply(
+                {"params": qparams, "cache": cache},
+                tokens[:, i : i + 1],
+                positions=jnp.full((2, 1), i, jnp.int32),
+                mutable=["cache"],
+            )
+            cache = mutated["cache"]
+            np.testing.assert_allclose(
+                np.asarray(step[:, 0]), np.asarray(full[:, i]), atol=2e-4
+            )
+
+    def test_moe_quantized_refused(self):
+        cfg = TransformerConfig.tiny_moe()
+        model = TransformerLM(config=cfg, dtype=jnp.float32, quantized=True)
+        with pytest.raises(ValueError, match="dense SwiGLU"):
+            model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+    def test_bhsd_quantized_refused(self):
+        import functools
+
+        from deeplearning_mpi_tpu.ops.pallas import flash_attention_bhsd
+
+        model, _ = _tiny_lm()
+        qmodel = dataclasses.replace(
+            model, quantized=True,
+            attention_fn=functools.partial(flash_attention_bhsd),
+        )
+        with pytest.raises(ValueError, match="BSHD path only"):
+            qmodel.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+    def test_gqa_composes_with_quantization(self):
+        # Both decode levers together: grouped KV cache + int8 weights.
+        model, params = _tiny_lm(num_heads=4, num_kv_heads=2)
+        qmodel = dataclasses.replace(model, quantized=True)
+        qparams = quantize_lm_params(params)
+        tokens = jnp.asarray(
+            np.random.default_rng(4).integers(0, 256, (2, 16)), jnp.int32
+        )
+        out = qmodel.apply({"params": qparams}, tokens)
+        assert np.all(np.isfinite(np.asarray(out)))
+        assert qparams["layer_0"]["attn"]["k_proj"]["kernel"].shape == (32, 2 * 8)

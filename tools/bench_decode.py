@@ -25,19 +25,36 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
-                    head_dim: int, steps: int = 50) -> list[dict]:
-    """Per-token decode attention: dense-masked vs windowed, same inputs."""
+                    head_dim: int, kv_heads: int = 0,
+                    steps: int = 50) -> list[dict]:
+    """Per-token decode attention: dense-masked vs windowed, same inputs.
+
+    ``kv_heads`` (GQA) sizes the K/V buffers at fewer heads than the query;
+    the dense comparator then scores ``repeat_kv``'d buffers (it has no
+    grouped form — exactly why the HBM win exists), while the windowed path
+    reads the grouped buffers natively.
+    """
     import jax
     import jax.numpy as jnp
 
-    from deeplearning_mpi_tpu.ops.attention import NEG_INF, decode_attention
+    from deeplearning_mpi_tpu.ops.attention import (
+        NEG_INF,
+        decode_attention,
+        repeat_kv,
+    )
 
+    kv_heads = kv_heads or heads
+    if heads % kv_heads:
+        raise ValueError(
+            f"--num_kv_heads ({kv_heads}) must divide --heads ({heads})"
+        )
+    rep = heads // kv_heads
     key = jax.random.key(0)
     kq, kk, kv = jax.random.split(key, 3)
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     q = jax.random.normal(kq, (batch, 1, heads, head_dim), dt)
-    k_buf = jax.random.normal(kk, (batch, max_len, heads, head_dim), dt)
-    v_buf = jax.random.normal(kv, (batch, max_len, heads, head_dim), dt)
+    k_buf = jax.random.normal(kk, (batch, max_len, kv_heads, head_dim), dt)
+    v_buf = jax.random.normal(kv, (batch, max_len, kv_heads, head_dim), dt)
 
     @jax.jit
     def dense(q, k_buf, v_buf, i):
@@ -65,10 +82,10 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
     rows = []
     for fill in fills:
         i = jnp.int32(fill - 1)
-        us_dense = clock(dense, q, k_buf, v_buf, i)
+        us_dense = clock(dense, q, repeat_kv(k_buf, rep), repeat_kv(v_buf, rep), i)
         us_win = clock(windowed, q, k_buf, v_buf, i)
         rows.append({
-            "fill": fill, "max_len": max_len,
+            "fill": fill, "max_len": max_len, "kv_heads": kv_heads,
             "dense_us_per_token": round(us_dense, 1),
             "windowed_us_per_token": round(us_win, 1),
             "speedup": round(us_dense / us_win, 2),
@@ -77,9 +94,13 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
     return rows
 
 
-def bench_e2e(max_len: int, *, new_tokens: int = 256) -> dict:
+def bench_e2e(max_len: int, *, new_tokens: int = 256,
+              quantize: str = "none", kv_heads: int = 0) -> dict:
     """generate() tok/s on a ~110M LM (BASELINE.md flagship shape), prompt
-    filling half the context so the windowed walk sees a realistic mix."""
+    filling half the context so the windowed walk sees a realistic mix.
+    ``quantize='int8'`` converts the block kernels (weight-only,
+    ``ops.quant``); ``kv_heads`` sizes a GQA cache — the two decode
+    bandwidth levers, measurable separately or together."""
     import jax
     import jax.numpy as jnp
 
@@ -88,7 +109,7 @@ def bench_e2e(max_len: int, *, new_tokens: int = 256) -> dict:
 
     cfg = TransformerConfig(
         vocab_size=256, num_layers=12, num_heads=12, head_dim=64,
-        d_model=768, d_ff=3072,
+        d_model=768, d_ff=3072, num_kv_heads=kv_heads or None,
     )
     dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     model = TransformerLM(config=cfg, dtype=dt)
@@ -96,6 +117,13 @@ def bench_e2e(max_len: int, *, new_tokens: int = 256) -> dict:
     prompt_len = max_len - new_tokens
     prompt = jnp.zeros((1, prompt_len), jnp.int32)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    if quantize == "int8":
+        import dataclasses
+
+        from deeplearning_mpi_tpu.ops.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+        model = dataclasses.replace(model, quantized=True)
 
     # Same jitted entry the CLI ships — timing eager generate() would fold
     # per-call retracing into the window and measure a path no caller uses.
@@ -112,6 +140,7 @@ def bench_e2e(max_len: int, *, new_tokens: int = 256) -> dict:
     positions = prompt_len + new_tokens  # the scan decodes every position
     row = {
         "e2e_context": max_len, "new_tokens": new_tokens,
+        "quantize": quantize, "kv_heads": kv_heads or cfg.num_heads,
         "positions_decoded": positions,
         "seconds": round(dt_s, 3),
         "positions_per_s": round(positions / dt_s, 1),
@@ -127,9 +156,14 @@ def main(argv: list[str] | None = None) -> int:
                         help="prefix lengths to time (default: max_len/8, /2, full)")
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--heads", type=int, default=12)
+    parser.add_argument("--num_kv_heads", type=int, default=0,
+                        help="GQA: K/V buffer heads (0 = --heads); the "
+                        "windowed path reads the grouped buffers natively")
     parser.add_argument("--head_dim", type=int, default=64)
     parser.add_argument("--e2e", action="store_true",
                         help="also run the ~110M-LM generate() end-to-end")
+    parser.add_argument("--quantize", default="none", choices=("none", "int8"),
+                        help="weight-only int8 kernels for the --e2e model")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     args = parser.parse_args(argv)
 
@@ -142,9 +176,12 @@ def main(argv: list[str] | None = None) -> int:
     bench_attention(
         args.max_len, fills,
         batch=args.batch, heads=args.heads, head_dim=args.head_dim,
+        kv_heads=args.num_kv_heads,
     )
     if args.e2e:
-        bench_e2e(args.max_len)
+        bench_e2e(
+            args.max_len, quantize=args.quantize, kv_heads=args.num_kv_heads
+        )
     return 0
 
 
