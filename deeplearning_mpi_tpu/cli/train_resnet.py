@@ -31,7 +31,12 @@ def build_parser() -> argparse.ArgumentParser:
         model_filename="resnet_distributed",
     )
     parser.add_argument("--arch", default="resnet18",
-                        choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152"])
+                        choices=["resnet18", "resnet34", "resnet50",
+                                 "resnet101", "resnet152",
+                                 "vit_tiny", "vit_small"],
+                        help="resnet* = reference-parity CNN family; vit_* "
+                        "= the attention-native classifier (models/vit.py) "
+                        "on the same data/trainer stack")
     parser.add_argument("--stem", default="imagenet", choices=["imagenet", "cifar"],
                         help="imagenet = torchvision-parity 7x7/2 stem (main.py:40)")
     parser.add_argument("--data_dir", default="data", help="dir containing cifar-10-batches-py")

@@ -25,6 +25,7 @@ from deeplearning_mpi_tpu.models.transformer import (  # noqa: F401
     TransformerLM,
 )
 from deeplearning_mpi_tpu.models.unet import UNet  # noqa: F401
+from deeplearning_mpi_tpu.models.vit import ViT, vit_small, vit_tiny  # noqa: F401
 
 _RESNETS = {
     "resnet18": resnet18,
@@ -34,11 +35,16 @@ _RESNETS = {
     "resnet152": resnet152,
 }
 
+_VITS = {"vit_tiny": vit_tiny, "vit_small": vit_small}
+
 
 def get_model(name: str, **kwargs: Any) -> nn.Module:
     """Build a model by name — the registry behind the trainers' ``--arch``."""
     if name in _RESNETS:
         return _RESNETS[name](**kwargs)
+    if name in _VITS:
+        kwargs.pop("stem", None)  # patchify IS the stem; CNN knob n/a
+        return _VITS[name](**kwargs)
     if name == "unet":
         return UNet(**kwargs)
     if name == "unet3d":
@@ -49,5 +55,5 @@ def get_model(name: str, **kwargs: Any) -> nn.Module:
         return TransformerLM(config=config, **kwargs)
     raise ValueError(
         f"unknown model '{name}'; choose from "
-        f"{sorted(_RESNETS) + ['unet', 'unet3d', 'transformer']}"
+        f"{sorted(_RESNETS) + sorted(_VITS) + ['unet', 'unet3d', 'transformer']}"
     )

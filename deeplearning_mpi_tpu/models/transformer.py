@@ -319,6 +319,9 @@ class Block(nn.Module):
     decode: bool = False
     num_kv_heads: int | None = None
     quantized: bool = False
+    #: False = bidirectional attention (encoder stacks: ViT); True = the
+    #: causal LM default.
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -327,7 +330,7 @@ class Block(nn.Module):
             attention_fn=self.attention_fn, decode=self.decode,
             num_kv_heads=self.num_kv_heads, quantized=self.quantized,
             name="attn",
-        )(RMSNorm(name="attn_norm")(x), positions)
+        )(RMSNorm(name="attn_norm")(x), positions, causal=self.causal)
         if self.quantized:
             if self.mlp_cls is not None:
                 raise ValueError(

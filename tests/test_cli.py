@@ -46,6 +46,19 @@ class TestTrainResnetCLI:
         assert "Epoch 0: loss" in logs
         assert "accuracy" in logs
 
+    def test_vit_arch_one_epoch(self, tmp_path):
+        # The attention-native classifier rides the same trainer stack:
+        # --arch is the only change from the reference-parity invocation.
+        rc = train_resnet.main(RESNET_ARGS + [
+            "--arch", "vit_tiny", "--num_epochs", "1",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+        logs = _read_logs(tmp_path / "logs")
+        assert "Epoch 0: loss" in logs
+        assert "accuracy" in logs
+
     def test_resume_continues_from_checkpoint(self, tmp_path):
         args = RESNET_ARGS + [
             "--model_dir", str(tmp_path / "ckpt"),

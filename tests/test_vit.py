@@ -1,0 +1,92 @@
+"""ViT classifier: shapes, bidirectionality, training integration, registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models import get_model
+from deeplearning_mpi_tpu.models.vit import ViT, vit_tiny
+
+
+def _tiny_vit(**kw):
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("patch_size", 8)  # 32x32 -> 4x4 = 16 patches + CLS
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("d_model", 16)
+    kw.setdefault("d_ff", 32)
+    kw.setdefault("dtype", jnp.float32)
+    return ViT(**kw)
+
+
+class TestViT:
+    def test_forward_shape_and_finite(self):
+        model = _tiny_vit()
+        images = jnp.asarray(
+            np.random.default_rng(0).normal(size=(2, 32, 32, 3)), jnp.float32
+        )
+        params = model.init(jax.random.key(0), images)["params"]
+        logits = model.apply({"params": params}, images)
+        assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(logits)))
+
+    def test_attention_is_bidirectional(self):
+        """The CLS token sits at position 0; with causal masking it could
+        never see any patch and the logits would be input-independent.
+        Perturbing the LAST patch must move the logits."""
+        model = _tiny_vit()
+        rng = np.random.default_rng(1)
+        images = jnp.asarray(rng.normal(size=(1, 32, 32, 3)), jnp.float32)
+        params = model.init(jax.random.key(0), images)["params"]
+        base = np.asarray(model.apply({"params": params}, images))
+        perturbed = images.at[:, 24:, 24:, :].add(3.0)  # last patch only
+        moved = np.asarray(model.apply({"params": params}, perturbed))
+        assert np.max(np.abs(base - moved)) > 1e-4
+
+    def test_resolution_independent_params(self):
+        """RoPE positions instead of a learned table: the same params apply
+        at a different image size (more patches) without reinit."""
+        model = _tiny_vit()
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 32, 32, 3))
+        )["params"]
+        out = model.apply({"params": params}, jnp.zeros((1, 64, 64, 3)))
+        assert out.shape == (1, 10)
+
+    def test_non_dividing_image_raises(self):
+        model = _tiny_vit(patch_size=5)
+        with pytest.raises(ValueError, match="not divisible"):
+            model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+    def test_train_step_decreases_loss(self):
+        from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+        from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+        model = _tiny_vit()
+        tx = build_optimizer("adam", 1e-3, clip_norm=1.0)
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 32, 32, 3)), tx
+        )
+        rng = np.random.default_rng(2)
+        batch = {
+            "image": jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32),
+            "label": jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32),
+        }
+        step = make_train_step("classification")
+        losses = []
+        for _ in range(30):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+    def test_registry_builds_and_drops_stem(self):
+        model = get_model("vit_tiny", num_classes=10, stem="imagenet",
+                          dtype=jnp.float32)
+        assert isinstance(model, ViT)
+        assert model.d_model == 192
+
+    def test_factory_defaults(self):
+        m = vit_tiny()
+        assert (m.num_layers, m.num_heads, m.patch_size) == (6, 3, 4)
