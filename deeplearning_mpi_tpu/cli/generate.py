@@ -40,6 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
                        "(dmt-train-lm default: float32)")
     parser.add_argument("--model_dir", default="saved_models")
     parser.add_argument("--model_filename", default="lm")
+    parser.add_argument("--ema", type=config.ema_decay, default=0.0,
+                        help="set to the training run's --ema decay when "
+                        "serving an EMA-trained checkpoint: shapes the "
+                        "restore template to include the EMA subtree and "
+                        "decodes from the AVERAGED weights (the decay value "
+                        "itself is unused at inference; nonzero = on)")
     parser.add_argument("--epoch", type=int, default=None,
                         help="checkpoint epoch to load (default: latest)")
     gen = parser.add_argument_group("generation")
@@ -157,6 +163,7 @@ def main(argv: list[str] | None = None) -> int:
     template = create_train_state(
         model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
         build_optimizer("adam", 1e-3, clip_norm=1.0),
+        ema=args.ema > 0,
     )
     if mesh is not None:
         # Shard the TEMPLATE (training's megatron rules, via the same
@@ -187,7 +194,9 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         ckpt.close()
 
-    params = state.params
+    # The averaged weights are what EMA exists to serve (same preference as
+    # the trainers' eval path, TrainState.eval_variables).
+    params = state.params if state.ema_params is None else state.ema_params
     if args.quantize == "int8":
         import dataclasses
 

@@ -189,7 +189,7 @@ def main(argv: list[str] | None = None) -> int:
         return create_train_state(
             model, jax.random.key(args.random_seed),
             jnp.zeros((1, args.seq_len), jnp.int32), tx,
-            mesh=mesh, zero=args.zero,
+            mesh=mesh, zero=args.zero, ema=args.ema > 0,
         )
 
     state = state_factory()
@@ -205,7 +205,7 @@ def main(argv: list[str] | None = None) -> int:
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
             grad_accum=args.grad_accum, loss_chunk=args.loss_chunk,
-            zero=args.zero,
+            zero=args.zero, ema_decay=args.ema,
         )
         trainer.place_state()
         config.build_observability(args, trainer)

@@ -120,7 +120,7 @@ def main(argv: list[str] | None = None) -> int:
     def state_factory():
         return create_train_state(
             model, jax.random.key(args.random_seed), jnp.zeros((1, 32, 32, 3)), tx,
-            mesh=mesh, zero=args.zero,
+            mesh=mesh, zero=args.zero, ema=args.ema > 0,
         )
 
     state = state_factory()
@@ -135,6 +135,7 @@ def main(argv: list[str] | None = None) -> int:
             state, "classification", mesh,
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             grad_accum=args.grad_accum, zero=args.zero,
+            ema_decay=args.ema,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
         config.build_observability(args, trainer)

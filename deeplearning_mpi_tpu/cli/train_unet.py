@@ -152,7 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         return create_train_state(
             model, jax.random.key(args.random_seed),
             jnp.zeros((1, *sample_hw, channels)), tx,
-            mesh=mesh, zero=args.zero,
+            mesh=mesh, zero=args.zero, ema=args.ema > 0,
         )
 
     state = state_factory()
@@ -167,6 +167,7 @@ def main(argv: list[str] | None = None) -> int:
             state, "segmentation", mesh,
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             grad_accum=args.grad_accum, zero=args.zero, seg_loss=args.loss,
+            ema_decay=args.ema,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
         config.build_observability(args, trainer)

@@ -71,9 +71,18 @@ class Checkpointer:
 
 
 def _arrays_only(state: TrainState) -> dict[str, Any]:
-    return {
+    out = {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
     }
+    # Included ONLY when tracked, so EMA-off checkpoints keep their exact
+    # historical tree. An --ema restore of a non-EMA checkpoint (or vice
+    # versa) is an orbax tree mismatch — fail-loud, as the flag's help
+    # documents. Omitting this line was a silent-drop bug: restore kept the
+    # template's freshly-initialized EMA and eval served init-tinted
+    # weights.
+    if state.ema_params is not None:
+        out["ema_params"] = state.ema_params
+    return out

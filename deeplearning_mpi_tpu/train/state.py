@@ -27,10 +27,23 @@ class TrainState(flax.struct.PyTreeNode):
     opt_state: Any
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    #: exponential moving average of ``params`` (None = EMA off). Initialized
+    #: to a copy of params (no zero-debias needed) and advanced by the train
+    #: step; evaluation prefers it when present — the averaged weights, not
+    #: the noisy last step, are what EMA exists for. None keeps the pytree
+    #: (and therefore every existing checkpoint's tree) unchanged.
+    ema_params: Any = None
 
     def variables(self) -> dict[str, Any]:
         """Flax variable dict for ``apply_fn``."""
         return {"params": self.params, "batch_stats": self.batch_stats}
+
+    def eval_variables(self) -> dict[str, Any]:
+        """Like :meth:`variables`, but with the EMA weights when tracked.
+        (``ema_params is None`` is a pytree-structure fact, static under
+        jit, so the branch costs nothing in the compiled eval step.)"""
+        params = self.params if self.ema_params is None else self.ema_params
+        return {"params": params, "batch_stats": self.batch_stats}
 
 
 def create_train_state(
@@ -41,6 +54,7 @@ def create_train_state(
     *,
     mesh: Any = None,
     zero: bool = False,
+    ema: bool = False,
 ) -> TrainState:
     """Initialize model variables and optimizer state.
 
@@ -65,6 +79,10 @@ def create_train_state(
             opt_state=tx.init(params),
             apply_fn=model.apply,
             tx=tx,
+            # Seeded with params itself (not zeros), so no bias correction
+            # is ever needed. EMA leaves shard exactly like their params
+            # (infer_state_sharding's rules are name-path based).
+            ema_params=jax.tree.map(jnp.copy, params) if ema else None,
         )
 
     # One compiled program instead of hundreds of eager dispatches — on real

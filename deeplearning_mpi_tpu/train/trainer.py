@@ -126,6 +126,7 @@ def make_train_step(
     loss_chunk: int = 0,
     seg_loss: str = "bce",
     state_shardings: Any = None,
+    ema_decay: float = 0.0,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
 
@@ -165,6 +166,11 @@ def make_train_step(
     pair with ``TransformerLM(return_prehead=True)``; the [B, S, V] logits
     never materialize (``ops.loss.chunked_lm_loss``), the long-context
     memory lever at large vocabularies.
+
+    ``ema_decay > 0`` advances ``state.ema_params`` after each accepted
+    update (``ema = d*ema + (1-d)*params``); requires a state built with
+    ``create_train_state(..., ema=True)``. A NaN-skipped step leaves the
+    EMA untouched along with everything else.
     """
     loss_fn = (
         _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
@@ -268,12 +274,30 @@ def make_train_step(
         keep = lambda new, old: jax.tree.map(
             lambda n, o: jnp.where(finite, n, o), new, old
         )
+        ema = state.ema_params
+        if ema_decay:
+            if ema is None:
+                raise ValueError(
+                    "ema_decay set but the state tracks no EMA — build it "
+                    "with create_train_state(..., ema=True)"
+                )
+            # Advance from the ACCEPTED params (NaN-skip folds in for free:
+            # on a skipped step new==old, so d*e + (1-d)*old(=e's target)
+            # still moves e — hence guard the EMA with keep() as well).
+            ema = keep(
+                jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                    ema, new_params,
+                ),
+                ema,
+            )
         return (
             state.replace(
                 step=state.step + 1,
                 params=keep(new_params, state.params),
                 batch_stats=keep(new_batch_stats, state.batch_stats),
                 opt_state=keep(new_opt_state, state.opt_state),
+                ema_params=ema,
             ),
             {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)},
         )
@@ -305,7 +329,11 @@ def make_eval_step(
     input_key = _INPUTS[task]
 
     def step(state: TrainState, batch: Batch) -> dict[str, jax.Array]:
-        outputs = state.apply_fn(state.variables(), batch[input_key], train=False)
+        # eval_variables: EMA weights when the state tracks them (--ema) —
+        # the averaged params, not the noisy last step, are what gets served.
+        outputs = state.apply_fn(
+            state.eval_variables(), batch[input_key], train=False
+        )
         # Wrap-padded rows (loader drop_last=False) carry __valid__=0 and are
         # excluded from every mean; "weight" is the real-example count the
         # caller accumulates by.
@@ -422,6 +450,7 @@ class Trainer:
         grad_accum: int = 1,  # gradient-accumulation chunks per optimizer step
         loss_chunk: int = 0,  # LM chunked head+loss (pair with return_prehead)
         seg_loss: str = "bce",  # segmentation objective: bce | dice | bce_dice
+        ema_decay: float = 0.0,  # EMA of params; eval/serving uses the average
         profiler: Any = None,  # utils.profiling.Profiler; traces a few hot steps
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
@@ -439,7 +468,7 @@ class Trainer:
         self.zero = zero
         self._step_kwargs = dict(
             aux_weight=aux_weight, grad_accum=grad_accum, loss_chunk=loss_chunk,
-            seg_loss=seg_loss,
+            seg_loss=seg_loss, ema_decay=ema_decay,
         )
         self.train_step = make_train_step(task, **self._step_kwargs)
         self.eval_step = make_eval_step(task, loss_chunk=loss_chunk, seg_loss=seg_loss)

@@ -30,6 +30,18 @@ def add_topology_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--zero", action="store_true", help="ZeRO-1: shard optimizer state over the data axis (moments drop to 1/dp per device)")
 
 
+def ema_decay(value: str) -> float:
+    """argparse type for ``--ema``: a decay in [0, 1). 1.0 would freeze the
+    average at its random-init seed — training improves while every eval
+    silently reports init-quality numbers — so out-of-range fails at parse."""
+    f = float(value)
+    if not 0.0 <= f < 1.0:
+        raise argparse.ArgumentTypeError(
+            f"--ema must be in [0, 1), got {f} (it is a decay; 0 disables)"
+        )
+    return f
+
+
 def add_training_flags(
     parser: argparse.ArgumentParser,
     *,
@@ -63,6 +75,14 @@ def add_training_flags(
                        "(global batch is split evenly; loss-mean semantics "
                        "preserved)")
     group.add_argument("--random_seed", type=int, default=random_seed)
+    group.add_argument("--ema", type=ema_decay, default=0.0,
+                       help="decay for an exponential moving average of "
+                       "params (e.g. 0.999; 0 = off; must be < 1 — at 1.0 "
+                       "the average would stay frozen at init). Eval and "
+                       "--eval_only then use the averaged weights. The EMA "
+                       "rides the checkpoint, so resume/eval/generate runs "
+                       "must pass the flag too (tree mismatch otherwise — "
+                       "fail-loud)")
     group.add_argument("--model_dir", default=model_dir)
     group.add_argument("--model_filename", default=model_filename)
     group.add_argument("--resume", action="store_true", help="resume from the latest checkpoint in --model_dir (full state: step + optimizer too, unlike the reference's weights-only resume, train.py:342-345)")

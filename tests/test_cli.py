@@ -46,6 +46,19 @@ class TestTrainResnetCLI:
         assert "Epoch 0: loss" in logs
         assert "accuracy" in logs
 
+    def test_ema_trains_and_eval_only_restores(self, tmp_path):
+        # --ema rides the checkpoint: eval_only with the same flag restores
+        # the EMA subtree and evaluates with the averaged weights.
+        args = RESNET_ARGS + [
+            "--ema", "0.9",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]
+        assert train_resnet.main(args + ["--num_epochs", "1"]) == 0
+        assert train_resnet.main(args + ["--eval_only"]) == 0
+        logs = _read_logs(tmp_path / "logs")
+        assert "Eval-only: accuracy" in logs
+
     def test_vit_arch_one_epoch(self, tmp_path):
         # The attention-native classifier rides the same trainer stack:
         # --arch is the only change from the reference-parity invocation.

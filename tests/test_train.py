@@ -242,6 +242,102 @@ class _ListLoader:
         return iter(self.batches)
 
 
+class TestEMA:
+    def _ema_state(self):
+        model = tiny_model()
+        tx = build_optimizer("sgd", 0.05, momentum=0.9)
+        return create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 32, 32, 3)), tx, ema=True
+        )
+
+    def test_initialized_to_params(self):
+        state = self._ema_state()
+        for e, p in zip(
+            jax.tree.leaves(state.ema_params), jax.tree.leaves(state.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(e), np.asarray(p))
+
+    def test_off_by_default_keeps_tree(self):
+        # ema_params=None must not add leaves: existing checkpoints keep
+        # their tree structure exactly.
+        state = make_state()
+        assert state.ema_params is None
+        n_core = len(jax.tree.leaves(
+            (state.step, state.params, state.batch_stats, state.opt_state)
+        ))
+        assert len(jax.tree.leaves(state)) == n_core
+
+    def test_update_rule_matches_manual(self):
+        d = 0.9
+        state = self._ema_state()
+        step = make_train_step("classification", donate=False, ema_decay=d)
+        batch = make_batch()
+        manual = jax.tree.map(jnp.copy, state.params)
+        for _ in range(3):
+            state, _ = step(state, batch)
+            manual = jax.tree.map(
+                lambda e, p: d * e + (1 - d) * p, manual, state.params
+            )
+        for e, m in zip(
+            jax.tree.leaves(state.ema_params), jax.tree.leaves(manual)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(e), np.asarray(m), rtol=1e-6, atol=1e-7
+            )
+        # And the EMA genuinely lags the raw params.
+        diffs = [
+            float(jnp.max(jnp.abs(e - p)))
+            for e, p in zip(
+                jax.tree.leaves(state.ema_params), jax.tree.leaves(state.params)
+            )
+        ]
+        assert max(diffs) > 0
+
+    def test_decay_without_ema_state_raises(self):
+        state = make_state()
+        step = make_train_step("classification", donate=False, ema_decay=0.9)
+        with pytest.raises(ValueError, match="tracks no EMA"):
+            step(state, make_batch())
+
+    def test_checkpoint_roundtrips_ema_bits(self, tmp_path):
+        # The silent-drop failure mode: _arrays_only once omitted ema_params,
+        # so restore kept the template's fresh EMA and eval quietly served
+        # init-tinted weights. Bits must survive the roundtrip.
+        state = self._ema_state()
+        step = make_train_step("classification", donate=False, ema_decay=0.9)
+        for _ in range(2):
+            state, _ = step(state, make_batch())
+        ck = Checkpointer(tmp_path / "ck")
+        ck.save(state, epoch=0)
+        template = self._ema_state()
+        restored = ck.restore(template)
+        ck.close()
+        for a, b in zip(
+            jax.tree.leaves(state.ema_params),
+            jax.tree.leaves(restored.ema_params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_eval_uses_ema_weights(self):
+        state = self._ema_state()
+        batch = make_batch()
+        eval_step = make_eval_step("classification")
+        base = float(eval_step(state, batch)["loss"])
+        # Corrupt the RAW params only: eval must be insensitive (it reads
+        # the EMA), and corrupting the EMA must move it.
+        corrupt = lambda t: jax.tree.map(lambda x: x + 1.0, t)  # noqa: E731
+        same = float(
+            eval_step(state.replace(params=corrupt(state.params)), batch)["loss"]
+        )
+        moved = float(
+            eval_step(
+                state.replace(ema_params=corrupt(state.ema_params)), batch
+            )["loss"]
+        )
+        assert same == pytest.approx(base)
+        assert moved != pytest.approx(base)
+
+
 class TestNonFiniteHandling:
     @pytest.mark.slow
     def test_nan_batch_excluded_from_epoch_mean(self, mesh):
