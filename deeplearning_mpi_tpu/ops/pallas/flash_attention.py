@@ -337,6 +337,7 @@ def _bwd_pallas(
     dk_dtype = grad_dtype or k.dtype
     dv_dtype = grad_dtype or v.dtype
     bq, bk = min(block_q, seq), min(block_k, seq)
+    bq, bk = fit_bwd_blocks(bq, bk, q.dtype)
     scale = head_dim**-0.5
 
     # One index map per (side, grid): the dq grid is (b, h, q, kv), the dkv
@@ -418,6 +419,31 @@ def fit_block(block: int, seq: int) -> int:
     while b > 8 and seq % b:
         b //= 2
     return b
+
+
+#: Scoped-VMEM budget for one backward tile's [bq, bk] intermediates. The
+#: hardware limit is 16 MiB (v5e "scoped vmem"); Mosaic's stack for
+#: _tile_p_ds measures ~17.75 MB at 1024x1024 f32 (s/p/dp/ds + the
+#: input-dtype casts of p and ds — the compile error that motivated this
+#: cap, hit by the 64k-seq f32 train_lm run) and ~14.7 MB at 1024x1024
+#: bf16, which compiles. 10 + 2*itemsize bytes/element reproduces both
+#: measurements (18 vs 14 B/elem); 15 MiB leaves margin for the row blocks.
+_BWD_TILE_BYTES_BUDGET = 15 * 1024 * 1024
+
+
+def fit_bwd_blocks(bq: int, bk: int, dtype) -> tuple[int, int]:
+    """Shrink backward tile sizes until the per-tile scoped-VMEM estimate
+    fits. The forward kernel keeps its own (larger-is-faster) blocks — only
+    the backward materializes four-plus ``[bq, bk]`` intermediates at once.
+    Halves the larger side first (a power-of-two divisor of ``seq`` stays a
+    divisor when halved, so tileability is preserved)."""
+    per_elem = 10 + 2 * jnp.dtype(dtype).itemsize
+    while bq * bk * per_elem > _BWD_TILE_BYTES_BUDGET and max(bq, bk) > 8:
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
+    return bq, bk
 
 
 def usable_blocks(bq: int, bk: int, seq: int) -> bool:
