@@ -284,10 +284,11 @@ class Attention(nn.Module):
         )
         cached_k.value, cached_v.value = new_k, new_v
         index.value = i + 1
-        # Windowed online-softmax over the filled prefix only — the dense
-        # whole-buffer-then-mask formulation read all max_len rows per token;
-        # decode_attention's dynamic trip count stops at the prefix, so
-        # per-token HBM traffic is O(i), not O(max_len).
+        # decode_attention picks its schedule at trace time on the static
+        # buffer length: one fused masked einsum at the HBM roofline for
+        # buffers <= DECODE_DENSE_MAX (reads all rows — safe because this
+        # cache zero-initializes), the blockwise prefix walk (O(i) reads
+        # per token) beyond it. Measured rationale: PERF_ANALYSIS.md §9.
         return decode_attention(q, new_k, new_v, i)
 
 

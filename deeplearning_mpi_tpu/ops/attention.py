@@ -86,32 +86,47 @@ def dense_attention(
     return out.astype(q.dtype)
 
 
+#: Buffers at or below this length take the one-shot masked path: measured
+#: on a v5e (tools/bench_decode.py, device-looped timing), the single fused
+#: einsum runs at the HBM roofline (~72 us/token flat at B8 H12 D64
+#: max_len 2048) while the blockwise while-loop walk pays ~40 us per
+#: iteration (~45% of roofline at block 512) — it only beats reading the
+#: whole buffer once the unfilled tail it skips outweighs that derate,
+#: i.e. on long buffers.
+DECODE_DENSE_MAX = 4096
+
+
 def decode_attention(
     q: jax.Array,
     k_buf: jax.Array,
     v_buf: jax.Array,
     index: jax.Array,
     *,
-    block: int = 512,
+    block: int = 2048,
+    dense_max: int = DECODE_DENSE_MAX,
 ) -> jax.Array:
-    """One KV-cached decode step: online-softmax attention over the filled
-    prefix of the cache, never touching unfilled blocks.
+    """One KV-cached decode step over the filled prefix of the cache.
 
     ``q`` is ``[B, 1, H, D]`` (the single new token, RoPE applied);
     ``k_buf``/``v_buf`` are the ``[B, max_len, Hkv, D]`` cache buffers with
     positions ``0..index`` (inclusive) filled. ``Hkv`` may be a divisor of
     ``H`` (grouped-query attention): the grouped buffers are read as-is —
     never repeated to ``H`` — so decode HBM traffic per token scales with
-    ``Hkv``, compounding GQA's cache-size saving with the windowed read.
-    The dense formulation scores
-    the WHOLE buffer and masks — O(max_len) HBM reads per token no matter
-    how short the prefix. Here the buffer is walked in ``block``-sized
-    chunks under a ``lax.fori_loop`` whose trip count is
-    ``ceil((index+1)/block)`` — a *traced* bound (XLA lowers it to a while
-    loop), so blocks past the prefix are neither read nor scored: decode
-    attention HBM traffic scales with the tokens generated so far, not the
-    buffer size ("flash-decoding" schedule, single chip). The flash-style
-    ``(acc, m, l)`` accumulator keeps softmax exact across chunks in f32.
+    ``Hkv``, compounding GQA's cache-size saving.
+
+    Two schedules, chosen at TRACE time on the static buffer length:
+
+    - ``max_len <= dense_max``: ONE masked grouped einsum over the whole
+      buffer. Reads unfilled rows, but as a single fused op it runs at the
+      HBM roofline — measured 1.3-2.3x faster than the blockwise walk for
+      fills above ~1/3 of a 2k buffer (tools/bench_decode.py).
+    - longer buffers: the flash-decoding walk — ``block``-sized chunks
+      under a ``lax.fori_loop`` whose trip count ``ceil((index+1)/block)``
+      is *traced* (XLA lowers a while loop), so blocks past the prefix are
+      neither read nor scored and per-token HBM traffic is O(index), not
+      O(max_len). The flash-style ``(acc, m, l)`` accumulator keeps softmax
+      exact across chunks in f32. The 2048 default block amortizes the
+      measured ~40 us/iteration loop overhead.
 
     Not differentiable (dynamic trip count) — decode is inference-only.
     """
@@ -124,6 +139,26 @@ def decode_attention(
             f"query heads ({heads}) must be a multiple of KV heads ({kv_heads})"
         )
     group = heads // kv_heads
+    scale = head_dim**-0.5
+
+    if length <= dense_max:
+        # Input-dtype dot with an f32 accumulator — the same formulation
+        # the roofline measurement used. An astype(f32) on k_buf instead
+        # would risk materializing a double-width copy of the whole cache,
+        # exactly the HBM bytes this path is chosen to minimize.
+        qg = q[:, 0].reshape(batch, kv_heads, group, head_dim)
+        s = jnp.einsum(
+            "bhgd,bkhd->bhgk", qg, k_buf,
+            preferred_element_type=jnp.float32,
+        ) * scale  # [B, Hkv, G, L]
+        valid = jnp.arange(length, dtype=jnp.int32) <= index
+        s = jnp.where(valid[None, None, None, :], s, NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum(
+            "bhgk,bkhd->bhgd", w.astype(v_buf.dtype), v_buf,
+            preferred_element_type=jnp.float32,
+        )
+        return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)
     # Blocks stay full-size whatever the buffer length (a CLI cache is
     # prompt+max_new — arbitrary): the final block's start is clamped back
     # so it never runs off the buffer, and rows it re-reads from the
@@ -132,7 +167,6 @@ def decode_attention(
     # chains down to 4) and lose to the dense path it replaces.
     b = min(block, length)
     n_blocks = (index + b) // b  # ceil((index+1)/b), traced
-    scale = head_dim**-0.5
     # [B, Hkv, G, D]: query heads grouped by the KV head they share.
     q32 = (q[:, 0].astype(jnp.float32) * scale).reshape(
         batch, kv_heads, group, head_dim

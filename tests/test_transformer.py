@@ -80,8 +80,13 @@ class TestDecodeAttention:
         weights = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_buf.dtype), v_buf)
 
+    # dense_max=0 forces the blockwise walk on these tiny buffers; the
+    # default dispatcher sends them down the one-shot masked path (buffers
+    # <= DECODE_DENSE_MAX take it — measured faster, ops/attention.py).
+    # Parametrizing both pins the two schedules to the same oracle.
+    @pytest.mark.parametrize("dense_max", [0, 4096], ids=["windowed", "dense"])
     @pytest.mark.parametrize("index", [0, 1, 7, 8, 19, 31])
-    def test_matches_dense_oracle_at_every_fill(self, index):
+    def test_matches_dense_oracle_at_every_fill(self, index, dense_max):
         from deeplearning_mpi_tpu.ops.attention import decode_attention
 
         rng = np.random.default_rng(index)
@@ -89,15 +94,19 @@ class TestDecodeAttention:
         k_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
         v_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
         q = jnp.asarray(rng.normal(size=(2, 1, 3, 8)), jnp.float32)
-        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=8)
+        out = decode_attention(
+            q, k_buf, v_buf, jnp.int32(index), block=8, dense_max=dense_max
+        )
         ref = self._oracle(q, k_buf, v_buf, index)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
     def test_unfilled_blocks_never_read(self):
-        # Poison the buffer past the prefix with NaN: the dense-then-mask
-        # formulation survives this only via masking; the windowed walk must
+        # Poison the buffer past the prefix with NaN: the windowed walk must
         # never touch those blocks at all (0*NaN would still be NaN in the
-        # accumulator if a poisoned block were scored).
+        # accumulator if a poisoned block were scored). A walk-only
+        # invariant — the one-shot path reads (and zero-weights) the whole
+        # buffer, which is safe for real caches because unfilled rows are
+        # zero-initialized, hence dense_max=0 here.
         from deeplearning_mpi_tpu.ops.attention import decode_attention
 
         rng = np.random.default_rng(0)
@@ -109,7 +118,8 @@ class TestDecodeAttention:
         v_buf[:, 8:] = np.nan
         q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
         out = decode_attention(
-            q, jnp.asarray(k_buf), jnp.asarray(v_buf), jnp.int32(7), block=8
+            q, jnp.asarray(k_buf), jnp.asarray(v_buf), jnp.int32(7), block=8,
+            dense_max=0,
         )
         assert np.all(np.isfinite(np.asarray(out)))
 
@@ -125,7 +135,9 @@ class TestDecodeAttention:
         k_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
         v_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
         q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
-        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=16)
+        out = decode_attention(
+            q, k_buf, v_buf, jnp.int32(index), block=16, dense_max=0
+        )
         ref = self._oracle(q, k_buf, v_buf, index)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
@@ -137,8 +149,9 @@ class TestDecodeAttention:
         with pytest.raises(ValueError, match="one query token"):
             decode_attention(q, buf, buf, jnp.int32(0))
 
+    @pytest.mark.parametrize("dense_max", [0, 4096], ids=["windowed", "dense"])
     @pytest.mark.parametrize("index", [0, 5, 19, 31])
-    def test_gqa_matches_repeated_kv(self, index):
+    def test_gqa_matches_repeated_kv(self, index, dense_max):
         # Grouped buffers consumed natively must equal plain decode over the
         # same buffers repeated to full head count — the repeat_kv ordering
         # (consecutive query heads share kv head h//G) is part of the
@@ -149,9 +162,12 @@ class TestDecodeAttention:
         k_buf = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
         v_buf = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
         q = jnp.asarray(rng.normal(size=(2, 1, 4, 8)), jnp.float32)  # H=4, Hkv=2
-        out = decode_attention(q, k_buf, v_buf, jnp.int32(index), block=8)
+        out = decode_attention(
+            q, k_buf, v_buf, jnp.int32(index), block=8, dense_max=dense_max
+        )
         ref = decode_attention(
-            q, repeat_kv(k_buf, 2), repeat_kv(v_buf, 2), jnp.int32(index), block=8
+            q, repeat_kv(k_buf, 2), repeat_kv(v_buf, 2), jnp.int32(index),
+            block=8, dense_max=dense_max,
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
 

@@ -34,14 +34,18 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
     grouped form — exactly why the HBM win exists), while the windowed path
     reads the grouped buffers natively.
     """
+    import functools
+
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from deeplearning_mpi_tpu.ops.attention import (
         NEG_INF,
         decode_attention,
         repeat_kv,
     )
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
 
     kv_heads = kv_heads or heads
     if heads % kv_heads:
@@ -69,15 +73,52 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
         w = jax.nn.softmax(s, axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
 
-    windowed = jax.jit(decode_attention, static_argnames=("block",))
+    # dense_max=0 forces the blockwise walk — this tool MEASURES the two
+    # schedules against each other, so the dispatcher that normally picks
+    # one must not reroute the windowed arm to dense. block=512 matches the
+    # recorded PERF_ANALYSIS §9 table (the shipped walk uses 2048).
+    windowed = functools.partial(decode_attention, block=512, dense_max=0)
+
+    def make_loop(fn):
+        # Device-looped timing: ONE dispatch runs `n` serialized executions
+        # of fn inside a jitted fori_loop whose carry feeds each iteration's
+        # q from the previous output (scaled by a *runtime* eps=0 scalar, so
+        # XLA can neither fold the dependence away nor hoist fn out of the
+        # loop). A host-side loop of per-call dispatches measured dispatch
+        # cadence, not device time, on the tunneled TPU — it produced
+        # physically impossible numbers (windowed decode getting CHEAPER
+        # with more fill). n is traced -> one executable for any trip count.
+        @jax.jit
+        def loop(n, eps, q, k, v, i):
+            def body(_, carry):
+                out = fn(carry, k, v, i).astype(carry.dtype)
+                return carry + eps.astype(carry.dtype) * out
+
+            return lax.fori_loop(0, n, body, q)
+
+        return loop
 
     def clock(fn, *args) -> float:
-        fn(*args).block_until_ready()  # compile
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / steps * 1e6  # us/token
+        # Two trip counts; the difference cancels the fixed dispatch +
+        # tunnel round-trip cost. Syncs are host_sync D2H fetches — on the
+        # tunnel, block_until_ready returns before execution finishes
+        # (utils.profiling.host_sync docstring). The long loop must put
+        # DEVICE time well above tunnel jitter (~10 ms round-trip spikes
+        # produced negative diffs at 100 trips x ~50 us), hence 10*steps
+        # trips and a median over 3 estimates.
+        loop = make_loop(fn)
+        n0, n1 = 16, 16 + 10 * steps
+        eps = jnp.float32(0.0)
+        host_sync(loop(n0, eps, *args).ravel()[:1])  # compile
+        estimates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            host_sync(loop(n0, eps, *args).ravel()[:1])
+            t1 = time.perf_counter()
+            host_sync(loop(n1, eps, *args).ravel()[:1])
+            t2 = time.perf_counter()
+            estimates.append(((t2 - t1) - (t1 - t0)) / (n1 - n0) * 1e6)
+        return sorted(estimates)[1]  # us/execution
 
     rows = []
     for fill in fills:
@@ -115,7 +156,6 @@ def bench_e2e(max_len: int, *, new_tokens: int = 256,
     model = TransformerLM(config=cfg, dtype=dt)
     new_tokens = min(new_tokens, max_len // 2)  # small --max_len smokes
     prompt_len = max_len - new_tokens
-    prompt = jnp.zeros((1, prompt_len), jnp.int32)
     params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
     if quantize == "int8":
         import dataclasses
@@ -130,13 +170,26 @@ def bench_e2e(max_len: int, *, new_tokens: int = 256,
     fn = generate_jit(model, max_new_tokens=new_tokens, temperature=0.0)
     rng = jax.random.key(0)
 
-    def run():
-        return fn(params, prompt, rng)
+    # Median of 3 timed calls, distinct prompt content each, synced by a
+    # D2H fetch (host_sync): block_until_ready returns before remote
+    # execution finishes on the tunneled TPU — a 2048-position decode once
+    # "measured" 0.23 ms wall, ~40x faster than its own per-token attention
+    # cost, because only dispatch was timed.
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
 
-    jax.block_until_ready(run())  # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(run())
-    dt_s = time.perf_counter() - t0
+    prompts = [
+        jax.random.randint(
+            jax.random.key(s), (1, prompt_len), 0, cfg.vocab_size, jnp.int32
+        )
+        for s in range(4)
+    ]
+    host_sync(fn(params, prompts[0], rng).ravel()[:1])  # compile
+    times = []
+    for p in prompts[1:]:
+        t0 = time.perf_counter()
+        host_sync(fn(params, p, rng).ravel()[:1])
+        times.append(time.perf_counter() - t0)
+    dt_s = sorted(times)[len(times) // 2]
     positions = prompt_len + new_tokens  # the scan decodes every position
     row = {
         "e2e_context": max_len, "new_tokens": new_tokens,

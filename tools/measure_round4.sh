@@ -44,10 +44,14 @@ step "2. decode: windowed vs dense at 2k + e2e generate" 1200 \
     python tools/bench_decode.py --e2e
 step "3. ring schedules' per-rotation inner at 8k local seq" 1200 \
     python tools/bench_flash.py --ring_inner --seqs 8192
+# The 110M flagship shape in bf16 — the BASELINE.md "64k context" entry
+# (11.0k tok/s = epoch-1 tokens / duration from the run log). Two epochs so
+# the second is compile-free; f32 also compiles since fit_bwd_blocks.
 step "4. 64k-token single-chip step (flash + remat + chunked loss)" 1800 \
     python -m deeplearning_mpi_tpu.cli.train_lm \
     --seq_len 65536 --attention flash --remat --loss_chunk 2048 \
-    --batch_size 1 --num_epochs 1 --train_sequences 2 \
+    --batch_size 1 --num_epochs 2 --train_sequences 4 --dtype bfloat16 \
+    --num_layers 12 --num_heads 12 --head_dim 64 --d_model 768 --d_ff 3072 \
     --model_dir /tmp/m4_ckpt --log_dir /tmp/m4_logs
 
 echo "== 5. (opt-in, slow compile) 32k long-context bench entry =="
