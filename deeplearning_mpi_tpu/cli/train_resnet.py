@@ -39,6 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "on the same data/trainer stack")
     parser.add_argument("--stem", default="imagenet", choices=["imagenet", "cifar"],
                         help="imagenet = torchvision-parity 7x7/2 stem (main.py:40)")
+    parser.add_argument("--torch_padding", action="store_true",
+                        help="torch-exact symmetric padding on strided convs "
+                        "— use when resuming a dmt-import-torch'd "
+                        "torchvision checkpoint (models/resnet.py)")
     parser.add_argument("--data_dir", default="data", help="dir containing cifar-10-batches-py")
     parser.add_argument("--synthetic", action="store_true",
                         help="train on synthetic CIFAR-like data (no dataset needed)")
@@ -51,6 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.torch_padding and args.arch.startswith("vit"):
+        raise SystemExit(
+            "--torch_padding is a CNN numerics flag (strided-conv "
+            "padding); it does not apply to --arch " + args.arch
+        )
 
     from deeplearning_mpi_tpu.utils import config
 
@@ -109,9 +118,13 @@ def main(argv: list[str] | None = None) -> int:
         num_workers=args.num_workers,
     )
 
+    model_kw = {}
+    if args.torch_padding:  # vit rejected at parse time above
+        model_kw["torch_padding"] = True
     model = get_model(
         args.arch, num_classes=10, stem=args.stem,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        **model_kw,
     )
     tx = build_optimizer(
         "sgd", config.build_lr(args, train_loader),

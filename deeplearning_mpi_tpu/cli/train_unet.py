@@ -38,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--mask_suffix", default="", help="mask filename suffix, e.g. _mask")
     parser.add_argument("--bilinear", action="store_true",
                         help="bilinear upsampling instead of transposed conv (model.py:40-43)")
+    parser.add_argument("--reference_topology", action="store_true",
+                        help="the reference's decoder channel plan (upsample "
+                        "keeps channels, DoubleConv reduces from 3f) — "
+                        "required when resuming from a dmt-import-torch'd "
+                        ".pth checkpoint")
     parser.add_argument("--val_fraction", type=float, default=0.2,
                         help="held-out fraction (80/20 split parity, train.py:86-88)")
     parser.add_argument("--clip_norm", type=float, default=1.0)
@@ -145,6 +150,7 @@ def main(argv: list[str] | None = None) -> int:
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         spatial_dims=3 if args.volumetric else 2,
         remat=args.remat,
+        reference_topology=args.reference_topology,
     )
     tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=args.clip_norm)
 

@@ -46,14 +46,18 @@ class BasicBlock(nn.Module):
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    pad3: Any = "SAME"  # 3×3 conv padding; see ResNet.torch_padding
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         residual = x
-        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=self.pad3,
+        )(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = self.conv(self.filters, (3, 3), padding=self.pad3)(y)
         y = self.norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = self.conv(
@@ -70,6 +74,7 @@ class Bottleneck(nn.Module):
     strides: int
     conv: ModuleDef
     norm: ModuleDef
+    pad3: Any = "SAME"  # 3×3 conv padding; see ResNet.torch_padding
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -77,7 +82,10 @@ class Bottleneck(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            padding=self.pad3,
+        )(y)
         y = self.norm()(y)
         y = nn.relu(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
@@ -101,6 +109,13 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
     bn_momentum: float = 0.9  # = 1 - torch momentum 0.1
     bn_epsilon: float = 1e-5
+    # torch-exact symmetric padding on STRIDED convs. Flax 'SAME' with
+    # stride 2 pads asymmetrically ((2,3) for the 7×7 stem, (0,1) for 3×3)
+    # where torch pads ((3,3))/((1,1)) — same output shapes and param tree,
+    # but a shifted conv grid, which degrades weights trained under torch's
+    # convention. Turn on when restoring a dmt-import-torch'd torchvision
+    # checkpoint; fresh TPU training keeps the XLA-native default.
+    torch_padding: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
@@ -120,9 +135,12 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
         )
 
+        pad7 = ((3, 3), (3, 3)) if self.torch_padding else "SAME"
+        pad3 = ((1, 1), (1, 1)) if self.torch_padding else "SAME"
+
         x = x.astype(self.dtype)
         if self.stem == "imagenet":
-            x = conv(self.num_filters, (7, 7), strides=(2, 2))(x)
+            x = conv(self.num_filters, (7, 7), strides=(2, 2), padding=pad7)(x)
             x = norm()(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -141,6 +159,7 @@ class ResNet(nn.Module):
                     strides=strides,
                     conv=conv,
                     norm=norm,
+                    pad3=pad3,
                 )(x)
 
         x = jnp.mean(x, axis=(1, 2))

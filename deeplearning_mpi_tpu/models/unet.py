@@ -80,6 +80,15 @@ class UNet(nn.Module):
     bn_epsilon: float = 1e-5
     spatial_dims: int = 2  # 2 = NHWC images, 3 = NDHWC volumes
     remat: bool = False  # checkpoint each DoubleConv (memory for recompute)
+    # Reference decoder topology, for importing its checkpoints
+    # (utils/torch_import.py). The reference's UpBlock KEEPS channels in the
+    # upsample (ConvTranspose2d(in-out, in-out), model.py:37-38) and lets
+    # DoubleConv reduce from up+skip (3f -> f); its concat order is
+    # [upsampled, skip] (model.py:47). Our default halves channels in the
+    # transposed conv first (f*2 -> f, concat -> 2f) — fewer DoubleConv
+    # FLOPs at the same accuracy class. Param shapes differ, so the flag is
+    # part of the checkpoint contract.
+    reference_topology: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, *, train: bool = True) -> jax.Array:
@@ -128,16 +137,20 @@ class UNet(nn.Module):
                     x.shape[-1],
                 )
                 x = jax.image.resize(x, shape, method="linear")
-                x = conv(f, kernel_size=(1,) * d)(x)
+                if not self.reference_topology:  # ref bilinear is a pure Upsample
+                    x = conv(f, kernel_size=(1,) * d)(x)
             else:
                 x = nn.ConvTranspose(
-                    f,
+                    x.shape[-1] if self.reference_topology else f,
                     (2,) * d,
                     strides=(2,) * d,
                     dtype=self.dtype,
                     param_dtype=jnp.float32,
                 )(x)
-            x = jnp.concatenate([skip, x], axis=-1)  # concat on channels (model.py:46)
+            if self.reference_topology:
+                x = jnp.concatenate([x, skip], axis=-1)  # model.py:47 order
+            else:
+                x = jnp.concatenate([skip, x], axis=-1)  # concat on channels (model.py:46)
             x = double(f, name=f"up_{i}")(x)
 
         # 1×1 head, with bias (no BN follows) — model.py:68,80.

@@ -1,0 +1,265 @@
+"""Import the reference's PyTorch ``.pth`` checkpoints into this framework.
+
+The reference saves raw DDP state_dicts — ``torch.save(ddp_model.
+state_dict(), path)`` every 10 epochs (``pytorch/resnet/main.py:139``,
+``pytorch/unet/train.py:216``) — so a user migrating from it arrives with
+``.pth`` files whose keys carry DDP's ``module.`` prefix. This module
+converts those serialized trees into this framework's Flax variables
+(``params`` + ``batch_stats``), handling the layout differences:
+
+- torch ``Conv2d`` weights are OIHW; Flax kernels are HWIO.
+- torch ``ConvTranspose2d`` weights are (in, out, kH, kW); Flax
+  ``nn.ConvTranspose`` kernels are (kH, kW, in, out).
+- torch ``Linear`` weights are (out, in); Flax ``Dense`` kernels are
+  (in, out).
+- torch BatchNorm splits into params (weight→scale, bias→bias) and
+  running stats (running_mean→mean, running_var→var).
+- The reference's 3×3 convs keep torch's default ``bias=True`` even though
+  BatchNorm follows (``pytorch/unet/model.py:9-13``); our convs are
+  bias-free there, so the bias is *folded into the BN running mean*:
+  BN(Wx + b) with stats (m, v) equals BN'(Wx) with stats (m − b, v) — an
+  exact transform, not an approximation.
+
+Only the UNet import needs the bias fold; torchvision ResNets use
+bias-free convs. UNet checkpoints restore into
+``UNet(reference_topology=True)`` — the reference's decoder keeps channels
+through the upsample and reduces in DoubleConv (``model.py:37-38,63-66``),
+which is a different param-shape contract than our default decoder.
+
+torch is imported lazily: it is only needed when actually reading a
+``.pth`` file, and the rest of the framework must not pay its import cost.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+Tree = dict[str, Any]
+
+
+def strip_ddp_prefix(state_dict: Mapping[str, Any]) -> dict[str, Any]:
+    """Drop the ``module.`` prefix DDP adds to every key.
+
+    The reference saves the *wrapped* model's state_dict, so its files
+    always carry the prefix (SURVEY.md §5.4); a plain model's dict passes
+    through unchanged. Mixed dicts are rejected — that indicates a file
+    this converter does not understand.
+    """
+    keys = list(state_dict)
+    prefixed = [k.startswith("module.") for k in keys]
+    if all(prefixed):
+        return {k[len("module."):]: v for k, v in state_dict.items()}
+    if any(prefixed):
+        bad = [k for k, p in zip(keys, prefixed) if not p][:3]
+        raise ValueError(
+            f"state_dict mixes DDP-prefixed and bare keys (e.g. {bad}); "
+            "refusing to guess"
+        )
+    return dict(state_dict)
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch tensor (or array-like) → float32 numpy without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _conv_kernel(w: Any) -> np.ndarray:
+    """OIHW → HWIO."""
+    return _np(w).transpose(2, 3, 1, 0)
+
+
+def _conv_transpose_kernel(w: Any) -> np.ndarray:
+    """torch ConvTranspose2d (in, out, kH, kW) → Flax (kH, kW, in, out).
+
+    Flax's ``nn.ConvTranspose`` (``lax.conv_transpose`` with
+    ``transpose_kernel=False``) correlates the *unflipped* kernel with the
+    stride-dilated input, while torch's ConvTranspose2d is the gradient of a
+    convolution — equivalent to correlating the spatially FLIPPED kernel.
+    For the reference's 2×2 stride-2 upsample the blocks do not overlap, so
+    the flip is exactly a reversal of both spatial axes (verified
+    numerically against ``torch.nn.functional.conv_transpose2d`` in
+    ``tests/test_torch_import.py``).
+    """
+    return _np(w)[:, :, ::-1, ::-1].transpose(2, 3, 0, 1)
+
+
+def _set(tree: Tree, path: tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for name in path[:-1]:
+        node = node.setdefault(name, {})
+    if path[-1] in node:
+        raise ValueError(f"duplicate assignment at {'/'.join(path)}")
+    node[path[-1]] = value
+
+
+def _double_conv(
+    sd: Mapping[str, Any], src: str, params: Tree, stats: Tree,
+    dst: tuple[str, ...],
+) -> None:
+    """One reference DoubleConv (``<src>.double_conv.{0,1,3,4}``) → our
+    ``Conv_{0,1}`` / ``BatchNorm_{0,1}`` under ``dst``, folding each conv's
+    bias into the following BN's running mean."""
+    for our_idx, (conv_i, bn_i) in enumerate(((0, 1), (3, 4))):
+        conv, bn = f"{src}.double_conv.{conv_i}", f"{src}.double_conv.{bn_i}"
+        _set(params, dst + (f"Conv_{our_idx}", "kernel"),
+             _conv_kernel(sd[f"{conv}.weight"]))
+        _set(params, dst + (f"BatchNorm_{our_idx}", "scale"),
+             _np(sd[f"{bn}.weight"]))
+        _set(params, dst + (f"BatchNorm_{our_idx}", "bias"),
+             _np(sd[f"{bn}.bias"]))
+        _set(stats, dst + (f"BatchNorm_{our_idx}", "mean"),
+             _np(sd[f"{bn}.running_mean"]) - _np(sd[f"{conv}.bias"]))
+        _set(stats, dst + (f"BatchNorm_{our_idx}", "var"),
+             _np(sd[f"{bn}.running_var"]))
+
+
+def convert_reference_unet(
+    state_dict: Mapping[str, Any],
+) -> dict[str, Tree]:
+    """Reference UNet state_dict → variables for
+    ``UNet(reference_topology=True, bilinear=False)``.
+
+    Key layout (from the reference's module attribute names,
+    ``pytorch/unet/model.py:51-68``): ``down_conv{1..4}`` encoder blocks,
+    ``double_conv`` bottleneck, ``up_conv{4..1}`` decoder blocks (each with
+    an ``up_sample`` ConvTranspose2d in conv_transpose mode), ``conv_last``
+    1×1 head. Decoder order reverses: ``up_conv4`` (deepest) is our
+    ``up_0``. Returns ``{"params": ..., "batch_stats": ...}``.
+    """
+    sd = strip_ddp_prefix(state_dict)
+    params: Tree = {}
+    stats: Tree = {}
+    # DownBlock/UpBlock hold a DoubleConv attribute named double_conv whose
+    # inner Sequential is ALSO named double_conv, so their keys nest it
+    # twice; the bottleneck is a bare DoubleConv (one level).
+    for n in range(1, 5):
+        _double_conv(
+            sd, f"down_conv{n}.double_conv", params, stats, (f"down_{n - 1}",)
+        )
+    _double_conv(sd, "double_conv", params, stats, ("bottleneck",))
+    for i, m in enumerate((4, 3, 2, 1)):
+        up = f"up_conv{m}.up_sample"
+        if f"{up}.weight" in sd:  # conv_transpose mode; bilinear has no params
+            _set(params, (f"ConvTranspose_{i}", "kernel"),
+                 _conv_transpose_kernel(sd[f"{up}.weight"]))
+            _set(params, (f"ConvTranspose_{i}", "bias"), _np(sd[f"{up}.bias"]))
+        _double_conv(
+            sd, f"up_conv{m}.double_conv", params, stats, (f"up_{i}",)
+        )
+    # 1×1 head: bias kept (no BN follows), model.py:68.
+    _set(params, ("Conv_0", "kernel"), _conv_kernel(sd["conv_last.weight"]))
+    _set(params, ("Conv_0", "bias"), _np(sd["conv_last.bias"]))
+
+    used = {k.rsplit(".", 1)[0] for k in sd}
+    known = {"conv_last"}
+    doubles = (
+        [f"down_conv{n}.double_conv" for n in range(1, 5)]
+        + ["double_conv"]
+        + [f"up_conv{m}.double_conv" for m in range(1, 5)]
+    )
+    known |= {f"{d}.double_conv.{i}" for d in doubles for i in (0, 1, 3, 4)}
+    known |= {f"up_conv{m}.up_sample" for m in range(1, 5)}
+    extra = sorted(set(used) - known)
+    if extra:
+        raise ValueError(f"unrecognized modules in state_dict: {extra[:5]}")
+    return {"params": params, "batch_stats": stats}
+
+
+# torchvision ResNet naming is canonical public API: stem conv1/bn1, stages
+# layer1..layer4 of numbered blocks, each block conv1/bn1/conv2/bn2
+# (+conv3/bn3 for Bottleneck) and optional downsample.{0,1}, head fc. The
+# reference builds exactly this via torchvision and only swaps fc
+# (``pytorch/resnet/main.py:40-41``).
+_RESNET_BLOCKS = {
+    "resnet18": (2, 2, 2, 2),
+    "resnet34": (3, 4, 6, 3),
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+_BOTTLENECK = {"resnet50", "resnet101", "resnet152"}
+
+
+def convert_torchvision_resnet(
+    state_dict: Mapping[str, Any], arch: str = "resnet18"
+) -> dict[str, Tree]:
+    """torchvision ResNet state_dict → variables for our ``models.resnet``
+    builders (ImageNet stem — what the reference trains,
+    ``pytorch/resnet/main.py:40``).
+
+    Our blocks are flat-numbered across stages (``BasicBlock_0..7`` for
+    resnet18; ``Bottleneck_*`` for 50/101/152) with convs/BNs numbered
+    in declaration order and the downsample projection LAST
+    (``Conv_2``/``BatchNorm_2`` for basic, ``Conv_3``/``BatchNorm_3`` for
+    bottleneck).
+
+    Numerical-exactness note: restore into a model built with
+    ``torch_padding=True`` — flax 'SAME' pads strided convs asymmetrically,
+    shifting the conv grid the weights were trained under
+    (``models/resnet.py``).
+    """
+    if arch not in _RESNET_BLOCKS:
+        raise ValueError(f"unknown arch {arch!r}; one of {sorted(_RESNET_BLOCKS)}")
+    sd = strip_ddp_prefix(state_dict)
+    bottleneck = arch in _BOTTLENECK
+    n_convs = 3 if bottleneck else 2
+    block_name = "Bottleneck" if bottleneck else "BasicBlock"
+    params: Tree = {}
+    stats: Tree = {}
+
+    def bn(src: str, dst: tuple[str, ...]) -> None:
+        _set(params, dst + ("scale",), _np(sd[f"{src}.weight"]))
+        _set(params, dst + ("bias",), _np(sd[f"{src}.bias"]))
+        _set(stats, dst + ("mean",), _np(sd[f"{src}.running_mean"]))
+        _set(stats, dst + ("var",), _np(sd[f"{src}.running_var"]))
+
+    _set(params, ("Conv_0", "kernel"), _conv_kernel(sd["conv1.weight"]))
+    bn("bn1", ("BatchNorm_0",))
+
+    flat = 0
+    for stage, n_blocks in enumerate(_RESNET_BLOCKS[arch], start=1):
+        for b in range(n_blocks):
+            src = f"layer{stage}.{b}"
+            ours = f"{block_name}_{flat}"
+            for c in range(1, n_convs + 1):
+                _set(params, (ours, f"Conv_{c - 1}", "kernel"),
+                     _conv_kernel(sd[f"{src}.conv{c}.weight"]))
+                bn(f"{src}.bn{c}", (ours, f"BatchNorm_{c - 1}"))
+            if f"{src}.downsample.0.weight" in sd:
+                _set(params, (ours, f"Conv_{n_convs}", "kernel"),
+                     _conv_kernel(sd[f"{src}.downsample.0.weight"]))
+                bn(f"{src}.downsample.1", (ours, f"BatchNorm_{n_convs}"))
+            flat += 1
+
+    _set(params, ("Dense_0", "kernel"), _np(sd["fc.weight"]).T)
+    _set(params, ("Dense_0", "bias"), _np(sd["fc.bias"]))
+
+    # Every module in the file must have been consumed — an arch-mismatched
+    # .pth (e.g. a resnet34 imported as resnet18: all resnet18 keys exist
+    # with identical shapes, 9 trained blocks silently dropped) would
+    # otherwise convert cleanly into a frankenmodel.
+    known = {"conv1", "bn1", "fc"}
+    for stage, n_blocks in enumerate(_RESNET_BLOCKS[arch], start=1):
+        for b in range(n_blocks):
+            src = f"layer{stage}.{b}"
+            known |= {f"{src}.conv{c}" for c in range(1, n_convs + 1)}
+            known |= {f"{src}.bn{c}" for c in range(1, n_convs + 1)}
+            known |= {f"{src}.downsample.0", f"{src}.downsample.1"}
+    extra = sorted({k.rsplit(".", 1)[0] for k in sd} - known)
+    if extra:
+        raise ValueError(
+            f"state_dict has modules {arch} does not ({extra[:5]}…) — "
+            f"wrong --arch?"
+        )
+    return {"params": params, "batch_stats": stats}
+
+
+def load_pth(path: str) -> dict[str, Any]:
+    """Read a ``.pth`` file the way the reference wrote it (CPU map)."""
+    import torch  # lazy: only the import path needs it
+
+    return torch.load(path, map_location="cpu", weights_only=True)

@@ -1,0 +1,409 @@
+"""torch .pth checkpoint import: layout conversions verified against torch
+functional ops, and the full reference-UNet import verified end-to-end
+against a functional oracle of the reference architecture.
+
+The oracle composes torch.nn.functional calls following the documented call
+graph (SURVEY.md §3.4 / models/unet.py docstring): four DoubleConv+maxpool
+encoder stages, a DoubleConv bottleneck, four [ConvTranspose2d(2,2,s2) →
+cat(up, skip) → DoubleConv] decoder stages, a 1×1 head. It consumes the
+same randomly-initialized state_dict the converter does, so a single
+comparison pins every conversion at once: OIHW→HWIO, the conv-bias → BN
+running-mean fold, BN param/stat split, ConvTranspose orientation, concat
+order, and the reference_topology channel plan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning_mpi_tpu.models.unet import UNet  # noqa: E402
+from deeplearning_mpi_tpu.utils.torch_import import (  # noqa: E402
+    convert_reference_unet,
+    convert_torchvision_resnet,
+    strip_ddp_prefix,
+)
+
+
+def _double_conv_sd(prefix: str, cin: int, cout: int, g) -> dict:
+    sd = {}
+    for idx, (ci, co) in zip((0, 3), ((cin, cout), (cout, cout))):
+        # fan-in scaling keeps activations O(1) through all 13 conv layers —
+        # the random BN "running stats" don't actually normalize, so
+        # unscaled weights would compound ~6x per layer and push outputs to
+        # 1e7, where a fixed atol can't detect mapping errors.
+        sd[f"{prefix}.double_conv.{idx}.weight"] = torch.tensor(
+            g.normal(size=(co, ci, 3, 3), scale=1 / np.sqrt(9 * ci)).astype(
+                np.float32
+            )
+        )
+        sd[f"{prefix}.double_conv.{idx}.bias"] = torch.tensor(
+            g.normal(size=(co,), scale=0.1).astype(np.float32)
+        )
+        bn = f"{prefix}.double_conv.{idx + 1}"
+        sd[f"{bn}.weight"] = torch.tensor(
+            (1 + g.normal(size=(co,), scale=0.1)).astype(np.float32)
+        )
+        sd[f"{bn}.bias"] = torch.tensor(
+            g.normal(size=(co,), scale=0.1).astype(np.float32)
+        )
+        sd[f"{bn}.running_mean"] = torch.tensor(
+            g.normal(size=(co,), scale=0.1).astype(np.float32)
+        )
+        sd[f"{bn}.running_var"] = torch.tensor(
+            (1 + g.random(co)).astype(np.float32)
+        )
+        sd[f"{bn}.num_batches_tracked"] = torch.tensor(7)
+    return sd
+
+
+def _reference_unet_sd(out_classes: int = 1, seed: int = 0) -> dict:
+    g = np.random.default_rng(seed)
+    sd = {}
+    downs = [(3, 64), (64, 128), (128, 256), (256, 512)]
+    for n, (ci, co) in enumerate(downs, start=1):
+        sd.update(_double_conv_sd(f"down_conv{n}.double_conv", ci, co, g))
+    sd.update(_double_conv_sd("double_conv", 512, 1024, g))
+    # UpBlock(in, out): ConvTranspose2d(in-out, in-out, 2, stride 2) then
+    # DoubleConv(in, out) — model.py:33-43.
+    ups = [(4, 1536, 512), (3, 768, 256), (2, 384, 128), (1, 192, 64)]
+    for m, cin, cout in ups:
+        ch = cin - cout
+        sd[f"up_conv{m}.up_sample.weight"] = torch.tensor(
+            g.normal(size=(ch, ch, 2, 2), scale=1 / np.sqrt(4 * ch)).astype(
+                np.float32
+            )
+        )
+        sd[f"up_conv{m}.up_sample.bias"] = torch.tensor(
+            g.normal(size=(ch,), scale=0.1).astype(np.float32)
+        )
+        sd.update(_double_conv_sd(f"up_conv{m}.double_conv", cin, cout, g))
+    sd["conv_last.weight"] = torch.tensor(
+        g.normal(size=(out_classes, 64, 1, 1), scale=0.125).astype(np.float32)
+    )
+    sd["conv_last.bias"] = torch.tensor(
+        g.normal(size=(out_classes,), scale=0.1).astype(np.float32)
+    )
+    return sd
+
+
+def _oracle_double_conv(x, sd, prefix):
+    for idx in (0, 3):
+        x = F.conv2d(
+            x, sd[f"{prefix}.double_conv.{idx}.weight"],
+            sd[f"{prefix}.double_conv.{idx}.bias"], padding=1,
+        )
+        bn = f"{prefix}.double_conv.{idx + 1}"
+        x = F.batch_norm(
+            x, sd[f"{bn}.running_mean"], sd[f"{bn}.running_var"],
+            sd[f"{bn}.weight"], sd[f"{bn}.bias"], training=False, eps=1e-5,
+        )
+        x = F.relu(x)
+    return x
+
+
+def _oracle_forward(x, sd):
+    skips = []
+    for n in range(1, 5):
+        s = _oracle_double_conv(x, sd, f"down_conv{n}.double_conv")
+        skips.append(s)
+        x = F.max_pool2d(s, 2)
+    x = _oracle_double_conv(x, sd, "double_conv")
+    for m, skip in zip((4, 3, 2, 1), reversed(skips)):
+        x = F.conv_transpose2d(
+            x, sd[f"up_conv{m}.up_sample.weight"],
+            sd[f"up_conv{m}.up_sample.bias"], stride=2,
+        )
+        x = torch.cat([x, skip], dim=1)  # [upsampled, skip] — model.py:47
+        x = _oracle_double_conv(x, sd, f"up_conv{m}.double_conv")
+    return F.conv2d(x, sd["conv_last.weight"], sd["conv_last.bias"])
+
+
+class TestStripDDP:
+    def test_strips_uniform_prefix(self):
+        out = strip_ddp_prefix({"module.a.w": 1, "module.b.w": 2})
+        assert out == {"a.w": 1, "b.w": 2}
+
+    def test_bare_keys_pass_through(self):
+        assert strip_ddp_prefix({"a.w": 1}) == {"a.w": 1}
+
+    def test_mixed_keys_rejected(self):
+        with pytest.raises(ValueError, match="mixes"):
+            strip_ddp_prefix({"module.a": 1, "b": 2})
+
+
+class TestUNetImport:
+    @pytest.mark.slow
+    def test_forward_matches_torch_oracle(self):
+        sd = _reference_unet_sd()
+        variables = convert_reference_unet(sd)
+        model = UNet(out_classes=1, reference_topology=True)
+        # Shapes must agree exactly with a fresh init of the flagged model.
+        ref_shapes = jax.tree_util.tree_map(
+            jnp.shape,
+            model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3))),
+        )
+        got_shapes = jax.tree_util.tree_map(np.shape, variables)
+        assert got_shapes == ref_shapes
+
+        g = np.random.default_rng(1)
+        x = g.normal(size=(2, 3, 32, 32)).astype(np.float32)
+        want = _oracle_forward(torch.tensor(x), sd).numpy()
+        got = model.apply(
+            variables, jnp.asarray(x.transpose(0, 2, 3, 1)), train=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(got).transpose(0, 3, 1, 2), want, atol=2e-4
+        )
+
+    def test_ddp_prefixed_dict_accepted(self):
+        sd = {f"module.{k}": v for k, v in _reference_unet_sd().items()}
+        variables = convert_reference_unet(sd)
+        assert "down_0" in variables["params"]
+
+    def test_unknown_module_rejected(self):
+        sd = _reference_unet_sd()
+        sd["surprise.weight"] = torch.zeros(1)
+        with pytest.raises(ValueError, match="unrecognized"):
+            convert_reference_unet(sd)
+
+
+def _torchvision_resnet18_sd(num_classes: int = 10, seed: int = 0) -> dict:
+    """Synthesize a state_dict with torchvision resnet18's exact key set and
+    shapes (the canonical names the reference's build_model produces).
+    Fan-in-scaled weights keep activations O(1) so tolerances stay
+    meaningful through 20 conv layers."""
+    g = np.random.default_rng(seed)
+
+    def t(*shape):
+        fan_in = int(np.prod(shape[1:])) or 1
+        return torch.tensor(
+            g.normal(size=shape, scale=1 / np.sqrt(fan_in)).astype(np.float32)
+        )
+
+    sd = {"conv1.weight": t(64, 3, 7, 7)}
+
+    def bn(prefix, c):
+        sd[f"{prefix}.weight"] = t(c)
+        sd[f"{prefix}.bias"] = t(c)
+        sd[f"{prefix}.running_mean"] = t(c)
+        sd[f"{prefix}.running_var"] = torch.tensor(
+            (1 + g.random(c)).astype(np.float32)
+        )
+        sd[f"{prefix}.num_batches_tracked"] = torch.tensor(3)
+
+    bn("bn1", 64)
+    chans = [64, 128, 256, 512]
+    cin = 64
+    for stage, c in enumerate(chans, start=1):
+        for b in range(2):
+            p = f"layer{stage}.{b}"
+            sd[f"{p}.conv1.weight"] = t(c, cin if b == 0 else c, 3, 3)
+            bn(f"{p}.bn1", c)
+            sd[f"{p}.conv2.weight"] = t(c, c, 3, 3)
+            bn(f"{p}.bn2", c)
+            if b == 0 and cin != c:
+                sd[f"{p}.downsample.0.weight"] = t(c, cin, 1, 1)
+                bn(f"{p}.downsample.1", c)
+        cin = c
+    sd["fc.weight"] = t(num_classes, 512)
+    sd["fc.bias"] = t(num_classes)
+    return sd
+
+
+def _oracle_resnet18(x, sd, *, blocks=(2, 2, 2, 2)):
+    """Functional torch oracle of the canonical torchvision resnet18
+    forward (7×7/2 stem + maxpool, 4 stages of BasicBlocks with stride-2
+    stage entries and conv+BN downsample, avgpool, fc)."""
+
+    def bn(x, p):
+        return F.batch_norm(
+            x, sd[f"{p}.running_mean"], sd[f"{p}.running_var"],
+            sd[f"{p}.weight"], sd[f"{p}.bias"], training=False, eps=1e-5,
+        )
+
+    x = F.conv2d(x, sd["conv1.weight"], stride=2, padding=3)
+    x = F.relu(bn(x, "bn1"))
+    x = F.max_pool2d(x, 3, stride=2, padding=1)
+    for stage, n in enumerate(blocks, start=1):
+        for b in range(n):
+            p = f"layer{stage}.{b}"
+            stride = 2 if (stage > 1 and b == 0) else 1
+            identity = x
+            y = F.conv2d(x, sd[f"{p}.conv1.weight"], stride=stride, padding=1)
+            y = F.relu(bn(y, f"{p}.bn1"))
+            y = F.conv2d(y, sd[f"{p}.conv2.weight"], padding=1)
+            y = bn(y, f"{p}.bn2")
+            if f"{p}.downsample.0.weight" in sd:
+                identity = bn(
+                    F.conv2d(x, sd[f"{p}.downsample.0.weight"], stride=stride),
+                    f"{p}.downsample.1",
+                )
+            x = F.relu(y + identity)
+    x = x.mean(dim=(2, 3))
+    return F.linear(x, sd["fc.weight"], sd["fc.bias"])
+
+
+class TestResNetImport:
+    @pytest.mark.slow
+    def test_forward_matches_torch_oracle(self):
+        """Imported weights + torch_padding=True must reproduce torchvision
+        numerics exactly — this is what makes the importer preserve trained
+        accuracy rather than merely shapes (flax 'SAME' would shift every
+        strided conv's grid by one pixel)."""
+        from deeplearning_mpi_tpu.models.resnet import resnet18
+
+        sd = _torchvision_resnet18_sd()
+        variables = convert_torchvision_resnet(sd, "resnet18")
+        g = np.random.default_rng(5)
+        x = g.normal(size=(2, 3, 64, 64)).astype(np.float32)
+        want = _oracle_resnet18(torch.tensor(x), sd).numpy()
+        model = resnet18(num_classes=10, torch_padding=True)
+        got = model.apply(
+            {"params": variables["params"],
+             "batch_stats": variables["batch_stats"]},
+            jnp.asarray(x.transpose(0, 2, 3, 1)), train=False,
+        )
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_resnet18_tree_matches_our_init(self):
+        from deeplearning_mpi_tpu.models.resnet import resnet18
+
+        variables = convert_torchvision_resnet(
+            _torchvision_resnet18_sd(), "resnet18"
+        )
+        model = resnet18(num_classes=10)
+        ref = model.init(
+            jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False
+        )
+        ref_shapes = jax.tree_util.tree_map(
+            jnp.shape, {"params": ref["params"], "batch_stats": ref["batch_stats"]}
+        )
+        got_shapes = jax.tree_util.tree_map(np.shape, variables)
+        assert got_shapes == ref_shapes
+
+    def test_fc_transposed(self):
+        variables = convert_torchvision_resnet(
+            _torchvision_resnet18_sd(), "resnet18"
+        )
+        assert variables["params"]["Dense_0"]["kernel"].shape == (512, 10)
+
+    def test_unknown_arch_rejected(self):
+        with pytest.raises(ValueError, match="unknown arch"):
+            convert_torchvision_resnet({}, "resnet19")
+
+    def test_arch_mismatch_rejected(self):
+        # A deeper net's extra blocks (here a synthetic layer1.2, as in a
+        # resnet34 .pth imported as resnet18) must refuse, not silently
+        # drop trained weights.
+        sd = _torchvision_resnet18_sd()
+        sd["layer1.2.conv1.weight"] = torch.zeros(64, 64, 3, 3)
+        with pytest.raises(ValueError, match="wrong --arch"):
+            convert_torchvision_resnet(sd, "resnet18")
+
+
+class TestImportCLI:
+    """dmt-import-torch → a checkpoint the trainers actually restore."""
+
+    @pytest.mark.slow
+    def test_resnet_pth_to_eval_only(self, tmp_path):
+        from deeplearning_mpi_tpu.cli import import_torch, train_resnet
+
+        sd = {f"module.{k}": v for k, v in _torchvision_resnet18_sd().items()}
+        pth = tmp_path / "resnet_distributed.pth"
+        torch.save(sd, pth)
+        assert import_torch.main([
+            "--input", str(pth), "--arch", "resnet18",
+            "--model_dir", str(tmp_path / "ckpt"),
+        ]) == 0
+        # The imported checkpoint must restore and evaluate through the
+        # standard trainer (imagenet stem + torch_padding = the import
+        # contract).
+        assert train_resnet.main([
+            "--synthetic", "--batch_size", "8", "--train_samples", "16",
+            "--torch_padding", "--eval_only",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]) == 0
+
+    @pytest.mark.slow
+    def test_unet_pth_to_resume(self, tmp_path):
+        from deeplearning_mpi_tpu.cli import import_torch, train_unet
+
+        pth = tmp_path / "unet_distributed.pth"
+        torch.save(_reference_unet_sd(), pth)
+        assert import_torch.main([
+            "--input", str(pth), "--arch", "unet",
+            "--model_dir", str(tmp_path / "ckpt"),
+        ]) == 0
+        # Resume TRAINING from the imported weights (epoch 0 -> epoch 1):
+        # the reference-topology decoder must round-trip through the
+        # trainer's restore template, optimizer init, and a real step.
+        assert train_unet.main([
+            "--synthetic", "--batch_size", "8", "--train_samples", "16",
+            "--image_size", "32", "--num_epochs", "2", "--eval_every", "1",
+            "--reference_topology", "--resume",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]) == 0
+        logs = "\n".join(
+            p.read_text() for p in (tmp_path / "logs").iterdir()
+        )
+        assert "Epoch 1: loss" in logs
+
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        # A .pth trained at the reference's DEFAULT out_classes=2 imported
+        # without --out_classes 2: identical tree STRUCTURE, different head
+        # shapes — must die with the importer's diagnostic, not a later
+        # orbax restore error.
+        from deeplearning_mpi_tpu.cli import import_torch
+
+        pth = tmp_path / "unet2.pth"
+        torch.save(_reference_unet_sd(out_classes=2), pth)
+        with pytest.raises(SystemExit, match="shapes do not match"):
+            import_torch.main([
+                "--input", str(pth), "--arch", "unet",
+                "--model_dir", str(tmp_path / "ckpt"),
+            ])
+
+    def test_vit_rejects_torch_padding(self):
+        from deeplearning_mpi_tpu.cli import train_resnet
+
+        with pytest.raises(SystemExit, match="CNN numerics"):
+            train_resnet.main(["--arch", "vit_tiny", "--torch_padding"])
+
+
+def test_conv_transpose_orientation():
+    """Pin the spatial-flip question directly: flax ConvTranspose with the
+    converted kernel must reproduce torch's conv_transpose2d."""
+    import flax.linen as nn
+
+    from deeplearning_mpi_tpu.utils.torch_import import _conv_transpose_kernel
+
+    g = np.random.default_rng(2)
+    w = g.normal(size=(3, 5, 2, 2)).astype(np.float32)  # (in, out, kH, kW)
+    b = g.normal(size=(5,)).astype(np.float32)
+    x = g.normal(size=(1, 3, 4, 4)).astype(np.float32)
+    want = F.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2
+    ).numpy()
+
+    mod = nn.ConvTranspose(5, (2, 2), strides=(2, 2))
+    variables = {
+        "params": {
+            "kernel": jnp.asarray(_conv_transpose_kernel(torch.tensor(w))),
+            "bias": jnp.asarray(b),
+        }
+    }
+    got = mod.apply(variables, jnp.asarray(x.transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(
+        np.asarray(got).transpose(0, 3, 1, 2), want, atol=1e-5
+    )
