@@ -173,11 +173,12 @@ def bench_unet(image_size: int = 512, batch_size: int = 8, steps: int = 10) -> d
 
 
 def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10,
-             remat: bool = False) -> dict:
+             remat: bool = False, loss_chunk: int = 0) -> dict:
     """TransformerLM train-step throughput with the compiled Pallas flash
     kernel: tokens/s/chip + MFU. Default config = the 110M-param
     TransformerConfig (768d x 12L) at 2k sequence, bf16. ``remat=True`` is
-    the long-context memory recipe (32k on one chip)."""
+    the long-context memory recipe; ``loss_chunk`` adds the chunked
+    head+loss (wall 3) needed at 64k."""
     import jax
     import jax.numpy as jnp
 
@@ -193,12 +194,14 @@ def bench_lm(seq_len: int = 2048, batch_size: int = 8, steps: int = 10,
     model = TransformerLM(
         config=config, dtype=jnp.bfloat16, attention_fn=flash_attention_bhsd,
         remat=remat,
+        # chunked head+loss consumes (prehead_x, head_kernel), not logits
+        return_prehead=loss_chunk > 0,
     )
     tx = build_optimizer("adam", 3e-4, clip_norm=1.0)
     state = create_train_state(
         model, jax.random.key(0), jnp.zeros((1, seq_len), jnp.int32), tx
     )
-    step = make_train_step("lm")
+    step = make_train_step("lm", loss_chunk=loss_chunk)
     tokens = jax.random.randint(
         jax.random.key(1), (batch_size, seq_len), 0, config.vocab_size
     )
@@ -427,8 +430,9 @@ def main() -> None:
     parser.add_argument("--skip_unet", action="store_true")
     parser.add_argument("--skip_decode", action="store_true")
     parser.add_argument("--long_context", action="store_true",
-                        help="add the 32k-seq flash+remat LM entry (slow "
-                        "compile; see the comment at its call site)")
+                        help="add the 32k flash+remat AND 64k "
+                        "flash+remat+chunked-loss LM entries (each a "
+                        "multi-minute compile; see their call sites)")
     parser.add_argument("--workload_timeout", type=float, default=600.0,
                         help="per-workload wall-clock budget (s); on overrun "
                         "the final combined line is emitted with the results "
@@ -512,6 +516,16 @@ def main() -> None:
             # Opt-in AND known-slow: the 32k compile alone takes many
             # minutes, so the default per-workload budget would kill a
             # healthy run as a "wedge".
+            budget_s=max(args.workload_timeout, 2400.0),
+        )
+        # 64k: all three walls at once (flash + remat + chunked head+loss).
+        # Measured 2026-07-31: 8.6k tok/s, 7.59 s/step (32k vocab; the
+        # byte-vocab CLI variant of the same shape runs 11.0k).
+        run(
+            "transformer_lm_64k_flash_remat_chunked", bench_lm,
+            metric="transformer_lm_110m_64k_flash_remat_chunk_tokens_per_sec_per_chip",
+            unit="tokens/s/chip", value_key="tokens_per_s_per_chip",
+            seq_len=65536, batch_size=1, steps=3, remat=True, loss_chunk=2048,
             budget_s=max(args.workload_timeout, 2400.0),
         )
 
