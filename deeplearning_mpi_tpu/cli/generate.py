@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                      "composes with --top_k)")
     gen.add_argument("--greedy", action="store_true",
                      help="argmax decoding (temperature ignored)")
+    gen.add_argument("--num_beams", type=int, default=1,
+                     help="N>1 = beam search over N beams (deterministic; "
+                     "sampling flags ignored). Cost: the forward runs at "
+                     "batch*N and each step gathers the beam cache. No "
+                     "length penalty: byte LM, no EOS — all beams are the "
+                     "same length, a normalizer could not change the rank")
     gen.add_argument("--random_seed", type=int, default=0)
     gen.add_argument("--quantize", default="none", choices=("none", "int8"),
                      help="int8 = weight-only quantized decode: the block "
@@ -210,21 +216,42 @@ def main(argv: list[str] | None = None) -> int:
         np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
     )[None, :]
 
-    fn = generate_jit(
-        model,
-        max_new_tokens=args.max_new_tokens,
-        temperature=0.0 if args.greedy else args.temperature,
-        top_k=0 if args.greedy else args.top_k,
-        top_p=1.0 if args.greedy else args.top_p,
-    )
-    rng = jax.random.key(args.random_seed)
-    out = fn(params, prompt, rng)
+    if args.num_beams > 1:
+        from deeplearning_mpi_tpu.models.generate import beam_search_jit
+
+        beam_fn = beam_search_jit(
+            model,
+            max_new_tokens=args.max_new_tokens,
+            num_beams=args.num_beams,
+        )
+
+        def call():
+            return beam_fn(params, prompt)
+    else:
+        fn = generate_jit(
+            model,
+            max_new_tokens=args.max_new_tokens,
+            temperature=0.0 if args.greedy else args.temperature,
+            top_k=0 if args.greedy else args.top_k,
+            top_p=1.0 if args.greedy else args.top_p,
+        )
+        rng = jax.random.key(args.random_seed)
+
+        def call():
+            return fn(params, prompt, rng)
+
+    out = call()
     if args.time:
         import time
 
-        jax.block_until_ready(out)  # first call compiled; now time the cache hit
+        from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+        # host_sync, not block_until_ready: the latter can return before
+        # remote execution finishes on the tunneled TPU (host_sync docs).
+        host_sync(out.ravel()[:1])  # first call compiled; time the cache hit
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(params, prompt, rng))
+        out = call()
+        host_sync(out.ravel()[:1])
         dt = time.perf_counter() - t0
         # The scan decodes EVERY position (prompt prefill + new tokens) at
         # identical per-step cost, so throughput is per position — dividing

@@ -126,3 +126,149 @@ def generate_jit(model: TransformerLM, **static_kwargs: Any):
         return generate(model, params, prompt, rng=rng, **static_kwargs)
 
     return jax.jit(fn)
+
+
+def beam_search(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    num_beams: int,
+) -> jax.Array:
+    """Beam-search decode: ``[B, P]`` prompt → ``[B, P + max_new]`` best beam.
+
+    Same ONE-``lax.scan`` shape as :func:`generate` — prefill and decode
+    share the per-position body, every shape static — with the beam dim
+    folded into the batch (cache and forward run at ``B*W``). Beam updates
+    are branch-free:
+
+    - while filling the prompt (``i < P``) every beam is force-fed the same
+      prompt token and scores stay 0;
+    - at the first generated position a ``[W]`` bias of ``[0, -inf, ...]``
+      restricts the top-k over ``W*V`` candidates to beam 0's logits, which
+      is exactly "seed W distinct beams from the first step's top-W tokens"
+      without a branch;
+    - afterwards the standard update: cumulative log-probs over all ``W*V``
+      continuations, top-W survivors, and a gather of each survivor's
+      parent cache (the textbook per-step ``O(W·cache)`` reindex — XLA
+      lowers it to a batched dynamic-gather).
+
+    No length penalty: every beam has exactly ``max_new_tokens`` new
+    tokens (the byte LM has no EOS), so any positive length normalizer is
+    a constant across beams and cannot change the ranking — offering the
+    knob would be a lie. It belongs with EOS support, if that ever lands.
+
+    Deterministic — no rng. Returns the highest-scoring beam per batch row.
+    """
+    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    W = num_beams
+    NEG = jnp.float32(-1e30)
+
+    # Beam-flattened cache: [B*W, total, ...] buffers.
+    cache = decode_model.init(
+        jax.random.key(0), jnp.zeros((batch * W, total), jnp.int32)
+    )["cache"]
+    # prompt broadcast over beams, flattened to [B*W, P]
+    flat_prompt = jnp.repeat(prompt, W, axis=0)
+
+    identity = jnp.broadcast_to(jnp.arange(W), (batch, W))
+
+    def body(carry, i):
+        cache, prev_tok, scores = carry
+        # prev_tok [B, W] int32; scores [B, W] f32
+        prompt_tok = lax.dynamic_index_in_dim(
+            flat_prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
+        ).reshape(batch, W)
+        tok = jnp.where(i < prompt_len, prompt_tok, prev_tok)
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(batch * W, 1),
+            positions=jnp.full((batch * W, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        logprobs = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32), axis=-1
+        ).reshape(batch, W, -1)
+        vocab = logprobs.shape[-1]
+
+        # Step i's selection chooses the token FED at position i+1, so the
+        # beam update is live from the last prompt position (i = P-1, the
+        # seed step) through total-2; the final step's would-be selection
+        # lies outside the returned window and must not touch scores.
+        seed = i == prompt_len - 1
+        update = (i >= prompt_len - 1) & (i < total - 1)
+        # Seed bias: at the seed step only beam 0 competes, so the top-k
+        # over W*V yields the top-W tokens of one distribution — W distinct
+        # starting beams, no branch.
+        beam_bias = jnp.where(seed & (jnp.arange(W) > 0), NEG, 0.0)  # [W]
+        cand = scores[:, :, None] + logprobs + beam_bias[None, :, None]
+        top_scores, top_idx = lax.top_k(cand.reshape(batch, W * vocab), W)
+        parent = top_idx // vocab  # [B, W]
+        next_tok = (top_idx % vocab).astype(jnp.int32)
+
+        new_scores = jnp.where(update, top_scores, scores)
+        new_tok = jnp.where(update, next_tok, tok)
+        new_parent = jnp.where(update, parent, identity)
+
+        # Reindex beam-major cache by parent (flat index b*W + parent) —
+        # only when a real update happened; prefill parents are identity
+        # and the O(W·cache) copy every prompt position would double
+        # prefill HBM traffic for nothing.
+        flat_parent = (
+            jnp.arange(batch)[:, None] * W + new_parent
+        ).reshape(-1)
+
+        def gather_tree(c):
+            return jax.tree.map(
+                lambda x: jnp.take(x, flat_parent, axis=0)
+                if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch * W
+                else x,  # cache_index scalars — same for every beam
+                c,
+            )
+
+        new_cache = lax.cond(update, gather_tree, lambda c: c, mutated["cache"])
+        return (new_cache, new_tok, new_scores), (tok, new_parent)
+
+    init = (
+        cache,
+        jnp.zeros((batch, W), jnp.int32),
+        jnp.zeros((batch, W), jnp.float32),
+    )
+    (_, _, scores), (consumed, parents) = lax.scan(
+        body, init, jnp.arange(total)
+    )
+    # consumed[i] is the [B, W] token fed at position i in the beam
+    # numbering ENTERING step i (frame N_i); parents[i] maps frame N_{i+1}
+    # back to N_i. The final scores/numbering live in frame N_total. Beam w
+    # at the end is NOT beam w throughout — survivors reorder every step —
+    # so each final beam's token sequence is recovered by walking its
+    # ancestry backward: map the index into the earlier frame FIRST, then
+    # read that frame's token.
+    def backtrace(beam, step):
+        tok_i, parent_i = step
+        prev_beam = jnp.take_along_axis(parent_i, beam, axis=1)  # -> N_i
+        tok = jnp.take_along_axis(tok_i, prev_beam, axis=1)
+        return prev_beam, tok
+
+    final_beam = identity
+    _, toks_rev = lax.scan(
+        backtrace, final_beam, (consumed[::-1], parents[::-1])
+    )
+    beams = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, W, total]
+
+    best = jnp.argmax(scores, axis=1)  # [B]
+    return jnp.take_along_axis(
+        beams, best[:, None, None], axis=1
+    )[:, 0]  # [B, total]
+
+
+def beam_search_jit(model: TransformerLM, **static_kwargs: Any):
+    """Jitted beam search: ``fn(params, prompt) -> [B, P + max_new]``."""
+
+    def fn(params, prompt):
+        return beam_search(model, params, prompt, **static_kwargs)
+
+    return jax.jit(fn)
