@@ -65,9 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--num_beams", type=int, default=1,
                      help="N>1 = beam search over N beams (deterministic; "
                      "sampling flags ignored). Cost: the forward runs at "
-                     "batch*N and each step gathers the beam cache. No "
-                     "length penalty: byte LM, no EOS — all beams are the "
-                     "same length, a normalizer could not change the rank")
+                     "batch*N and each step gathers the beam cache")
+    gen.add_argument("--eos_id", type=int, default=-1,
+                     help="byte value that terminates generation (e.g. 10 "
+                     "= newline for line-structured text); -1 = off. Rows/"
+                     "beams that emit it are EOS-padded to the full length")
+    gen.add_argument("--length_penalty", type=float, default=0.0,
+                     help="beam ranking: score / len^alpha, len = generated "
+                     "tokens through the first EOS. Needs --eos_id (without "
+                     "EOS all beams are the same length and a normalizer "
+                     "cannot change the ranking — rejected, not ignored)")
     gen.add_argument("--random_seed", type=int, default=0)
     gen.add_argument("--quantize", default="none", choices=("none", "int8"),
                      help="int8 = weight-only quantized decode: the block "
@@ -101,6 +108,27 @@ def main(argv: list[str] | None = None) -> int:
             "(not --tp or --moe_experts yet)",
             file=sys.stderr,
         )
+        return 1
+    # Pure-argv checks belong HERE, before the minutes-long init + restore
+    # (same fail-fast rule as above).
+    eos_id = args.eos_id if args.eos_id >= 0 else None
+    if eos_id is not None and eos_id > 255:
+        print(
+            f"--eos_id {eos_id} is outside the byte vocab (0-255) — it "
+            "could never be emitted, silently disabling stopping",
+            file=sys.stderr,
+        )
+        return 1
+    if args.length_penalty != 0.0 and eos_id is None:
+        print(
+            "--length_penalty requires --eos_id: without EOS every beam "
+            "has the same length and the penalty cannot change the ranking",
+            file=sys.stderr,
+        )
+        return 1
+    if args.length_penalty != 0.0 and args.num_beams <= 1:
+        print("--length_penalty only applies to --num_beams > 1",
+              file=sys.stderr)
         return 1
 
     from deeplearning_mpi_tpu.runtime import bootstrap
@@ -223,6 +251,8 @@ def main(argv: list[str] | None = None) -> int:
             model,
             max_new_tokens=args.max_new_tokens,
             num_beams=args.num_beams,
+            eos_id=eos_id,
+            length_penalty=args.length_penalty,
         )
 
         def call():
@@ -234,6 +264,7 @@ def main(argv: list[str] | None = None) -> int:
             temperature=0.0 if args.greedy else args.temperature,
             top_k=0 if args.greedy else args.top_k,
             top_p=1.0 if args.greedy else args.top_p,
+            eos_id=eos_id,
         )
         rng = jax.random.key(args.random_seed)
 

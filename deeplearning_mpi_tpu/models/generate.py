@@ -72,12 +72,18 @@ def generate(
     temperature: float = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
 
     Returns ``[B, P + max_new_tokens]`` (prompt included). The decode-mode
     twin of ``model`` shares its params; the cache sized ``P + max_new`` is
     created by a decode-mode ``init`` and threaded through the scan.
+
+    ``eos_id``: once a row SAMPLES that token, every later position in the
+    row is forced to ``eos_id`` (the scan's shapes are static, so "stop"
+    means "pad with EOS from there on"). Prompt occurrences don't count —
+    only generated positions finish a row.
     """
     decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
     batch, prompt_len = prompt.shape
@@ -90,7 +96,7 @@ def generate(
     )["cache"]
 
     def body(carry, i):
-        cache, prev_tok, rng = carry
+        cache, prev_tok, rng, done = carry
         # Prefill phase feeds the prompt; afterwards, the previous sample.
         prompt_tok = lax.dynamic_index_in_dim(
             prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
@@ -107,10 +113,18 @@ def generate(
             logits[:, 0], sub, temperature=temperature, top_k=top_k,
             top_p=top_p,
         )
-        return (mutated["cache"], next_tok, rng), tok
+        if eos_id is not None:
+            # Selections happen at i >= P-1 (choosing position i+1's token).
+            sampled_eos = (next_tok == eos_id) & (i >= prompt_len - 1)
+            next_tok = jnp.where(done, eos_id, next_tok)
+            done = done | sampled_eos
+        return (mutated["cache"], next_tok, rng, done), tok
 
-    init = (cache, jnp.zeros((batch,), jnp.int32), rng)
-    (_, _, _), consumed = lax.scan(body, init, jnp.arange(total))
+    init = (
+        cache, jnp.zeros((batch,), jnp.int32), rng,
+        jnp.zeros((batch,), bool),
+    )
+    (_, _, _, _), consumed = lax.scan(body, init, jnp.arange(total))
     # consumed[i] is the token fed at position i: prompt tokens for i < P,
     # and for i >= P the sample produced at step i-1 — i.e. exactly the
     # generated continuation. (The final step's sample would be the token
@@ -135,6 +149,8 @@ def beam_search(
     *,
     max_new_tokens: int,
     num_beams: int,
+    eos_id: int | None = None,
+    length_penalty: float = 0.0,
 ) -> jax.Array:
     """Beam-search decode: ``[B, P]`` prompt → ``[B, P + max_new]`` best beam.
 
@@ -154,13 +170,22 @@ def beam_search(
       parent cache (the textbook per-step ``O(W·cache)`` reindex — XLA
       lowers it to a batched dynamic-gather).
 
-    No length penalty: every beam has exactly ``max_new_tokens`` new
-    tokens (the byte LM has no EOS), so any positive length normalizer is
-    a constant across beams and cannot change the ranking — offering the
-    knob would be a lie. It belongs with EOS support, if that ever lands.
+    ``eos_id``: a beam that emits it is *finished* — its only continuation
+    is EOS at zero added log-prob (so its score freezes while it stays in
+    the candidate pool), and its output is EOS-padded to the static length.
+    ``length_penalty`` α then ranks final beams by ``score / len**α`` where
+    ``len`` counts generated tokens through the first EOS inclusive — with
+    variable-length beams a normalizer is meaningful. Without ``eos_id``
+    every beam has identical length, a normalizer cannot change the
+    ranking, and a nonzero α is rejected rather than silently ignored.
 
     Deterministic — no rng. Returns the highest-scoring beam per batch row.
     """
+    if eos_id is None and length_penalty != 0.0:
+        raise ValueError(
+            "length_penalty requires eos_id: without EOS every beam has "
+            "the same length and the penalty cannot change the ranking"
+        )
     decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
@@ -177,7 +202,7 @@ def beam_search(
     identity = jnp.broadcast_to(jnp.arange(W), (batch, W))
 
     def body(carry, i):
-        cache, prev_tok, scores = carry
+        cache, prev_tok, scores, finished, lengths = carry
         # prev_tok [B, W] int32; scores [B, W] f32
         prompt_tok = lax.dynamic_index_in_dim(
             flat_prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
@@ -193,6 +218,10 @@ def beam_search(
             logits[:, 0].astype(jnp.float32), axis=-1
         ).reshape(batch, W, -1)
         vocab = logprobs.shape[-1]
+        if eos_id is not None:
+            # A finished beam's single viable continuation: EOS, free.
+            eos_row = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
+            logprobs = jnp.where(finished[..., None], eos_row, logprobs)
 
         # Step i's selection chooses the token FED at position i+1, so the
         # beam update is live from the last prompt position (i = P-1, the
@@ -212,6 +241,18 @@ def beam_search(
         new_scores = jnp.where(update, top_scores, scores)
         new_tok = jnp.where(update, next_tok, tok)
         new_parent = jnp.where(update, parent, identity)
+        if eos_id is not None:
+            parent_fin = jnp.take_along_axis(finished, new_parent, axis=1)
+            parent_len = jnp.take_along_axis(lengths, new_parent, axis=1)
+            new_finished = jnp.where(
+                update, parent_fin | (next_tok == eos_id), finished
+            )
+            # Generated-token count through the first EOS inclusive: a live
+            # parent's extension counts (even when it IS the EOS), a
+            # finished parent's forced EOS padding doesn't.
+            new_lengths = jnp.where(update, parent_len + ~parent_fin, lengths)
+        else:
+            new_finished, new_lengths = finished, lengths
 
         # Reindex beam-major cache by parent (flat index b*W + parent) —
         # only when a real update happened; prefill parents are identity
@@ -230,14 +271,19 @@ def beam_search(
             )
 
         new_cache = lax.cond(update, gather_tree, lambda c: c, mutated["cache"])
-        return (new_cache, new_tok, new_scores), (tok, new_parent)
+        return (
+            (new_cache, new_tok, new_scores, new_finished, new_lengths),
+            (tok, new_parent),
+        )
 
     init = (
         cache,
         jnp.zeros((batch, W), jnp.int32),
         jnp.zeros((batch, W), jnp.float32),
+        jnp.zeros((batch, W), bool),
+        jnp.zeros((batch, W), jnp.int32),
     )
-    (_, _, scores), (consumed, parents) = lax.scan(
+    (_, _, scores, _, lengths), (consumed, parents) = lax.scan(
         body, init, jnp.arange(total)
     )
     # consumed[i] is the [B, W] token fed at position i in the beam
@@ -259,7 +305,12 @@ def beam_search(
     )
     beams = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, W, total]
 
-    best = jnp.argmax(scores, axis=1)  # [B]
+    ranks = scores
+    if eos_id is not None and length_penalty != 0.0:
+        ranks = scores / jnp.maximum(lengths, 1).astype(
+            jnp.float32
+        ) ** jnp.float32(length_penalty)
+    best = jnp.argmax(ranks, axis=1)  # [B]
     return jnp.take_along_axis(
         beams, best[:, None, None], axis=1
     )[:, 0]  # [B, total]

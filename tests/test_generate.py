@@ -260,3 +260,110 @@ class TestBeamSearch:
                 model, params, prompts[b : b + 1], max_new_tokens=4, num_beams=3
             )
             np.testing.assert_array_equal(np.asarray(both)[b], np.asarray(solo)[0])
+
+
+class TestEOS:
+    """eos_id: stop-and-pad semantics for sampling and beam search."""
+
+    def _tiny(self, vocab=6, seed=1):
+        cfg = dataclasses.replace(TransformerConfig.tiny(), vocab_size=vocab)
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return model, params
+
+    def test_greedy_pads_after_first_eos(self):
+        model, params = self._tiny(vocab=16)
+        prompt = jnp.asarray([[7, 7, 2]], jnp.int32)
+        free = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+        )
+        first = int(np.asarray(free)[0, 3])  # first generated token
+        out = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0, eos_id=first,
+        )
+        # The row finishes at its first generated position; everything
+        # after is EOS padding. Prompt occurrences of the byte don't count.
+        np.testing.assert_array_equal(
+            np.asarray(out)[0, 3:], np.full(6, first)
+        )
+        np.testing.assert_array_equal(np.asarray(out)[0, :3], [7, 7, 2])
+
+    @pytest.mark.slow
+    def test_exhaustive_beam_with_eos_matches_bruteforce(self):
+        """Canonical sequences (everything after the first EOS is EOS) are
+        scored by their pre-EOS log-prob; with an exhaustive beam width the
+        search must return the best canonical sequence — pins the
+        finished-beam freeze (EOS extension at zero cost) and padding."""
+        import itertools
+
+        from deeplearning_mpi_tpu.models.generate import beam_search
+
+        vocab, new, eos = 6, 3, 2
+        model, params = self._tiny(vocab)
+        prompt = jnp.asarray([[4, 1, 3]], jnp.int32)
+        p_len = prompt.shape[1]
+        conts = np.array(
+            list(itertools.product(range(vocab), repeat=new)), np.int32
+        )
+        full = np.concatenate(
+            [np.repeat(np.asarray(prompt), len(conts), 0), conts], axis=1
+        )
+        logp = np.asarray(jax.nn.log_softmax(
+            model.apply({"params": params}, jnp.asarray(full)).astype(
+                jnp.float32
+            ), -1,
+        ))
+
+        def canonical_score(row, cont):
+            # sum through the first EOS inclusive; None if not canonical
+            s, done = 0.0, False
+            for j, t in enumerate(cont):
+                if done:
+                    if t != eos:
+                        return None
+                    continue  # forced padding, free
+                s += logp[row, p_len - 1 + j, t]
+                done = t == eos
+            return s
+
+        scored = [
+            (canonical_score(r, c), c) for r, c in enumerate(conts)
+        ]
+        best_score, best = max(
+            ((s, c) for s, c in scored if s is not None), key=lambda x: x[0]
+        )
+        out = beam_search(
+            model, params, prompt, max_new_tokens=new, num_beams=vocab**2,
+            eos_id=eos,
+        )
+        got = np.asarray(out)[0, p_len:]
+        got_score = canonical_score(
+            int(np.argwhere((conts == got).all(1))[0, 0]), got
+        )
+        # Ties between canonical sequences are possible in principle;
+        # compare SCORES, not token identity.
+        np.testing.assert_allclose(got_score, best_score, atol=1e-5)
+
+    def test_length_penalty_requires_eos(self):
+        from deeplearning_mpi_tpu.models.generate import beam_search
+
+        model, params = self._tiny()
+        with pytest.raises(ValueError, match="length_penalty requires"):
+            beam_search(
+                model, params, jnp.zeros((1, 2), jnp.int32),
+                max_new_tokens=2, num_beams=2, length_penalty=0.6,
+            )
+
+    def test_length_penalty_runs_with_eos(self):
+        from deeplearning_mpi_tpu.models.generate import beam_search
+
+        model, params = self._tiny()
+        out = beam_search(
+            model, params, jnp.zeros((1, 2), jnp.int32),
+            max_new_tokens=3, num_beams=3, eos_id=2, length_penalty=0.6,
+        )
+        assert out.shape == (1, 5)
