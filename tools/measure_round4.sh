@@ -1,6 +1,8 @@
 #!/usr/bin/env bash
 # Round-4 on-chip measurement runbook, executable form (BASELINE.md
-# "Round-4 measurement debt"). Run on a machine whose TPU tunnel is ALIVE.
+# "Round-4 measurement status"). Run on a machine whose TPU tunnel is
+# ALIVE. As of 2026-07-31 every step HAS been measured (results in
+# BASELINE.md); re-running refreshes the numbers.
 #
 # Bounding strategy: a 120 s probe gates entry AND re-runs between steps
 # (cheap, kills nothing mid-compile), and each step carries a GENEROUS
