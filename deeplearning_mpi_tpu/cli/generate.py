@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--prompt", default="",
                      help="UTF-8 prompt text (byte tokens); empty = BOS-free "
                      "unconditional generation from byte 0")
+    gen.add_argument("--prompts_file", default=None,
+                     help="file with ONE prompt per line: the whole batch "
+                     "decodes in a single jitted program (prompts "
+                     "right-padded to the longest; each row switches from "
+                     "prompt to samples at its own length). Sampling only "
+                     "(--num_beams is single-prompt); one output line per "
+                     "prompt")
     gen.add_argument("--max_new_tokens", type=int, default=128)
     gen.add_argument("--temperature", type=float, default=1.0)
     gen.add_argument("--top_k", type=int, default=0,
@@ -130,6 +137,40 @@ def main(argv: list[str] | None = None) -> int:
         print("--length_penalty only applies to --num_beams > 1",
               file=sys.stderr)
         return 1
+    if args.prompts_file and args.prompt:
+        print("--prompt and --prompts_file are mutually exclusive",
+              file=sys.stderr)
+        return 1
+    if args.prompts_file and args.num_beams > 1:
+        print("--prompts_file batches the sampling path; --num_beams is "
+              "single-prompt", file=sys.stderr)
+        return 1
+    from pathlib import Path  # stdlib — no deferred-import rationale applies
+
+    prompt_texts = None
+    if args.prompts_file:
+        try:
+            raw = Path(args.prompts_file).read_text(encoding="utf-8")
+        except OSError as e:
+            print(f"cannot read --prompts_file: {e}", file=sys.stderr)
+            return 1
+        lines = raw.splitlines()
+        # Reject blank interior lines instead of dropping them: output is
+        # documented as one line per input line, and silently skipping a
+        # blank would misalign every following completion with its prompt.
+        blank = [n for n, ln in enumerate(lines, 1) if not ln.strip()]
+        if blank:
+            print(
+                f"{args.prompts_file}: blank prompt line(s) {blank[:5]} — "
+                "every line must be a prompt (one output line per input "
+                "line)",
+                file=sys.stderr,
+            )
+            return 1
+        if not lines:
+            print(f"{args.prompts_file} has no prompts", file=sys.stderr)
+            return 1
+        prompt_texts = lines
 
     from deeplearning_mpi_tpu.runtime import bootstrap
 
@@ -151,8 +192,6 @@ def main(argv: list[str] | None = None) -> int:
 
     # Fail BEFORE the (potentially minutes-long) model/optimizer init, and
     # without Checkpointer's create=True side-effect mkdir on a typo'd path.
-    from pathlib import Path
-
     ckpt_dir = Path(args.model_dir) / args.model_filename
     if not ckpt_dir.is_dir():
         print(f"no checkpoint found under {ckpt_dir}", file=sys.stderr)
@@ -239,10 +278,25 @@ def main(argv: list[str] | None = None) -> int:
         params = quantize_lm_params(params)
         model = dataclasses.replace(model, quantized=True)
 
-    prompt_bytes = args.prompt.encode("utf-8") or b"\x00"
-    prompt = jnp.asarray(
-        np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
-    )[None, :]
+    if prompt_texts is not None:
+        rows = [
+            np.frombuffer(t.encode("utf-8") or b"\x00", np.uint8).astype(
+                np.int32
+            )
+            for t in prompt_texts
+        ]
+        lens = np.array([len(r) for r in rows], np.int32)
+        padded = np.zeros((len(rows), int(lens.max())), np.int32)
+        for b, r in enumerate(rows):
+            padded[b, : len(r)] = r
+        prompt = jnp.asarray(padded)
+        prompt_lens = jnp.asarray(lens)
+    else:
+        prompt_bytes = args.prompt.encode("utf-8") or b"\x00"
+        prompt = jnp.asarray(
+            np.frombuffer(prompt_bytes, np.uint8).astype(np.int32)
+        )[None, :]
+        prompt_lens = None
 
     if args.num_beams > 1:
         from deeplearning_mpi_tpu.models.generate import beam_search_jit
@@ -269,7 +323,7 @@ def main(argv: list[str] | None = None) -> int:
         rng = jax.random.key(args.random_seed)
 
         def call():
-            return fn(params, prompt, rng)
+            return fn(params, prompt, rng, prompt_lens)
 
     out = call()
     if args.time:
@@ -287,15 +341,27 @@ def main(argv: list[str] | None = None) -> int:
         # The scan decodes EVERY position (prompt prefill + new tokens) at
         # identical per-step cost, so throughput is per position — dividing
         # by max_new_tokens alone would understate it for long prompts.
-        positions = prompt.shape[1] + args.max_new_tokens
+        # Batch mode decodes all rows in one program: count them all.
+        positions = out.shape[0] * (prompt.shape[1] + args.max_new_tokens)
         print(
             f"decode: {positions} positions ({args.max_new_tokens} new) in "
             f"{dt:.3f}s = {positions / dt:.1f} positions/s",
             file=sys.stderr,
         )
-    tokens = np.asarray(out[0], np.uint8)
-    text = tokens.tobytes().decode("utf-8", errors="replace")
-    print(text)
+    if prompt_texts is not None:
+        # One line per prompt. Short rows keep generating to the end of the
+        # static window; slice each at its own len + max_new so every
+        # prompt gets exactly max_new_tokens of continuation.
+        lens_np = np.asarray(prompt_lens)
+        for b in range(out.shape[0]):
+            row = np.asarray(
+                out[b, : int(lens_np[b]) + args.max_new_tokens], np.uint8
+            )
+            print(row.tobytes().decode("utf-8", errors="replace"))
+    else:
+        tokens = np.asarray(out[0], np.uint8)
+        text = tokens.tobytes().decode("utf-8", errors="replace")
+        print(text)
     return 0
 
 

@@ -73,6 +73,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     eos_id: int | None = None,
+    prompt_lens: jax.Array | None = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
 
@@ -84,10 +85,22 @@ def generate(
     row is forced to ``eos_id`` (the scan's shapes are static, so "stop"
     means "pad with EOS from there on"). Prompt occurrences don't count —
     only generated positions finish a row.
+
+    ``prompt_lens`` (``[B]`` int32) batches prompts of different lengths:
+    ``prompt`` is right-padded to the longest, and each row switches from
+    prompt-feeding to its own samples at its OWN length (the pad bytes are
+    never fed — the switch happens per row inside the scan). A short row
+    therefore keeps generating to the end of the static window: slice its
+    output at ``prompt_lens[b] + max_new_tokens`` if you want exactly
+    ``max_new_tokens`` from every row.
     """
     decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
+    plens = (
+        jnp.full((batch,), prompt_len, jnp.int32)
+        if prompt_lens is None else prompt_lens.astype(jnp.int32)
+    )
 
     # Decode-mode init with the full-length input shapes the cache buffers;
     # params from init are discarded (we use the trained ones).
@@ -101,7 +114,7 @@ def generate(
         prompt_tok = lax.dynamic_index_in_dim(
             prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
         )
-        tok = jnp.where(i < prompt_len, prompt_tok, prev_tok)
+        tok = jnp.where(i < plens, prompt_tok, prev_tok)  # per-row switch
         logits, mutated = decode_model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
@@ -114,8 +127,9 @@ def generate(
             top_p=top_p,
         )
         if eos_id is not None:
-            # Selections happen at i >= P-1 (choosing position i+1's token).
-            sampled_eos = (next_tok == eos_id) & (i >= prompt_len - 1)
+            # Row b's selections happen at i >= plens[b]-1 (choosing
+            # position i+1's token).
+            sampled_eos = (next_tok == eos_id) & (i >= plens - 1)
             next_tok = jnp.where(done, eos_id, next_tok)
             done = done | sampled_eos
         return (mutated["cache"], next_tok, rng, done), tok
@@ -134,10 +148,13 @@ def generate(
 
 def generate_jit(model: TransformerLM, **static_kwargs: Any):
     """Jitted generate with static sampling knobs:
-    ``fn(params, prompt, rng) -> [B, P + max_new]``."""
+    ``fn(params, prompt, rng, prompt_lens=None) -> [B, P + max_new]``."""
 
-    def fn(params, prompt, rng):
-        return generate(model, params, prompt, rng=rng, **static_kwargs)
+    def fn(params, prompt, rng, prompt_lens=None):
+        return generate(
+            model, params, prompt, rng=rng, prompt_lens=prompt_lens,
+            **static_kwargs,
+        )
 
     return jax.jit(fn)
 
