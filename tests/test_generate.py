@@ -423,3 +423,47 @@ class TestRaggedBatch:
         )
         np.testing.assert_array_equal(np.asarray(out)[0, :2], [8, 1])
         assert not np.array_equal(np.asarray(out)[0, 2:5], [31, 31, 31])
+
+    def test_ragged_batch_composes_with_eos(self):
+        # Per-row EOS selection windows (i >= plens[b]-1) with per-row
+        # prompt switches: each ragged row must equal its solo run under
+        # the same eos_id, including the post-EOS padding.
+        cfg = dataclasses.replace(TransformerConfig.tiny(), vocab_size=32)
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        new = 5
+        p_a = jnp.asarray([[5, 9, 11, 2, 7]], jnp.int32)
+        p_b = jnp.asarray([[8, 1]], jnp.int32)
+        # Pick row b's first greedy token as the EOS: row b must pad from
+        # its first generated position; row a stops only if it emits the
+        # same byte.
+        free_b = generate(
+            model, params, p_b, max_new_tokens=new,
+            rng=jax.random.key(0), temperature=0.0,
+        )
+        eos = int(np.asarray(free_b)[0, 2])
+        solo = [
+            generate(
+                model, params, p, max_new_tokens=new,
+                rng=jax.random.key(0), temperature=0.0, eos_id=eos,
+            )
+            for p in (p_a, p_b)
+        ]
+        padded = jnp.asarray(
+            [[5, 9, 11, 2, 7], [8, 1, 0, 0, 0]], jnp.int32
+        )
+        out = generate(
+            model, params, padded, max_new_tokens=new,
+            rng=jax.random.key(0), temperature=0.0, eos_id=eos,
+            prompt_lens=jnp.asarray([5, 2], jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(out)[0], np.asarray(solo[0])[0])
+        np.testing.assert_array_equal(
+            np.asarray(out)[1, : 2 + new], np.asarray(solo[1])[0]
+        )
+        # And row b really did stop: padding from its first generated slot.
+        np.testing.assert_array_equal(
+            np.asarray(out)[1, 2 : 2 + new], np.full(new, eos)
+        )
