@@ -46,6 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "restore template to include the EMA subtree and "
                         "decodes from the AVERAGED weights (the decay value "
                         "itself is unused at inference; nonzero = on)")
+    parser.add_argument("--optimizer", default="adam",
+                        choices=("sgd", "adam", "adamw", "adafactor", "lion"),
+                        help="set to the training run's --optimizer when it "
+                        "wasn't adam: the optimizer family shapes the "
+                        "restore template's opt-state tree (adafactor's "
+                        "factored moments, lion's single moment), which "
+                        "must match the checkpoint exactly; its "
+                        "hyperparameters are irrelevant at inference")
     parser.add_argument("--epoch", type=int, default=None,
                         help="checkpoint epoch to load (default: latest)")
     gen = parser.add_argument_group("generation")
@@ -225,17 +233,18 @@ def main(argv: list[str] | None = None) -> int:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_routing=args.moe_routing,
+        attention_window=args.attention_window,
     )
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     model = TransformerLM(config=cfg, dtype=dtype)
-    # Optimizer only shapes the restore template (adam state matches the
-    # trainer's); its hyperparameters are irrelevant for inference. The
-    # dummy input is short on purpose: params are sequence-independent
-    # (RoPE, no position table), and a full --seq_len dense init would do
-    # O(S^2) work — fatal for long-context checkpoints.
+    # The optimizer only shapes the restore template — the FAMILY must match
+    # the training run's (--optimizer), the hyperparameters are irrelevant
+    # for inference. The dummy input is short on purpose: params are
+    # sequence-independent (RoPE, no position table), and a full --seq_len
+    # dense init would do O(S^2) work — fatal for long-context checkpoints.
     template = create_train_state(
         model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
-        build_optimizer("adam", 1e-3, clip_norm=1.0),
+        build_optimizer(args.optimizer, 1e-3, clip_norm=1.0),
         ema=args.ema > 0,
     )
     if mesh is not None:

@@ -86,7 +86,7 @@ def main(argv: list[str] | None = None) -> int:
         )
         sample = jnp.zeros((1, 64, 64, 3))
         d = train_unet.build_parser().parse_args([])
-        tx = build_optimizer("adam", d.learning_rate, clip_norm=d.clip_norm)
+        tx = build_optimizer(d.optimizer, d.learning_rate, clip_norm=d.clip_norm)
         default_name = d.model_filename
     else:
         from deeplearning_mpi_tpu.cli import train_resnet
@@ -102,7 +102,7 @@ def main(argv: list[str] | None = None) -> int:
         sample = jnp.zeros((1, 32, 32, 3))
         d = train_resnet.build_parser().parse_args([])
         tx = build_optimizer(
-            "sgd", d.learning_rate, momentum=d.momentum,
+            d.optimizer, d.learning_rate, momentum=d.momentum,
             weight_decay=d.weight_decay,
         )
         default_name = d.model_filename

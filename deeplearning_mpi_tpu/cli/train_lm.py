@@ -129,6 +129,18 @@ def main(argv: list[str] | None = None) -> int:
         num_workers=args.num_workers,
     )
 
+    if args.attention_window and args.attention in ("ring", "ulysses"):
+        # The sequence-parallel cores shard S over the mesh and do not take
+        # a window; Attention would raise a TypeError mid-trace — reject
+        # with a clear message before any compile instead.
+        print(
+            f"--attention_window is not supported with --attention "
+            f"{args.attention} (windowing is a single-sequence-core "
+            "feature: dense or flash)",
+            file=sys.stderr,
+        )
+        return 1
+
     attention_fn = None
     if args.attention == "flash":
         # The BHSD-native entry: Attention sees .layout == 'bhsd' and
@@ -168,6 +180,7 @@ def main(argv: list[str] | None = None) -> int:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_routing=args.moe_routing,
+        attention_window=args.attention_window,
     )
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.pp > 1:
@@ -183,7 +196,8 @@ def main(argv: list[str] | None = None) -> int:
             config=cfg, dtype=dtype, attention_fn=attention_fn, remat=args.remat,
             return_prehead=args.loss_chunk > 0,
         )
-    tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=1.0)
+    tx = build_optimizer(args.optimizer, config.build_lr(args, train_loader),
+                         weight_decay=args.weight_decay, clip_norm=1.0)
 
     def state_factory():
         return create_train_state(

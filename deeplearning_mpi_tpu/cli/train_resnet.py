@@ -28,7 +28,7 @@ def build_parser() -> argparse.ArgumentParser:
     # ResNet defaults: epochs 100, batch 128, lr 0.1, seed 0 (main.py:162-176).
     config.add_training_flags(
         parser, num_epochs=100, batch_size=128, learning_rate=0.1, random_seed=0,
-        model_filename="resnet_distributed",
+        model_filename="resnet_distributed", optimizer="sgd", weight_decay=1e-5,
     )
     parser.add_argument("--arch", default="resnet18",
                         choices=["resnet18", "resnet34", "resnet50",
@@ -49,7 +49,6 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--train_samples", type=int, default=2048,
                         help="synthetic dataset size")
     parser.add_argument("--momentum", type=float, default=0.9)
-    parser.add_argument("--weight_decay", type=float, default=1e-5)
     return parser
 
 
@@ -127,7 +126,7 @@ def main(argv: list[str] | None = None) -> int:
         **model_kw,
     )
     tx = build_optimizer(
-        "sgd", config.build_lr(args, train_loader),
+        args.optimizer, config.build_lr(args, train_loader),
         momentum=args.momentum, weight_decay=args.weight_decay,
     )
     def state_factory():

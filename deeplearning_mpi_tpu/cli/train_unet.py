@@ -152,7 +152,8 @@ def main(argv: list[str] | None = None) -> int:
         remat=args.remat,
         reference_topology=args.reference_topology,
     )
-    tx = build_optimizer("adam", config.build_lr(args, train_loader), clip_norm=args.clip_norm)
+    tx = build_optimizer(args.optimizer, config.build_lr(args, train_loader),
+                         weight_decay=args.weight_decay, clip_norm=args.clip_norm)
 
     def state_factory():
         return create_train_state(

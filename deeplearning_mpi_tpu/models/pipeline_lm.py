@@ -77,7 +77,8 @@ class StageBlocks(nn.Module):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
                 attention_fn=self.attention_fn, mlp_cls=self.mlp_cls,
-                num_kv_heads=cfg.num_kv_heads, name=f"block_{i}",
+                num_kv_heads=cfg.num_kv_heads, window=cfg.attention_window,
+                name=f"block_{i}",
             )(x, positions)
         return x
 

@@ -201,6 +201,15 @@ class Attention(nn.Module):
     #: weight-only int8 projections (``ops.quant.QuantDense``); inference
     #: only — params come from ``ops.quant.quantize_lm_params``.
     quantized: bool = False
+    #: sliding-window (local) attention: each query attends its last
+    #: ``window`` tokens, self included (0 = unlimited). One knob drives all
+    #: three cores consistently — the full-sequence ``attention_fn`` (dense
+    #: oracle or flash kernels, which skip out-of-window blocks), AND the
+    #: KV-cached decode walk (which then starts at the window's first cache
+    #: block: O(window) HBM reads per token however long the generation).
+    #: Sequence-parallel cores (ring/ulysses) do not take a window — the
+    #: CLI rejects that combination up front.
+    window: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array, *, causal: bool = True) -> jax.Array:
@@ -227,7 +236,7 @@ class Attention(nn.Module):
             v = proj(kv_heads, "v_proj")(x)
             ctx = self.attention_fn(
                 q, repeat_kv(k, rep, axis=1), repeat_kv(v, rep, axis=1),
-                causal=causal,
+                causal=causal, **self._window_kw(),
             )  # [B, H, S, D]
             return _ProjFromBHSD(x.shape[-1], self.dtype, name="out_proj")(ctx)
         dense = _dense_factory(self.quantized, self.dtype)
@@ -243,10 +252,20 @@ class Attention(nn.Module):
             ctx = self._cached_attention(q, k, v)
         else:
             attn = self.attention_fn or dense_attention
-            ctx = attn(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal)
+            ctx = attn(
+                q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal,
+                **self._window_kw(),
+            )
         ctx = ctx.reshape(batch, seq, features)
         # "out_proj" triggers tensor_parallel's row-parallel (input-dim) rule.
         return dense(x.shape[-1], "out_proj")(ctx)
+
+    def _window_kw(self) -> dict:
+        """``{'window': N}`` for the full-sequence core when sliding-window
+        is on — passed as a kwarg so a core that cannot honor it (ring,
+        ulysses) fails loudly with a TypeError instead of silently attending
+        to the full sequence."""
+        return {"window": self.window} if self.window else {}
 
     def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         """One decode step: append K/V to the cache, attend over the prefix.
@@ -289,7 +308,9 @@ class Attention(nn.Module):
         # buffers <= DECODE_DENSE_MAX (reads all rows — safe because this
         # cache zero-initializes), the blockwise prefix walk (O(i) reads
         # per token) beyond it. Measured rationale: PERF_ANALYSIS.md §9.
-        return decode_attention(q, new_k, new_v, i)
+        return decode_attention(
+            q, new_k, new_v, i, window=self.window or None
+        )
 
 
 class SwiGLU(nn.Module):
@@ -323,6 +344,8 @@ class Block(nn.Module):
     #: False = bidirectional attention (encoder stacks: ViT); True = the
     #: causal LM default.
     causal: bool = True
+    #: sliding-window attention size (0 = unlimited); see Attention.window.
+    window: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -330,7 +353,7 @@ class Block(nn.Module):
             self.num_heads, self.head_dim, self.dtype,
             attention_fn=self.attention_fn, decode=self.decode,
             num_kv_heads=self.num_kv_heads, quantized=self.quantized,
-            name="attn",
+            window=self.window, name="attn",
         )(RMSNorm(name="attn_norm")(x), positions, causal=self.causal)
         if self.quantized:
             if self.mlp_cls is not None:
@@ -374,6 +397,11 @@ class TransformerConfig:
     #: expert takes its top-C tokens; balanced by construction — see
     #: MoEMLP's causality caveat before using it in a causal LM).
     moe_routing: str = "token_choice"
+    #: sliding-window (local) attention: each query attends its last N
+    #: tokens (0 = unlimited). A MODEL property, not a runtime knob — train,
+    #: prefill, and KV-cached decode all mask with it, so a window-trained
+    #: checkpoint decodes with the same receptive field it learned.
+    attention_window: int = 0
 
     @staticmethod
     def tiny() -> "TransformerConfig":
@@ -443,7 +471,8 @@ class TransformerLM(nn.Module):
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
                 attention_fn=self.attention_fn, mlp_cls=mlp_cls,
                 decode=self.decode, num_kv_heads=cfg.num_kv_heads,
-                quantized=self.quantized, name=f"layer_{i}",
+                quantized=self.quantized, window=cfg.attention_window,
+                name=f"layer_{i}",
             )(x, positions)
         x = RMSNorm(name="final_norm")(x)
         if self.return_prehead:

@@ -49,6 +49,7 @@ def dense_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    window: int | None = None,
     q_offset: int | jax.Array = 0,
     kv_offset: int | jax.Array = 0,
 ) -> jax.Array:
@@ -57,7 +58,15 @@ def dense_attention(
     ``q_offset``/``kv_offset`` are the absolute positions of the first query /
     key row — used by the blockwise/ring implementations, which call this on
     sequence *shards* and need causal masking in global coordinates.
+
+    ``window`` (sliding-window / local attention, Mistral-style): each query
+    attends only its last ``window`` keys (self included) — requires
+    ``causal`` since the window is defined against the causal past. This is
+    the numerical oracle for the windowed flash kernel
+    (``ops.pallas.flash_attention(window=...)``).
     """
+    if window is not None and not causal:
+        raise ValueError("window attention is causal by definition; pass causal=True")
     *_, q_len, _, head_dim = q.shape
     kv_len = k.shape[-3]
     scale = head_dim**-0.5
@@ -70,6 +79,8 @@ def dense_attention(
         q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
         k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
         valid = q_pos >= k_pos
+        if window is not None:
+            valid &= q_pos - k_pos < window
         scores = jnp.where(valid, scores, NEG_INF)
         # A query row with NO valid key (possible on blockwise shards that are
         # entirely in the row's future) must contribute zero, not a uniform
@@ -104,6 +115,7 @@ def decode_attention(
     *,
     block: int = 2048,
     dense_max: int = DECODE_DENSE_MAX,
+    window: int | None = None,
 ) -> jax.Array:
     """One KV-cached decode step over the filled prefix of the cache.
 
@@ -128,6 +140,13 @@ def decode_attention(
       exact across chunks in f32. The 2048 default block amortizes the
       measured ~40 us/iteration loop overhead.
 
+    ``window`` (sliding-window models): the query attends only cache
+    positions ``index-window+1 .. index``. The blockwise walk then *starts*
+    at the window's first block instead of 0, so per-token HBM traffic is
+    O(window) however long the generation has run — decode cost stops
+    growing with context, the inference-side half of the sliding-window
+    trade.
+
     Not differentiable (dynamic trip count) — decode is inference-only.
     """
     batch, q_len, heads, head_dim = q.shape
@@ -151,7 +170,10 @@ def decode_attention(
             "bhgd,bkhd->bhgk", qg, k_buf,
             preferred_element_type=jnp.float32,
         ) * scale  # [B, Hkv, G, L]
-        valid = jnp.arange(length, dtype=jnp.int32) <= index
+        pos = jnp.arange(length, dtype=jnp.int32)
+        valid = pos <= index
+        if window is not None:
+            valid &= pos > index - window
         s = jnp.where(valid[None, None, None, :], s, NEG_INF)
         w = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
@@ -187,6 +209,8 @@ def decode_attention(
         pos = start + jnp.arange(b, dtype=jnp.int32)
         # Lower bound deduplicates the clamped tail's overlap with block j-1.
         valid = (pos >= j * b) & (pos <= index)
+        if window is not None:
+            valid &= pos > index - window
         s = jnp.where(valid[None, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[..., None])
@@ -197,8 +221,13 @@ def decode_attention(
         )
         return acc * alpha[..., None] + pv, m_new, l * alpha + jnp.sum(p, axis=-1)
 
+    # Windowed decode never reads blocks wholly before the window: start the
+    # walk at the window's first block (traced, like the trip count).
+    j_start = (
+        jnp.maximum(index - window + 1, 0) // b if window is not None else 0
+    )
     acc, _, l = lax.fori_loop(
-        0, n_blocks, body,
+        j_start, n_blocks, body,
         (
             jnp.zeros((batch, kv_heads, group, head_dim), jnp.float32),
             jnp.full((batch, kv_heads, group), NEG_INF, jnp.float32),

@@ -406,7 +406,9 @@ def build_optimizer(
     weight_decay: float = 0.0,
     clip_norm: float | None = None,
 ) -> optax.GradientTransformation:
-    """Reference-parity optimizers as optax chains.
+    """Reference-parity optimizers as optax chains, plus transformer-era ones.
+
+    Reference parity:
 
     - ``sgd``: SGD + momentum 0.9 + weight decay 1e-5 for ResNet
       (``pytorch/resnet/main.py:114``). torch couples weight decay into the
@@ -414,6 +416,23 @@ def build_optimizer(
       momentum — the same coupling.
     - ``adam``: Adam for UNet (``pytorch/unet/train.py:160``), with the
       trainer's grad-clip 1.0 (``train.py:194``) prepended when requested.
+
+    Beyond parity (the reference predates all three):
+
+    - ``adamw``: Adam with DECOUPLED weight decay — the transformer-training
+      standard. ``weight_decay`` here is applied by the optimizer after the
+      moment update, not folded into the gradient like ``sgd``'s L2.
+    - ``adafactor``: factored second moments — optimizer HBM drops from 2
+      f32 copies of the params (Adam) to ~1 plus O(rows+cols) factors, the
+      TPU-idiomatic choice for large models (and it composes with ZeRO-1:
+      ``--zero`` shards whatever moments remain over the data axis).
+    - ``lion``: sign-momentum; one f32 moment (half of Adam's optimizer
+      memory), decoupled decay like adamw.
+
+    A checkpoint stores the optimizer state TREE, so ``--resume`` must use
+    the same optimizer the run started with — a mismatch fails loudly at
+    restore time as an orbax tree-structure error (same contract as
+    ``--ema``, ``utils/config.py``).
     """
     parts: list[optax.GradientTransformation] = []
     if clip_norm is not None:
@@ -424,6 +443,22 @@ def build_optimizer(
         parts.append(optax.sgd(learning_rate, momentum=momentum))
     elif name == "adam":
         parts.append(optax.adam(learning_rate))
+    elif name == "adamw":
+        parts.append(optax.adamw(learning_rate, weight_decay=weight_decay))
+    elif name == "adafactor":
+        # multiply_by_parameter_scale=False keeps the step size directly
+        # governed by the LR schedule (True rescales per-tensor and wants
+        # the ~1e-2 "relative" LR regime — surprising under the CLIs'
+        # Adam-tuned defaults and schedules).
+        parts.append(
+            optax.adafactor(
+                learning_rate,
+                multiply_by_parameter_scale=False,
+                weight_decay_rate=weight_decay or None,
+            )
+        )
+    elif name == "lion":
+        parts.append(optax.lion(learning_rate, weight_decay=weight_decay))
     else:
         raise ValueError(f"unknown optimizer '{name}'")
     return optax.chain(*parts)

@@ -51,6 +51,8 @@ def add_training_flags(
     random_seed: int = 0,
     model_dir: str = "saved_models",
     model_filename: str = "model",
+    optimizer: str = "adam",
+    weight_decay: float = 0.0,
 ) -> None:
     """The reference's shared hyperparameter flags, names and defaults intact.
 
@@ -64,6 +66,20 @@ def add_training_flags(
     group.add_argument("--num_epochs", type=int, default=num_epochs)
     group.add_argument("--batch_size", type=int, default=batch_size, help="GLOBAL batch size")
     group.add_argument("--learning_rate", type=float, default=learning_rate)
+    group.add_argument("--optimizer", default=optimizer,
+                       choices=("sgd", "adam", "adamw", "adafactor", "lion"),
+                       help="default = the reference's choice for this "
+                       "trainer (resnet: sgd, unet/lm: adam). adamw/lion use "
+                       "decoupled weight decay; adafactor's factored moments "
+                       "cut optimizer HBM to ~half of Adam's (composes with "
+                       "--zero). --resume requires the same optimizer the "
+                       "run started with (opt-state tree mismatch otherwise "
+                       "— fail-loud, like --ema)")
+    group.add_argument("--weight_decay", type=float, default=weight_decay,
+                       help="sgd: coupled L2 (torch semantics, reference "
+                       "parity); adamw/adafactor/lion: decoupled decay. "
+                       "Ignored by plain adam. Default = the reference's "
+                       "value for this trainer (resnet: 1e-5, unet/lm: 0)")
     group.add_argument("--lr_schedule", default="constant",
                        choices=("constant", "cosine", "linear"),
                        help="LR over steps: constant (reference parity), "
@@ -132,6 +148,14 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        help="0 = dense SwiGLU MLP; N>1 swaps in a routed MoE "
                        "MLP per block (shard with --ep when training)")
     group.add_argument("--moe_top_k", type=int, default=2)
+    group.add_argument("--attention_window", type=int, default=0,
+                       help="sliding-window (local) attention: each token "
+                       "attends its last N tokens only (0 = full causal). "
+                       "A model property — training, prefill, and KV-cached "
+                       "decode all honor it (decode then reads O(N) cache "
+                       "rows per token). Flash kernels skip out-of-window "
+                       "blocks: attention cost becomes O(S*N). Not valid "
+                       "with --attention ring|ulysses")
     group.add_argument("--moe_routing", default="token_choice",
                        choices=("token_choice", "expert_choice"),
                        help="token_choice = GShard top-k + balance aux loss; "

@@ -46,6 +46,23 @@ class TestTrainResnetCLI:
         assert "Epoch 0: loss" in logs
         assert "accuracy" in logs
 
+    def test_optimizer_flag_beyond_parity(self, tmp_path):
+        """--optimizer selects the transformer-era families end-to-end
+        (adafactor here: the factored-moment TPU default); resume with a
+        DIFFERENT optimizer must fail loudly, not silently reinterpret the
+        checkpoint's opt-state tree."""
+        args = RESNET_ARGS + [
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ]
+        assert train_resnet.main(
+            args + ["--num_epochs", "1", "--optimizer", "adafactor"]
+        ) == 0
+        with pytest.raises(Exception):
+            train_resnet.main(
+                args + ["--num_epochs", "2", "--resume", "--optimizer", "lion"]
+            )
+
     def test_ema_trains_and_eval_only_restores(self, tmp_path):
         # --ema rides the checkpoint: eval_only with the same flag restores
         # the EMA subtree and evaluates with the averaged weights.

@@ -76,6 +76,86 @@ def test_rectangular_block_grads(bq, bk):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+class TestSlidingWindow:
+    """Windowed (local) flash attention vs the windowed dense oracle.
+
+    Window sizes are chosen against the 16-wide blocks to hit every gating
+    case: window inside one block (8), window == block (16), window
+    spanning blocks at a non-block-multiple (24), and window >= seq
+    (degenerates to plain causal). The dense oracle's own window mask is
+    three lines of iota arithmetic, independently checkable by eye."""
+
+    @pytest.mark.parametrize("window", [8, 16, 24, 56, 1000])
+    def test_forward_matches_windowed_dense(self, window):
+        q, k, v = qkv()
+        out = flash_attention(
+            q, k, v, causal=True, window=window, block_q=16, block_k=16
+        )
+        ref = dense_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_window_actually_masks(self):
+        """Guards against a no-op window: out-of-window keys must not
+        influence the output (perturb a stale key -> output unchanged)."""
+        q, k, v = qkv(S=64)
+        out = flash_attention(
+            q, k, v, causal=True, window=8, block_q=16, block_k=16
+        )
+        k2 = k.at[:, 0].add(100.0)  # key 0 is outside every window for t >= 8
+        v2 = v.at[:, 0].add(100.0)
+        out2 = flash_attention(
+            q, k2, v2, causal=True, window=8, block_q=16, block_k=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, 8:]), np.asarray(out2[:, 8:]), atol=2e-5
+        )
+        assert not np.allclose(np.asarray(out[:, :8]), np.asarray(out2[:, :8]))
+
+    # Windows 50/56 are the near-sequence regime (window >= S - block_q + 2
+    # = 50 here): the dkv kernel's trimmed-grid anchor overshoots the last
+    # real q block and must be clamped BEFORE the span subtraction —
+    # unclamped, dk/dv silently dropped the earliest in-window q blocks
+    # (found by review, verified numerically: O(1) absolute dk/dv error).
+    @pytest.mark.slow
+    @pytest.mark.parametrize("window", [8, 24, 50, 56])
+    def test_grads_match_windowed_dense(self, window):
+        q, k, v = qkv(S=64)
+
+        def loss(attn, q, k, v):
+            return jnp.sum(attn(q, k, v) ** 2)
+
+        flash = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=True, window=window, block_q=16, block_k=16
+        )
+        dense = lambda q, k, v: dense_attention(  # noqa: E731
+            q, k, v, causal=True, window=window
+        )
+        g_ref = jax.grad(loss, argnums=(1, 2, 3))(dense, q, k, v)
+        g_out = jax.grad(loss, argnums=(1, 2, 3))(flash, q, k, v)
+        for a, b in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_bhsd_entry_matches(self):
+        from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+            flash_attention_bhsd,
+        )
+
+        q, k, v = qkv()
+        qh, kh, vh = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        out = flash_attention_bhsd(
+            qh, kh, vh, causal=True, window=24, block_q=16, block_k=16
+        ).transpose(0, 2, 1, 3)
+        ref = dense_attention(q, k, v, causal=True, window=24)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_window_requires_causal(self):
+        q, k, v = qkv()
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+        with pytest.raises(ValueError, match="causal"):
+            dense_attention(q, k, v, causal=False, window=8)
+
+
 def test_indivisible_seq_falls_back_to_dense():
     q, k, v = qkv(S=48)  # 48 % 32 != 0 after clamping
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)

@@ -24,6 +24,15 @@ def _dense_cfg():
     return TransformerConfig.tiny()
 
 
+def _windowed_cfg():
+    # Sliding-window attention as a MODEL property: window 5 < seq 12 so
+    # later positions genuinely drop old keys, and the stepwise decode
+    # (decode_attention's windowed cache walk) must reproduce the windowed
+    # full forward (dense_attention's window mask) position by position —
+    # the train/decode receptive-field consistency claim.
+    return dataclasses.replace(TransformerConfig.tiny(), attention_window=5)
+
+
 def _gqa_cfg():
     # Grouped KV heads: the cache stores Hkv=2 for H=4 query heads, and
     # decode_attention consumes the grouped buffers natively — stepwise
@@ -47,8 +56,9 @@ def _moe_dropfree_cfg():
 class TestCachedDecode:
     @pytest.mark.slow
     @pytest.mark.parametrize("make_cfg",
-                             [_dense_cfg, _moe_dropfree_cfg, _gqa_cfg],
-                             ids=["dense", "moe", "gqa"])
+                             [_dense_cfg, _moe_dropfree_cfg, _gqa_cfg,
+                              _windowed_cfg],
+                             ids=["dense", "moe", "gqa", "windowed"])
     def test_stepwise_decode_matches_full_forward(self, make_cfg):
         """Feeding tokens one at a time through the KV cache must reproduce
         the full-sequence causal forward logits position by position."""

@@ -155,6 +155,38 @@ class TestTrainLMCLI:
         ])
         assert rc == 0
 
+    def test_sliding_window_through_flash(self, tmp_path):
+        # --attention_window with the flash core: a full epoch through the
+        # windowed kernels (block gating + in-tile mask, interpret on CPU).
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        rc = train_lm.main([
+            "--attention", "flash", "--attention_window", "16",
+            "--num_epochs", "1", "--batch_size", "8", "--seq_len", "32",
+            "--num_layers", "1", "--num_heads", "2", "--head_dim", "8",
+            "--d_model", "16", "--d_ff", "32",
+            "--train_sequences", "32",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+
+    def test_sliding_window_rejects_sequence_parallel_cores(self, tmp_path):
+        # ring/ulysses shard S over the mesh and take no window — the CLI
+        # must reject the combination up front, not TypeError mid-trace.
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        rc = train_lm.main([
+            "--attention", "ring", "--sp", "4", "--attention_window", "16",
+            "--num_epochs", "1", "--batch_size", "8", "--seq_len", "64",
+            "--num_layers", "1", "--num_heads", "2", "--head_dim", "8",
+            "--d_model", "16", "--d_ff", "32",
+            "--train_sequences", "32",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 1
+
     def test_ring_attention_sequence_parallel(self, tmp_path):
         # --sp 4 over the 8 virtual devices: the ring schedule through the
         # CLI (mesh construction, loader seq handling, collective epoch).

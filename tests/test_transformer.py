@@ -149,6 +149,67 @@ class TestDecodeAttention:
         with pytest.raises(ValueError, match="one query token"):
             decode_attention(q, buf, buf, jnp.int32(0))
 
+    def _window_oracle(self, q, k_buf, v_buf, i, window):
+        from deeplearning_mpi_tpu.ops.attention import NEG_INF
+
+        scale = q.shape[-1] ** -0.5
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_buf, preferred_element_type=jnp.float32
+        ) * scale
+        pos = jnp.arange(k_buf.shape[1])[None, None, None, :]
+        valid = (pos <= i) & (pos > i - window)
+        scores = jnp.where(valid, scores, NEG_INF)
+        weights = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(v_buf.dtype), v_buf)
+
+    @pytest.mark.parametrize("dense_max", [0, 4096], ids=["walk", "dense"])
+    @pytest.mark.parametrize("index", [0, 3, 7, 8, 15, 23, 31])
+    def test_sliding_window_matches_oracle(self, index, dense_max):
+        """Windowed decode (window 8, block 8): fills below, at, and past
+        the window boundary, on both schedules."""
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        rng = np.random.default_rng(index)
+        shape = (2, 32, 3, 8)
+        k_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        v_buf = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(2, 1, 3, 8)), jnp.float32)
+        out = decode_attention(
+            q, k_buf, v_buf, jnp.int32(index), block=8, dense_max=dense_max,
+            window=8,
+        )
+        ref = self._window_oracle(q, k_buf, v_buf, index, 8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_sliding_window_skips_stale_blocks(self):
+        """The walk must START at the window's first block — poison every
+        wholly-stale block with NaN; any read of one surfaces as NaN in the
+        flash accumulator. This is the O(window)-reads-per-token claim."""
+        from deeplearning_mpi_tpu.ops.attention import decode_attention
+
+        rng = np.random.default_rng(0)
+        k_buf = rng.normal(size=(1, 32, 2, 8)).astype(np.float32)
+        v_buf = rng.normal(size=(1, 32, 2, 8)).astype(np.float32)
+        # index 23, window 8 -> window covers 16..23 -> blocks 0 and 1
+        # (rows 0..15) are wholly stale; block 3 (rows 24..31) is unfilled.
+        k_buf[:, :16] = np.nan
+        v_buf[:, :16] = np.nan
+        k_buf[:, 24:] = np.nan
+        v_buf[:, 24:] = np.nan
+        q = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
+        out = decode_attention(
+            q, jnp.asarray(k_buf), jnp.asarray(v_buf), jnp.int32(23),
+            block=8, dense_max=0, window=8,
+        )
+        assert np.all(np.isfinite(np.asarray(out)))
+        ref = self._window_oracle(
+            q,
+            jnp.nan_to_num(jnp.asarray(k_buf)),
+            jnp.nan_to_num(jnp.asarray(v_buf)),
+            23, 8,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
     @pytest.mark.parametrize("dense_max", [0, 4096], ids=["windowed", "dense"])
     @pytest.mark.parametrize("index", [0, 5, 19, 31])
     def test_gqa_matches_repeated_kv(self, index, dense_max):

@@ -37,7 +37,8 @@ def _clock(fn, args, steps: int) -> float:
 
 
 def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
-              causal: bool, bwd: bool, steps: int = 10) -> dict:
+              causal: bool, bwd: bool, steps: int = 10,
+              window: int | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -51,16 +52,30 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
     v = jax.random.normal(kv, shape, jnp.bfloat16)
 
     flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                                    window=window,
                                                     interpret=False))
-    dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=causal))
+    dense = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=causal,
+                                                    window=window))
 
     def time_fn(fn):
         return _clock(fn, (q, k, v), steps)
 
     result: dict = {"seq": seq, "batch": batch, "heads": heads,
                     "head_dim": head_dim, "causal": causal}
+    if window is not None:
+        result["window"] = window
     t_flash = time_fn(flash)
     result["flash_fwd_ms"] = round(t_flash * 1e3, 3)
+    if window is not None:
+        # The sliding-window claim is vs FULL flash (dense rarely compiles
+        # at the seqs where a window matters): O(S·W) vs O(S²/2) tiles.
+        full = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                            interpret=False)
+        )
+        t_full = time_fn(full)
+        result["full_flash_fwd_ms"] = round(t_full * 1e3, 3)
+        result["window_fwd_speedup"] = round(t_full / t_flash, 2)
     # Attention fwd FLOPs: 2 matmuls of [S,D]x[D,S] and [S,S]x[S,D] per
     # head, halved for the causal triangle.
     flops = 2 * 2 * batch * heads * seq * seq * head_dim * (0.5 if causal else 1)
@@ -77,14 +92,18 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
         result["dense_error"] = repr(e)[:120]
 
     if bwd:
-        def loss(q, k, v):
-            return jnp.sum(
-                flash_attention(q, k, v, causal=causal, interpret=False)
-                .astype(jnp.float32) ** 2
-            )
-        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        from deeplearning_mpi_tpu.utils.profiling import host_sync
 
-        def time_g():
+        def make_grad(win):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=causal, window=win,
+                                    interpret=False)
+                    .astype(jnp.float32) ** 2
+                )
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        def time_g(g):
             out = g(q, k, v)
             host_sync(out[0].ravel()[:1])
             t0 = time.perf_counter()
@@ -93,7 +112,12 @@ def bench_one(seq: int, *, batch: int, heads: int, head_dim: int,
             host_sync(out[0].ravel()[:1])
             return (time.perf_counter() - t0) / steps
 
-        result["flash_fwd_bwd_ms"] = round(time_g() * 1e3, 3)
+        t_g = time_g(make_grad(window))
+        result["flash_fwd_bwd_ms"] = round(t_g * 1e3, 3)
+        if window is not None:
+            t_g_full = time_g(make_grad(None))
+            result["full_flash_fwd_bwd_ms"] = round(t_g_full * 1e3, 3)
+            result["window_fwd_bwd_speedup"] = round(t_g_full / t_g, 2)
     return result
 
 
@@ -188,6 +212,10 @@ def main() -> None:
     ap.add_argument("--head_dim", type=int, default=64)
     ap.add_argument("--non_causal", action="store_true")
     ap.add_argument("--bwd", action="store_true")
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window size: times windowed flash AND "
+                    "full flash in one run, reporting the speedup (the "
+                    "O(S*W) vs O(S^2/2) block-skip claim)")
     ap.add_argument("--ring_inner", action="store_true",
                     help="compare the two ring schedules' per-rotation inner "
                     "pass (the single-chip-measurable part; see "
@@ -206,7 +234,7 @@ def main() -> None:
         else:
             print(json.dumps(bench_one(
                 seq, batch=args.batch, heads=args.heads, head_dim=args.head_dim,
-                causal=not args.non_causal, bwd=args.bwd,
+                causal=not args.non_causal, bwd=args.bwd, window=args.window,
             )))
 
 
