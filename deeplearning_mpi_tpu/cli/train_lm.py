@@ -129,14 +129,13 @@ def main(argv: list[str] | None = None) -> int:
         num_workers=args.num_workers,
     )
 
-    if args.attention_window and args.attention in ("ring", "ulysses"):
-        # The sequence-parallel cores shard S over the mesh and do not take
-        # a window; Attention would raise a TypeError mid-trace — reject
-        # with a clear message before any compile instead.
+    if args.attention_window and args.attention == "ring":
+        # The ring schedule's rotating K/V shards would need window-aware
+        # rotation skipping (not built); Ulysses composes (its inner core
+        # sees the full sequence). Reject before any compile.
         print(
-            f"--attention_window is not supported with --attention "
-            f"{args.attention} (windowing is a single-sequence-core "
-            "feature: dense or flash)",
+            "--attention_window is not supported with --attention ring; "
+            "use ulysses, flash, or dense",
             file=sys.stderr,
         )
         return 1

@@ -207,8 +207,8 @@ class Attention(nn.Module):
     #: oracle or flash kernels, which skip out-of-window blocks), AND the
     #: KV-cached decode walk (which then starts at the window's first cache
     #: block: O(window) HBM reads per token however long the generation).
-    #: Sequence-parallel cores (ring/ulysses) do not take a window — the
-    #: CLI rejects that combination up front.
+    #: Ulysses sequence parallelism composes (full-sequence inner core);
+    #: the ring schedule rejects a window (rotation skipping not built).
     window: int = 0
 
     @nn.compact
@@ -261,10 +261,11 @@ class Attention(nn.Module):
         return dense(x.shape[-1], "out_proj")(ctx)
 
     def _window_kw(self) -> dict:
-        """``{'window': N}`` for the full-sequence core when sliding-window
-        is on — passed as a kwarg so a core that cannot honor it (ring,
-        ulysses) fails loudly with a TypeError instead of silently attending
-        to the full sequence."""
+        """``{'window': N}`` for the attention core when sliding-window is
+        on — passed as a kwarg so a core that cannot honor it fails loudly
+        (the ring factory raises; an unknown injected core TypeErrors)
+        instead of silently attending to the full sequence. Dense, flash,
+        and Ulysses all accept it."""
         return {"window": self.window} if self.window else {}
 
     def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:

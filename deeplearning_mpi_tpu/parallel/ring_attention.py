@@ -177,7 +177,17 @@ def make_ring_attention_fn(
         flash = mesh.devices.flat[0].platform == "tpu"
 
     @functools.lru_cache(maxsize=2)
-    def _sharded(causal: bool):
+    def _sharded(causal: bool, window: int | None = None):
+        if window is not None:
+            # Honoring a window here would need rotation skipping (only
+            # ceil(W/S_local)+1 neighbor shards contribute) — not built;
+            # silently attending to the full sequence would be worse.
+            raise ValueError(
+                "ring attention does not support sliding-window attention; "
+                "use --attention ulysses (window passes through its "
+                "full-sequence inner core) or flash"
+            )
+
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,

@@ -13,17 +13,21 @@ def with_divisibility_fallback(
     mesh: Mesh,
     batch_axes: Any,
     seq_axis: str,
-    sharded: Callable[[bool], Callable],
+    sharded: Callable[[bool, int | None], Callable],
     fallback: Callable,
 ) -> Callable:
     """Wrap a seq-parallel attention schedule with a static-shape fallback.
 
-    ``sharded(causal)`` returns the shard_map'd schedule; ``fallback`` is a
-    single-device attention core. Shapes the mesh can't divide — notably the
-    batch-1 forward ``model.init`` runs to shape the params (attention itself
-    has no params) — take the fallback instead of failing shard_map's
-    divisibility check. The decision is static (trace-time shapes), so jit
-    caches one program per shape as usual.
+    ``sharded(causal, window)`` returns the shard_map'd schedule;
+    ``fallback`` is a single-device attention core. Shapes the mesh can't
+    divide — notably the batch-1 forward ``model.init`` runs to shape the
+    params (attention itself has no params) — take the fallback instead of
+    failing shard_map's divisibility check. The decision is static
+    (trace-time shapes), so jit caches one program per shape as usual.
+
+    ``window`` is forwarded to both paths; a schedule that cannot honor it
+    (the ring) must raise from its ``sharded`` factory rather than silently
+    attending to the full sequence.
     """
     batch_list = [batch_axes] if isinstance(batch_axes, str) else list(batch_axes)
     dp = 1
@@ -31,13 +35,14 @@ def with_divisibility_fallback(
         dp *= mesh.shape[a]
     sp = mesh.shape[seq_axis if seq_axis else AXIS_SEQ]
 
-    def attention_fn(q, k, v, *, causal: bool = True):
+    def attention_fn(q, k, v, *, causal: bool = True, window: int | None = None):
         if q.shape[0] % dp == 0 and q.shape[1] % sp == 0:
-            return sharded(causal)(q, k, v)
+            return sharded(causal, window)(q, k, v)
         if q.shape[0] == 1:
             # model.init's batch-1 param-shaping forward (and batch-1
             # inference): attention has no params, so the core swap is safe.
-            return fallback(q, k, v, causal=causal)
+            kw = {"window": window} if window is not None else {}
+            return fallback(q, k, v, causal=causal, **kw)
         # A real training/eval shape the mesh can't divide must not silently
         # lose its sequence sharding (dense attention at long context is an
         # OOM or an order-of-magnitude regression) — fail with the fix.

@@ -47,6 +47,7 @@ def ulysses_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    window: int | None = None,
     axis_name: str = AXIS_SEQ,
     inner: InnerAttentionFn = dense_attention,
 ) -> jax.Array:
@@ -54,6 +55,12 @@ def ulysses_attention(
 
     Inputs are this device's sequence shard ``[B, S_local, H, D]`` with
     ``H % axis_size == 0``. Returns the same shard of the attention output.
+
+    ``window`` (sliding-window attention) composes for free: the inner core
+    runs on the FULL sequence per head group, so the window is just passed
+    through — unlike the ring schedule, whose rotating K/V shards would need
+    window-aware rotation skipping (not implemented; the ring factory
+    rejects a window).
     """
     n = lax.axis_size(axis_name)
     heads = q.shape[-2]
@@ -62,14 +69,15 @@ def ulysses_attention(
             f"ulysses attention needs heads ({heads}) divisible by the "
             f"'{axis_name}' axis size ({n})"
         )
+    kw = {"window": window} if window is not None else {}
     if n == 1:
-        return inner(q, k, v, causal=causal)
+        return inner(q, k, v, causal=causal, **kw)
     # seq-sharded -> head-sharded: split heads (axis 2), gather sequence (1).
     to_heads = functools.partial(
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
     )
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # [B, S, H/n, D]
-    ctx = inner(qh, kh, vh, causal=causal)
+    ctx = inner(qh, kh, vh, causal=causal, **kw)
     # head-sharded -> seq-sharded: split sequence (1), gather heads (2).
     return lax.all_to_all(
         ctx, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -90,8 +98,8 @@ def make_ulysses_attention_fn(
     """
     spec = P(batch_axes, seq_axis, None, None)
 
-    @functools.lru_cache(maxsize=2)
-    def _sharded(causal: bool):
+    @functools.lru_cache(maxsize=4)
+    def _sharded(causal: bool, window: int | None = None):
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
@@ -99,7 +107,8 @@ def make_ulysses_attention_fn(
         )
         def fn(q, k, v):
             return ulysses_attention(
-                q, k, v, causal=causal, axis_name=seq_axis, inner=inner
+                q, k, v, causal=causal, window=window, axis_name=seq_axis,
+                inner=inner,
             )
 
         return fn

@@ -154,8 +154,9 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        "A model property — training, prefill, and KV-cached "
                        "decode all honor it (decode then reads O(N) cache "
                        "rows per token). Flash kernels skip out-of-window "
-                       "blocks: attention cost becomes O(S*N). Not valid "
-                       "with --attention ring|ulysses")
+                       "blocks: attention cost becomes O(S*N). Composes "
+                       "with --attention ulysses (full-sequence inner); "
+                       "not valid with --attention ring")
     group.add_argument("--moe_routing", default="token_choice",
                        choices=("token_choice", "expert_choice"),
                        help="token_choice = GShard top-k + balance aux loss; "

@@ -171,9 +171,26 @@ class TestTrainLMCLI:
         ])
         assert rc == 0
 
-    def test_sliding_window_rejects_sequence_parallel_cores(self, tmp_path):
-        # ring/ulysses shard S over the mesh and take no window — the CLI
-        # must reject the combination up front, not TypeError mid-trace.
+    def test_sliding_window_composes_with_ulysses(self, tmp_path):
+        # --sp 4 + --attention_window: the window rides the all-to-all
+        # schedule's full-sequence inner core (values pinned to the windowed
+        # oracle in test_sequence_parallel; this is the CLI wiring).
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        rc = train_lm.main([
+            "--attention", "ulysses", "--sp", "4", "--attention_window", "16",
+            "--num_epochs", "1", "--batch_size", "8", "--seq_len", "64",
+            "--num_layers", "1", "--num_heads", "4", "--head_dim", "8",
+            "--d_model", "16", "--d_ff", "32",
+            "--train_sequences", "32",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+
+    def test_sliding_window_rejects_ring(self, tmp_path):
+        # The ring schedule's rotating K/V shards can't honor a window —
+        # the CLI must reject the combination up front, not mid-trace.
         from deeplearning_mpi_tpu.cli import train_lm
 
         rc = train_lm.main([

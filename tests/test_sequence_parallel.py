@@ -56,6 +56,36 @@ def test_grads_match_dense(make_fn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
+@pytest.mark.parametrize("window", [8, 20])
+def test_ulysses_sliding_window_matches_oracle(window):
+    """Windowed Ulysses: the window passes through the all-to-alls to the
+    full-sequence inner core, so the sharded result must equal the windowed
+    dense oracle — values and gradients."""
+    mesh = seq_mesh()
+    q, k, v = qkv()
+    fn = make_ulysses_attention_fn(mesh)
+    out = fn(q, k, v, causal=True, window=window)
+    ref = dense_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True, window=window) ** 2)
+
+    g_ref = jax.grad(loss, argnums=(1, 2, 3))(dense_attention, q, k, v)
+    g_out = jax.grad(loss, argnums=(1, 2, 3))(fn, q, k, v)
+    for a, b in zip(g_out, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_ring_rejects_window():
+    """The ring schedule cannot honor a window (rotation skipping not
+    built) and must refuse rather than silently attend the full sequence."""
+    mesh = seq_mesh()
+    q, k, v = qkv()
+    with pytest.raises(ValueError, match="ring attention does not support"):
+        make_ring_attention_fn(mesh)(q, k, v, causal=True, window=8)
+
+
 @pytest.mark.slow
 def test_ring_seq8_uneven_heads():
     """The ring schedule has no head-divisibility constraint: seq=8 > heads=4."""
