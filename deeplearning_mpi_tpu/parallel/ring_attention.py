@@ -178,15 +178,11 @@ def make_ring_attention_fn(
 
     @functools.lru_cache(maxsize=2)
     def _sharded(causal: bool, window: int | None = None):
-        if window is not None:
-            # Honoring a window here would need rotation skipping (only
-            # ceil(W/S_local)+1 neighbor shards contribute) — not built;
-            # silently attending to the full sequence would be worse.
-            raise ValueError(
-                "ring attention does not support sliding-window attention; "
-                "use --attention ulysses (window passes through its "
-                "full-sequence inner core) or flash"
-            )
+        # window is rejected upstream (with_divisibility_fallback,
+        # supports_window=False) so BOTH paths — sharded and the batch-1
+        # init fallback — refuse it; honoring it here would need rotation
+        # skipping (only ceil(W/S_local)+1 neighbor shards contribute).
+        del window
 
         @functools.partial(
             jax.shard_map, mesh=mesh,
@@ -210,5 +206,6 @@ def make_ring_attention_fn(
     from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
 
     return with_divisibility_fallback(
-        mesh, batch_axes, seq_axis, _sharded, dense_attention
+        mesh, batch_axes, seq_axis, _sharded, dense_attention,
+        supports_window=False,
     )

@@ -15,6 +15,8 @@ def with_divisibility_fallback(
     seq_axis: str,
     sharded: Callable[[bool, int | None], Callable],
     fallback: Callable,
+    *,
+    supports_window: bool = True,
 ) -> Callable:
     """Wrap a seq-parallel attention schedule with a static-shape fallback.
 
@@ -26,8 +28,10 @@ def with_divisibility_fallback(
     (trace-time shapes), so jit caches one program per shape as usual.
 
     ``window`` is forwarded to both paths; a schedule that cannot honor it
-    (the ring) must raise from its ``sharded`` factory rather than silently
-    attending to the full sequence.
+    (the ring) passes ``supports_window=False`` and the wrapper rejects the
+    kwarg up front — HERE, not inside ``sharded``, because the batch-1
+    init fallback never reaches the sharded factory and would otherwise
+    silently accept the window on the dense core.
     """
     batch_list = [batch_axes] if isinstance(batch_axes, str) else list(batch_axes)
     dp = 1
@@ -36,6 +40,12 @@ def with_divisibility_fallback(
     sp = mesh.shape[seq_axis if seq_axis else AXIS_SEQ]
 
     def attention_fn(q, k, v, *, causal: bool = True, window: int | None = None):
+        if window is not None and not supports_window:
+            raise ValueError(
+                "ring attention does not support sliding-window attention; "
+                "use --attention ulysses (window passes through its "
+                "full-sequence inner core) or flash"
+            )
         if q.shape[0] % dp == 0 and q.shape[1] % sp == 0:
             return sharded(causal, window)(q, k, v)
         if q.shape[0] == 1:

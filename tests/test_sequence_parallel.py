@@ -79,11 +79,18 @@ def test_ulysses_sliding_window_matches_oracle(window):
 
 def test_ring_rejects_window():
     """The ring schedule cannot honor a window (rotation skipping not
-    built) and must refuse rather than silently attend the full sequence."""
+    built) and must refuse rather than silently attend the full sequence —
+    on BOTH dispatch paths: the sharded schedule AND the batch-1 init
+    fallback (which never reaches the sharded factory, so a factory-only
+    raise would let init silently accept the window on the dense core)."""
     mesh = seq_mesh()
+    fn = make_ring_attention_fn(mesh)
     q, k, v = qkv()
     with pytest.raises(ValueError, match="ring attention does not support"):
-        make_ring_attention_fn(mesh)(q, k, v, causal=True, window=8)
+        fn(q, k, v, causal=True, window=8)
+    q1, k1, v1 = qkv(B=1)
+    with pytest.raises(ValueError, match="ring attention does not support"):
+        fn(q1, k1, v1, causal=True, window=8)
 
 
 @pytest.mark.slow

@@ -26,13 +26,19 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
                     head_dim: int, kv_heads: int = 0,
-                    steps: int = 50) -> list[dict]:
+                    steps: int = 50, window: int = 0) -> list[dict]:
     """Per-token decode attention: dense-masked vs windowed, same inputs.
 
     ``kv_heads`` (GQA) sizes the K/V buffers at fewer heads than the query;
     the dense comparator then scores ``repeat_kv``'d buffers (it has no
     grouped form — exactly why the HBM win exists), while the windowed path
     reads the grouped buffers natively.
+
+    ``window`` adds a third arm: the SLIDING-WINDOW walk (``--attention_window``
+    models), whose per-token time should be flat in the fill — it starts at
+    the window's first cache block, so reads are O(window) however deep the
+    generation. (Naming note: "windowed" in this tool's output predates the
+    sliding-window feature and means the blockwise prefix walk.)
     """
     import functools
 
@@ -78,6 +84,13 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
     # one must not reroute the windowed arm to dense. block=512 matches the
     # recorded PERF_ANALYSIS §9 table (the shipped walk uses 2048).
     windowed = functools.partial(decode_attention, block=512, dense_max=0)
+    sliding = (
+        functools.partial(
+            decode_attention, block=512, dense_max=0, window=window
+        )
+        if window
+        else None
+    )
 
     def make_loop(fn):
         # Device-looped timing: ONE dispatch runs `n` serialized executions
@@ -131,6 +144,10 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
             "windowed_us_per_token": round(us_win, 1),
             "speedup": round(us_dense / us_win, 2),
         })
+        if sliding is not None:
+            us_slide = clock(sliding, q, k_buf, v_buf, i)
+            rows[-1]["sliding_window"] = window
+            rows[-1]["sliding_us_per_token"] = round(us_slide, 1)
         print(json.dumps(rows[-1]))
     return rows
 
@@ -213,6 +230,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="GQA: K/V buffer heads (0 = --heads); the "
                         "windowed path reads the grouped buffers natively")
     parser.add_argument("--head_dim", type=int, default=64)
+    parser.add_argument("--window", type=int, default=0,
+                        help="sliding-window size: adds a third arm timing "
+                        "the O(window)-reads decode walk, which should be "
+                        "FLAT in the fill")
     parser.add_argument("--e2e", action="store_true",
                         help="also run the ~110M-LM generate() end-to-end")
     parser.add_argument("--quantize", default="none", choices=("none", "int8"),
@@ -229,7 +250,7 @@ def main(argv: list[str] | None = None) -> int:
     bench_attention(
         args.max_len, fills,
         batch=args.batch, heads=args.heads, head_dim=args.head_dim,
-        kv_heads=args.num_kv_heads,
+        kv_heads=args.num_kv_heads, window=args.window,
     )
     if args.e2e:
         bench_e2e(
