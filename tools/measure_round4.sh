@@ -56,6 +56,16 @@ step "4. 64k-token single-chip step (flash + remat + chunked loss)" 1800 \
     --num_layers 12 --num_heads 12 --head_dim 64 --d_model 768 --d_ff 3072 \
     --model_dir /tmp/m4_ckpt --log_dir /tmp/m4_logs
 
-echo "== 5. (opt-in, slow compile) 32k long-context bench entry =="
+step "5. sliding-window kernels at 32k (windowed vs full flash, fwd+bwd)" 1500 \
+    python tools/bench_flash.py --seqs 32768 --batch 1 --heads 12 \
+    --head_dim 64 --bwd --window 4096
+step "6. sliding-window decode flatness (8k buffer, window 2048)" 1200 \
+    python tools/bench_decode.py --max_len 8192 --fills 1024 4096 8192 \
+    --window 2048
+# The windowed 32k/64k e2e train numbers (52.4k tok/s at both lengths) are
+# the step-4 command plus --attention_window 4096 (and --seq_len 32768 for
+# the 32k point).
+
+echo "== 7. (opt-in, slow compile) 32k long-context bench entry =="
 echo "   run manually if the tunnel is healthy: python bench.py --long_context"
 exit $rc
