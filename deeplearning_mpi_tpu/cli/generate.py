@@ -235,6 +235,16 @@ def main(argv: list[str] | None = None) -> int:
         moe_routing=args.moe_routing,
         attention_window=args.attention_window,
     )
+    from deeplearning_mpi_tpu.utils import config
+
+    # Shape-changing mistakes fail at restore anyway; this catches the
+    # TREE-INVISIBLE ones (--attention_window, --moe_routing) that would
+    # otherwise silently decode with different semantics than the
+    # checkpoint was trained with.
+    err = config.arch_mismatch_error(cfg, ckpt_dir)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     model = TransformerLM(config=cfg, dtype=dtype)
     # The optimizer only shapes the restore template — the FAMILY must match

@@ -207,11 +207,26 @@ def main(argv: list[str] | None = None) -> int:
 
     state = state_factory()
 
-    checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    ckpt_dir = f"{args.model_dir}/{args.model_filename}"
+    checkpointer = Checkpointer(ckpt_dir)
     # restore_for_start can SystemExit (--eval_only with no checkpoint); it
     # must do so inside the try or the other hosts hang at their next
     # collective (bootstrap.shutdown never runs) and orbax threads leak.
+    # The arch guard sits inside for the same reason.
     try:
+        # Tree-invisible flags (--attention_window, --moe_routing) would
+        # otherwise train/eval/resume with silently different semantics
+        # than the directory's checkpoints — the array restore cannot catch
+        # them (config.save_arch's rationale). Guarded on EVERY start, so a
+        # fresh run into a dir holding a different architecture's epochs
+        # cannot re-stamp the sidecar out from under them; --eval_only is
+        # read-only (check, never write).
+        err = config.arch_mismatch_error(cfg, ckpt_dir)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        if not args.eval_only:
+            config.save_arch(cfg, ckpt_dir)
         state, start_epoch = config.restore_for_start(args, checkpointer, state, logger)
         trainer = Trainer(
             state, "lm", mesh,

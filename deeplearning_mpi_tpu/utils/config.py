@@ -166,6 +166,71 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
     return group
 
 
+def save_arch(cfg, ckpt_dir) -> None:
+    """Persist the model architecture next to the checkpoint (process 0).
+
+    The checkpoint stores arrays, not architecture; most wrong-flag serving
+    mistakes fail loudly anyway (a wrong ``--d_model`` is a shape mismatch,
+    a wrong ``--optimizer`` an opt-state tree mismatch). But two knobs are
+    TREE-INVISIBLE: ``--attention_window`` and ``--moe_routing`` change
+    semantics without changing a single array shape, so serving a
+    window-trained checkpoint without the flag would silently decode with
+    full attention. ``arch.json`` closes that hole:
+    ``arch_mismatch_error`` refuses the mismatch at every start (train,
+    resume, eval_only, generate).
+    """
+    import dataclasses
+    import json
+    from pathlib import Path
+
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    path = Path(ckpt_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "arch.json").write_text(json.dumps(dataclasses.asdict(cfg)))
+
+
+def arch_mismatch_error(cfg, ckpt_dir) -> str | None:
+    """Formatted refusal message if ``cfg`` differs from the checkpoint
+    directory's saved ``arch.json`` — ``None`` if they match or the
+    checkpoint predates arch sidecars (old checkpoints keep working; only
+    fields present in the file are compared, so new config fields stay
+    forward-compatible). One formatter for every caller (train resume,
+    eval_only, fresh-train-into-existing-dir, generate), so the message
+    and its remedy hint cannot drift between CLIs.
+
+    Multi-host note: all processes read the same file — the checkpoint
+    directory is on a shared filesystem by requirement (orbax multi-host
+    save/restore already assumes it), so every host reaches the same
+    verdict and exits together rather than diverging into a hung
+    collective.
+    """
+    import dataclasses
+    import json
+    from pathlib import Path
+
+    path = Path(ckpt_dir) / "arch.json"
+    if not path.is_file():
+        return None
+    saved = json.loads(path.read_text())
+    current = dataclasses.asdict(cfg)
+    lines = [
+        f"{key}: checkpoint={saved[key]!r}, flags={current[key]!r}"
+        for key in saved
+        if key in current and saved[key] != current[key]
+    ]
+    if not lines:
+        return None
+    return (
+        "checkpoint architecture does not match the flags:\n  "
+        + "\n  ".join(lines)
+        + f"\n(sidecar: {path}; pass matching flags, or use a fresh "
+        "--model_dir to train a different architecture)"
+    )
+
+
 def build_lr(args: argparse.Namespace, train_loader) -> object:
     """Resolve the shared LR flags into what ``build_optimizer`` takes.
 
