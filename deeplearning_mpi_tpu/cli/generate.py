@@ -48,12 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "itself is unused at inference; nonzero = on)")
     parser.add_argument("--optimizer", default="adam",
                         choices=("sgd", "adam", "adamw", "adafactor", "lion"),
-                        help="set to the training run's --optimizer when it "
-                        "wasn't adam: the optimizer family shapes the "
-                        "restore template's opt-state tree (adafactor's "
-                        "factored moments, lion's single moment), which "
-                        "must match the checkpoint exactly; its "
-                        "hyperparameters are irrelevant at inference")
+                        help="accepted for backward compatibility and "
+                        "IGNORED: restore is params-only (the optimizer "
+                        "state is never read), so serving no longer depends "
+                        "on the training run's optimizer family or "
+                        "hyperparameters")
     parser.add_argument("--epoch", type=int, default=None,
                         help="checkpoint epoch to load (default: latest)")
     gen = parser.add_argument_group("generation")
@@ -193,10 +192,11 @@ def main(argv: list[str] | None = None) -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    import optax
+
     from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
     from deeplearning_mpi_tpu.models.generate import generate_jit
     from deeplearning_mpi_tpu.train import Checkpointer, create_train_state
-    from deeplearning_mpi_tpu.train.trainer import build_optimizer
 
     # Fail BEFORE the (potentially minutes-long) model/optimizer init, and
     # without Checkpointer's create=True side-effect mkdir on a typo'd path.
@@ -247,14 +247,16 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     model = TransformerLM(config=cfg, dtype=dtype)
-    # The optimizer only shapes the restore template — the FAMILY must match
-    # the training run's (--optimizer), the hyperparameters are irrelevant
-    # for inference. The dummy input is short on purpose: params are
-    # sequence-independent (RoPE, no position table), and a full --seq_len
-    # dense init would do O(S^2) work — fatal for long-context checkpoints.
+    # optax.identity(): restore is params-only (the checkpoint's opt_state
+    # bytes are never read), so the template needs no real optimizer — any
+    # family/hyperparameter combination at training time serves unchanged,
+    # and no moment memory is ever initialized. The dummy input is short on
+    # purpose: params are sequence-independent (RoPE, no position table),
+    # and a full --seq_len dense init would do O(S^2) work — fatal for
+    # long-context checkpoints.
     template = create_train_state(
         model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
-        build_optimizer(args.optimizer, 1e-3, clip_norm=1.0),
+        optax.identity(),
         ema=args.ema > 0,
     )
     if mesh is not None:
@@ -269,7 +271,7 @@ def main(argv: list[str] | None = None) -> int:
         template = shard_state(template, mesh)
     ckpt = Checkpointer(ckpt_dir)
     try:
-        state = ckpt.restore(template, epoch=args.epoch)
+        state = ckpt.restore_params_only(template, epoch=args.epoch)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 1

@@ -208,4 +208,9 @@ def make_ring_attention_fn(
     return with_divisibility_fallback(
         mesh, batch_axes, seq_axis, _sharded, dense_attention,
         supports_window=False,
+        window_error=(
+            "ring attention does not support sliding-window attention; "
+            "use --attention ulysses (window passes through its "
+            "full-sequence inner core) or flash"
+        ),
     )

@@ -17,6 +17,7 @@ def with_divisibility_fallback(
     fallback: Callable,
     *,
     supports_window: bool = True,
+    window_error: str | None = None,
 ) -> Callable:
     """Wrap a seq-parallel attention schedule with a static-shape fallback.
 
@@ -28,10 +29,11 @@ def with_divisibility_fallback(
     (trace-time shapes), so jit caches one program per shape as usual.
 
     ``window`` is forwarded to both paths; a schedule that cannot honor it
-    (the ring) passes ``supports_window=False`` and the wrapper rejects the
-    kwarg up front — HERE, not inside ``sharded``, because the batch-1
-    init fallback never reaches the sharded factory and would otherwise
-    silently accept the window on the dense core.
+    passes ``supports_window=False`` with its own ``window_error`` message
+    (the caller knows its name and the alternatives to suggest) and the
+    wrapper rejects the kwarg up front — HERE, not inside ``sharded``,
+    because the batch-1 init fallback never reaches the sharded factory and
+    would otherwise silently accept the window on the dense core.
     """
     batch_list = [batch_axes] if isinstance(batch_axes, str) else list(batch_axes)
     dp = 1
@@ -42,9 +44,9 @@ def with_divisibility_fallback(
     def attention_fn(q, k, v, *, causal: bool = True, window: int | None = None):
         if window is not None and not supports_window:
             raise ValueError(
-                "ring attention does not support sliding-window attention; "
-                "use --attention ulysses (window passes through its "
-                "full-sequence inner core) or flash"
+                window_error
+                or "this attention schedule does not support sliding-window "
+                "attention"
             )
         if q.shape[0] % dp == 0 and q.shape[1] % sp == 0:
             return sharded(causal, window)(q, k, v)

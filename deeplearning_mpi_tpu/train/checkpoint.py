@@ -56,15 +56,97 @@ class Checkpointer:
     def restore(self, template: TrainState, *, epoch: int | None = None) -> TrainState:
         """Restore into the shardings/dtypes of ``template`` (a freshly
         created state — supplies apply_fn/tx, which are code, not data)."""
+        restored = self.manager.restore(
+            self._resolve_epoch(epoch),
+            args=ocp.args.StandardRestore(_arrays_only(template)),
+        )
+        return template.replace(**restored)
+
+    def restore_params_only(
+        self, template: TrainState, *, epoch: int | None = None
+    ) -> TrainState:
+        """Restore the weights (params/batch_stats/step, plus the EMA subtree
+        when the template tracks one) WITHOUT reading the optimizer state.
+
+        Inference needs weights, not moments — restoring through the
+        full-state path forces serving to reconstruct the training run's
+        exact optax tree (family AND hyperparameters: adafactor with a
+        nonzero ``weight_decay_rate`` appends a transform element, changing
+        the tuple arity). Orbax partial restore skips the ``opt_state``
+        subtree entirely — its bytes are never read — so the returned
+        state keeps the template's (trivial) opt_state; serving templates
+        pass ``optax.identity()`` and pay no moment-init memory at all.
+
+        The EMA guard is correctness-bearing in BOTH directions, because
+        partial restore cannot fail on the subtree by itself: a template
+        without ``ema_params`` simply never asks for it (a forgotten
+        ``--ema`` would silently serve the raw last-step weights), and a
+        template WITH it against an EMA-less checkpoint silently keeps the
+        template's freshly-initialized copy (measured: orbax 0.11 leaves a
+        requested-but-absent key untouched instead of erroring). Both
+        mismatches are refused loudly against the checkpoint's actual
+        saved-tree keys before any bytes are read.
+        """
+        epoch = self._resolve_epoch(epoch)
+        saved = self._saved_tree_keys(epoch)
+        if template.ema_params is not None and "ema_params" not in saved:
+            raise ValueError(
+                "checkpoint has no EMA weights (trained without --ema) but "
+                "the restore template tracks an EMA subtree — drop --ema"
+            )
+        if template.ema_params is None and "ema_params" in saved:
+            raise ValueError(
+                "checkpoint carries EMA weights (trained with --ema) "
+                "but the restore template has no EMA subtree — pass "
+                "--ema to serve the averaged weights"
+            )
+        item: dict[str, Any] = {
+            "step": template.step,
+            "params": template.params,
+            "batch_stats": template.batch_stats,
+        }
+        if template.ema_params is not None:
+            item["ema_params"] = template.ema_params
+        restored = self.manager.restore(
+            epoch,
+            args=ocp.args.PyTreeRestore(
+                item=item,
+                # Template shardings travel via restore_args; without them
+                # orbax would fall back to the shardings recorded at save
+                # time (wrong topology for --tp serving of a 1-device-
+                # trained checkpoint).
+                restore_args=ocp.checkpoint_utils.construct_restore_args(item),
+                partial_restore=True,
+            ),
+        )
+        return template.replace(**restored)
+
+    def _resolve_epoch(self, epoch: int | None) -> int:
         self.manager.wait_until_finished()  # in-flight async save must land first
         if epoch is None:
             epoch = self.manager.latest_step()
         if epoch is None:
             raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        restored = self.manager.restore(
-            epoch, args=ocp.args.StandardRestore(_arrays_only(template))
+        return epoch
+
+    def _saved_tree_keys(self, epoch: int) -> set[str]:
+        """Top-level keys of the saved tree.
+
+        Read through a short-lived metadata-only manager: ``item_metadata``
+        needs a handler registry, but registering one on ``self.manager``
+        pins its args types to Standard* and rejects the PyTreeRestore that
+        partial restore requires (measured on orbax 0.11). The manager owns
+        step-path resolution, so no on-disk layout is hardcoded here.
+        Fail-loud on an unreadable tree: the EMA guard above is
+        correctness-bearing, not advisory.
+        """
+        probe = ocp.CheckpointManager(
+            self.directory, item_handlers=ocp.StandardCheckpointHandler()
         )
-        return template.replace(**restored)
+        try:
+            return set(probe.item_metadata(epoch).keys())
+        finally:
+            probe.close()
 
     def close(self) -> None:
         self.manager.close()

@@ -85,11 +85,16 @@ class TestSlidingWindow:
     (degenerates to plain causal). The dense oracle's own window mask is
     three lines of iota arithmetic, independently checkable by eye."""
 
+    # Rectangular (block_q != block_k) pairs exercise the asymmetric
+    # span/anchor arithmetic of the trimmed grid (ADVICE r4: square-only
+    # coverage left the bq != bk branches untested).
+    @pytest.mark.parametrize("block_q,block_k", [(16, 16), (8, 32), (32, 8)])
     @pytest.mark.parametrize("window", [8, 16, 24, 56, 1000])
-    def test_forward_matches_windowed_dense(self, window):
+    def test_forward_matches_windowed_dense(self, window, block_q, block_k):
         q, k, v = qkv()
         out = flash_attention(
-            q, k, v, causal=True, window=window, block_q=16, block_k=16
+            q, k, v, causal=True, window=window,
+            block_q=block_q, block_k=block_k,
         )
         ref = dense_attention(q, k, v, causal=True, window=window)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
@@ -117,15 +122,17 @@ class TestSlidingWindow:
     # unclamped, dk/dv silently dropped the earliest in-window q blocks
     # (found by review, verified numerically: O(1) absolute dk/dv error).
     @pytest.mark.slow
+    @pytest.mark.parametrize("block_q,block_k", [(16, 16), (8, 16), (16, 8)])
     @pytest.mark.parametrize("window", [8, 24, 50, 56])
-    def test_grads_match_windowed_dense(self, window):
+    def test_grads_match_windowed_dense(self, window, block_q, block_k):
         q, k, v = qkv(S=64)
 
         def loss(attn, q, k, v):
             return jnp.sum(attn(q, k, v) ** 2)
 
         flash = lambda q, k, v: flash_attention(  # noqa: E731
-            q, k, v, causal=True, window=window, block_q=16, block_k=16
+            q, k, v, causal=True, window=window,
+            block_q=block_q, block_k=block_k,
         )
         dense = lambda q, k, v: dense_attention(  # noqa: E731
             q, k, v, causal=True, window=window
