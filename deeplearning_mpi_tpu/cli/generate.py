@@ -96,9 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                      "scales after restore (checkpoints stay full-precision)"
                      " — halves parameter HBM reads per token vs bfloat16")
     gen.add_argument("--time", action="store_true",
-                     help="print decode throughput to stderr (runs the "
-                     "program twice: an untimed compile pass, then a timed "
-                     "pass on the cached executable)")
+                     help="print serving throughput to stderr (runs each "
+                     "phase twice: an untimed compile pass, then a timed "
+                     "pass on the cached executable). Sampling with "
+                     "uniform prompts reports the honest prefill/decode "
+                     "split (prefill tokens/s is the batched cache-fill "
+                     "forward; decode tokens/s counts ONLY generated "
+                     "tokens); beam/ragged paths report whole-program "
+                     "positions/s")
     run = parser.add_argument_group("runtime")
     run.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     run.add_argument("--n_virtual_devices", type=int, default=None)
@@ -346,8 +351,81 @@ def main(argv: list[str] | None = None) -> int:
         def call():
             return fn(params, prompt, rng, prompt_lens)
 
-    out = call()
-    if args.time:
+    if args.time and args.num_beams == 1 and prompt_lens is None:
+        # Honest split timing: phase-separate jits so prefill (one batched
+        # MXU-bound forward over the prompt) and decode (the HBM-bound
+        # per-token cache walk, generated tokens ONLY) each get their own
+        # number — one fused program would re-conflate them into the
+        # "positions/s" figure the round-4 review called flattered. The
+        # rng handling mirrors generate()'s fast path exactly, so the
+        # emitted text equals the untimed run's.
+        import time
+
+        from deeplearning_mpi_tpu.models.generate import (
+            decode_tokens,
+            first_token,
+            prefill,
+        )
+        from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+        p_len = prompt.shape[1]
+        total = p_len + args.max_new_tokens
+        temperature = 0.0 if args.greedy else args.temperature
+        top_k = 0 if args.greedy else args.top_k
+        top_p = 1.0 if args.greedy else args.top_p
+
+        @jax.jit
+        def run_prefill(params, prompt):
+            return prefill(model, params, prompt, total_len=total)
+
+        @jax.jit
+        def run_decode(params, cache, first, rng, done):
+            return decode_tokens(
+                model, params, cache, first,
+                start=p_len, steps=args.max_new_tokens, rng=rng,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, done=done,
+            )
+
+        def measure(thunk, sync_of):
+            # host_sync, not block_until_ready: the latter can return
+            # before remote execution finishes on the tunneled TPU.
+            host_sync(sync_of(thunk()).ravel()[:1])  # compile + warm
+            t0 = time.perf_counter()
+            r = thunk()
+            host_sync(sync_of(r).ravel()[:1])
+            return r, time.perf_counter() - t0
+
+        (cache, logits), dt_pre = measure(
+            lambda: run_prefill(params, prompt), lambda r: r[1]
+        )
+        # first_token is the SHARED seed step with generate()'s fast path
+        # — same rng split order, same EOS done-seed — so the timed run
+        # emits exactly the untimed run's text.
+        first, done, rng = first_token(
+            logits, jax.random.key(args.random_seed),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id,
+        )
+        new, dt_dec = measure(
+            lambda: run_decode(params, cache, first, rng, done), lambda r: r
+        )
+        out = jnp.concatenate([prompt, new], axis=1)
+        batch = prompt.shape[0]
+        # The decode phase executed max_new - 1 model steps (the first
+        # generated token came from the prefill logits) — the rate divides
+        # by what actually ran, not the tokens returned.
+        dec_steps = max(args.max_new_tokens - 1, 1)
+        print(
+            f"prefill: {batch * p_len} tokens in {dt_pre:.3f}s = "
+            f"{batch * p_len / dt_pre:.1f} tokens/s | decode: "
+            f"{batch * dec_steps} steps in {dt_dec:.3f}s = "
+            f"{batch * dec_steps / dt_dec:.1f} tokens/s",
+            file=sys.stderr,
+        )
+    else:
+        out = call()
+    if args.time and (args.num_beams > 1 or prompt_lens is not None):
         import time
 
         from deeplearning_mpi_tpu.utils.profiling import host_sync
@@ -359,10 +437,10 @@ def main(argv: list[str] | None = None) -> int:
         out = call()
         host_sync(out.ravel()[:1])
         dt = time.perf_counter() - t0
-        # The scan decodes EVERY position (prompt prefill + new tokens) at
-        # identical per-step cost, so throughput is per position — dividing
-        # by max_new_tokens alone would understate it for long prompts.
-        # Batch mode decodes all rows in one program: count them all.
+        # The beam/ragged scan decodes EVERY position (prompt prefill + new
+        # tokens) at identical per-step cost, so throughput is per position
+        # — dividing by max_new_tokens alone would understate it for long
+        # prompts. Batch mode decodes all rows in one program: count all.
         positions = out.shape[0] * (prompt.shape[1] + args.max_new_tokens)
         print(
             f"decode: {positions} positions ({args.max_new_tokens} new) in "

@@ -62,6 +62,179 @@ def sample_logits(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _prefill_attention_fn() -> Any:
+    """Full-sequence core for the prefill chunk: the Pallas flash kernel on
+    TPU (O(P) memory — a 64k prompt prefills without materializing [P, P]
+    scores), dense elsewhere (the Pallas interpreter is far slower than XLA
+    on CPU). BSHD entry — the decode-mode projections are BSHD."""
+    if jax.default_backend() == "tpu":
+        from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention
+    return None
+
+
+def prefill(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    total_len: int,
+    attention_fn: Any = None,
+    last_logits_only: bool = True,
+) -> tuple[Any, jax.Array]:
+    """Fill a fresh KV cache with ``prompt`` ``[B, P]`` in ONE forward pass.
+
+    Returns ``(cache, logits)`` where ``cache`` has positions ``0..P-1``
+    written (``cache_index == P``) and ``logits`` is ``[B, V]`` — the LAST
+    position's logits, the only ones generation needs. ``last_logits_only=
+    False`` returns the full ``[B, P, V]`` instead (tests/scoring) — NOT
+    the serving default because the full f32 logits tensor is enormous at
+    batch (32 x 1920 x 32000 f32 = 7.9 GB, a measured on-chip OOM); with
+    tied embeddings the last-only path runs the head matmul on one row via
+    ``return_prehead``, never materializing the rest.
+
+    This is the serving-side half of the prefill/decode split: prompt
+    ingestion is MXU-bound batched matmuls (the same compute shape as a
+    training forward, flash-kernel capable), while generation stays the
+    HBM-bound single-token cache walk. The prior design fed prompt tokens
+    through the decode step one at a time — P sequential, latency-bound
+    steps for work that is one batched forward (the round-4 verdict's
+    "prefill-flattered" serving metric came from exactly that conflation).
+
+    The cache is created here (empty) and written once — the "prefill on an
+    empty cache only" contract of ``Attention.decode == 'prefill'`` holds by
+    construction. MoE models prefill with TRAINING routing semantics
+    (capacity limits can drop prompt tokens exactly as training would),
+    where the stepwise path never dropped — train/serve consistency over
+    the old accident.
+    """
+    if attention_fn is None:
+        attention_fn = _prefill_attention_fn()
+    last_via_prehead = last_logits_only and model.config.tied_embeddings
+    prefill_model = dataclasses.replace(
+        model, decode="prefill", attention_fn=attention_fn,
+        return_prehead=last_via_prehead,
+    )
+    batch = prompt.shape[0]
+    cache = prefill_model.init(
+        jax.random.key(0), jnp.zeros((batch, total_len), jnp.int32)
+    )["cache"]
+    out, mutated = prefill_model.apply(
+        {"params": params, "cache": cache},
+        prompt,
+        mutable=["cache"],
+    )
+    if last_via_prehead:
+        x, head = out  # [B, P, d], [d, V]
+        # Same numerics as Embed.attend on the last row: dtype-cast matmul,
+        # f32 result.
+        logits = (
+            x[:, -1].astype(model.dtype) @ head.astype(model.dtype)
+        ).astype(jnp.float32)
+    elif last_logits_only:
+        logits = out[:, -1]  # untied head: full logits, slice (rare path)
+    else:
+        logits = out
+    return mutated["cache"], logits
+
+
+def first_token(
+    logits: jax.Array,
+    rng: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Sample the first generated token from the prefill's ``[B, V]`` logits.
+
+    Returns ``(token, done, rng)`` — the shared seed step between the two
+    phases. ONE definition, used by :func:`generate`'s fast path AND the
+    CLI's phase-timed path, so their rng streams and EOS done-seeds cannot
+    drift apart (the timed run must emit the same text as the untimed one).
+    """
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(
+        logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
+    )
+    done = (
+        tok == eos_id if eos_id is not None
+        else jnp.zeros(tok.shape, bool)
+    )
+    return tok, done, rng
+
+
+def decode_tokens(
+    model: TransformerLM,
+    params: Any,
+    cache: Any,
+    first_token: jax.Array,
+    *,
+    start: int,
+    steps: int,
+    rng: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_id: int | None = None,
+    done: jax.Array | None = None,
+) -> jax.Array:
+    """Autoregressively decode from a filled cache: ``steps - 1`` model steps.
+
+    ``first_token`` ``[B]`` is the token at position ``start`` — already
+    sampled (from the prefill's last logits), so the scan feeds it and
+    samples ``steps - 1`` more. Returns ``[B, steps]`` — the tokens at
+    positions ``start .. start + steps - 1``. Timing note: a caller
+    reporting a decode rate over this call must divide by the ``steps - 1``
+    model steps actually executed, not the ``steps`` tokens returned — the
+    first returned token was the PREFILL phase's sample (counting it
+    flattered the rate by 1/steps; review r5).
+
+    ``done`` ``[B]`` bool marks rows already finished (their first token was
+    EOS); finished rows emit ``eos_id`` forever, matching the uniform-scan
+    semantics.
+    """
+    if steps < 1:
+        raise ValueError(f"decode_tokens needs steps >= 1, got {steps}")
+    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
+    batch = first_token.shape[0]
+    if done is None:
+        done = jnp.zeros((batch,), bool)
+
+    def body(carry, i):
+        cache, tok, rng, done = carry
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((batch, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        next_tok = sample_logits(
+            logits[:, 0], sub, temperature=temperature, top_k=top_k,
+            top_p=top_p,
+        )
+        if eos_id is not None:
+            next_tok = jnp.where(done, eos_id, next_tok)
+            done = done | (next_tok == eos_id)
+        return (mutated["cache"], next_tok, rng, done), tok
+
+    # steps - 1 decode iterations: the final carry token is position
+    # start + steps - 1; decoding it further would produce a token outside
+    # the returned window.
+    (_, last, _, _), toks = lax.scan(
+        body, (cache, first_token, rng, done),
+        jnp.arange(start, start + steps - 1),
+    )
+    return jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+    )
+
+
 def generate(
     model: TransformerLM,
     params: Any,
@@ -77,9 +250,13 @@ def generate(
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
 
-    Returns ``[B, P + max_new_tokens]`` (prompt included). The decode-mode
-    twin of ``model`` shares its params; the cache sized ``P + max_new`` is
-    created by a decode-mode ``init`` and threaded through the scan.
+    Returns ``[B, P + max_new_tokens]`` (prompt included). Uniform-length
+    prompts (``prompt_lens is None``) take the two-phase path: one batched
+    :func:`prefill` forward over the prompt (MXU-bound, flash-kernel
+    capable), then a :func:`decode_tokens` scan over ONLY the new tokens —
+    O(P) sequential steps cheaper than scanning every position. Ragged
+    batches keep the uniform scan (each row switches from prompt to samples
+    at its own length mid-scan, which has no single prefill boundary).
 
     ``eos_id``: once a row SAMPLES that token, every later position in the
     row is forced to ``eos_id`` (the scan's shapes are static, so "stop"
@@ -94,13 +271,26 @@ def generate(
     output at ``prompt_lens[b] + max_new_tokens`` if you want exactly
     ``max_new_tokens`` from every row.
     """
-    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
-    plens = (
-        jnp.full((batch,), prompt_len, jnp.int32)
-        if prompt_lens is None else prompt_lens.astype(jnp.int32)
-    )
+    if prompt_lens is None:
+        if max_new_tokens < 1:
+            return prompt  # [B, P + 0]: nothing to generate, nothing run
+        cache, logits = prefill(model, params, prompt, total_len=total)
+        first, done, rng = first_token(
+            logits, rng, temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id,
+        )
+        new = decode_tokens(
+            model, params, cache, first,
+            start=prompt_len, steps=max_new_tokens, rng=rng,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id, done=done,
+        )
+        return jnp.concatenate([prompt, new], axis=1)
+
+    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
+    plens = prompt_lens.astype(jnp.int32)
 
     # Decode-mode init with the full-length input shapes the cache buffers;
     # params from init are discarded (we use the trained ones).

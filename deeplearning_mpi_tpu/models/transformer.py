@@ -191,7 +191,14 @@ class Attention(nn.Module):
     head_dim: int
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
-    decode: bool = False
+    #: ``False`` = full-sequence training/eval forward. ``True`` = KV-cached
+    #: single-token decode. ``"prefill"`` = the cache-WRITING full-sequence
+    #: pass: a multi-token chunk is projected once, written into the cache
+    #: buffers, and attended with the full-sequence core (flash on TPU) —
+    #: O(P) sequential steps become one MXU-batched forward. Valid ONLY on a
+    #: fresh (empty) cache: the chunk attends within itself, not to prior
+    #: cache rows (``models.generate.prefill`` owns that contract).
+    decode: bool | str = False
     #: grouped-query attention: number of shared K/V heads (None = num_heads,
     #: plain MHA). K/V are projected and CACHED at this head count — the KV
     #: cache and decode HBM reads shrink by num_heads/num_kv_heads — and the
@@ -290,10 +297,12 @@ class Attention(nn.Module):
         )
         if self.is_initializing():
             return jnp.zeros_like(q)
-        if seq != 1:
+        if seq != 1 and self.decode != "prefill":
             raise ValueError(
                 f"decode mode feeds one token per step, got seq={seq}; "
-                "initialize the cache with the full [B, max_len] shape"
+                "initialize the cache with the full [B, max_len] shape "
+                "(multi-token cache writes need the 'prefill' twin — "
+                "models.generate.prefill)"
             )
         i = index.value
         new_k = lax.dynamic_update_slice(
@@ -303,7 +312,20 @@ class Attention(nn.Module):
             cached_v.value, v.astype(self.dtype), (0, i, 0, 0)
         )
         cached_k.value, cached_v.value = new_k, new_v
-        index.value = i + 1
+        index.value = i + seq
+        if seq != 1:
+            # Prefill: the chunk attends within itself — exactly the
+            # training-path full-sequence attention (flash kernel capable,
+            # O(seq) memory), not seq sequential cache walks. Correct only
+            # when the cache was empty (i == 0, untracked here — traced);
+            # the prefill twin's contract. GQA repeats K/V for the
+            # full-sequence core like the non-decode path does.
+            rep = q.shape[2] // k.shape[2]
+            attn = self.attention_fn or dense_attention
+            return attn(
+                q, repeat_kv(k, rep), repeat_kv(v, rep), causal=True,
+                **self._window_kw(),
+            )
         # decode_attention picks its schedule at trace time on the static
         # buffer length: one fused masked einsum at the HBM roofline for
         # buffers <= DECODE_DENSE_MAX (reads all rows — safe because this
@@ -339,7 +361,7 @@ class Block(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
     mlp_cls: type[nn.Module] | None = None
-    decode: bool = False
+    decode: bool | str = False  # False | True | "prefill" (see Attention)
     num_kv_heads: int | None = None
     quantized: bool = False
     #: False = bidirectional attention (encoder stacks: ViT); True = the
@@ -429,7 +451,8 @@ class TransformerLM(nn.Module):
     attention_fn: AttentionFn | None = None
     remat: bool = False
     mlp_cls: type[nn.Module] | None = None
-    decode: bool = False  # KV-cached single-token autoregressive mode
+    #: False | True | "prefill": KV-cached decode modes (see Attention.decode)
+    decode: bool | str = False
     #: return (final-norm activations, head kernel [d, V]) instead of
     #: logits, for the chunked head+loss path (``ops.loss.chunked_lm_loss``)
     #: that never materializes [B, S, V] logits. Tied embeddings only — the

@@ -106,6 +106,96 @@ class TestCachedDecode:
             raise AssertionError("expected ValueError for seq>1 decode step")
 
 
+class TestPrefill:
+    """Batched cache-fill forward vs the stepwise decode ground truth."""
+
+    @pytest.mark.parametrize("make_cfg", [_dense_cfg, _gqa_cfg, _windowed_cfg],
+                             ids=["dense", "gqa", "windowed"])
+    def test_prefill_matches_stepwise_cache_and_logits(self, make_cfg):
+        """One prefill forward must leave the cache in the same state as
+        feeding the prompt token by token, and its logits must equal the
+        full causal forward — the two-phase serving path's correctness
+        contract (GQA caches grouped heads; windowed masks the chunk)."""
+        import dataclasses as dc
+
+        from deeplearning_mpi_tpu.models.generate import prefill
+
+        seq, total = 12, 16
+        model = TransformerLM(config=make_cfg(), dtype=jnp.float32)
+        tokens_init = jnp.zeros((2, total), jnp.int32)
+        params = model.init(jax.random.key(0), tokens_init)["params"]
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 256, (2, seq)), jnp.int32)
+
+        full_logits = model.apply({"params": params}, tokens)
+        cache_pre, logits_pre = prefill(
+            model, params, tokens, total_len=total, last_logits_only=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_pre), np.asarray(full_logits), atol=2e-4
+        )
+        # The serving default (last-only via return_prehead) must agree
+        # with the full path's final position.
+        _, logits_last = prefill(model, params, tokens, total_len=total)
+        np.testing.assert_allclose(
+            np.asarray(logits_last), np.asarray(full_logits[:, -1]),
+            atol=2e-4,
+        )
+
+        decode_model = dc.replace(model, decode=True)
+        cache_step = decode_model.init(jax.random.key(0), tokens_init)["cache"]
+        for i in range(seq):
+            _, mutated = decode_model.apply(
+                {"params": params, "cache": cache_step},
+                tokens[:, i : i + 1],
+                positions=jnp.full((2, 1), i, jnp.int32),
+                mutable=["cache"],
+            )
+            cache_step = mutated["cache"]
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            cache_pre, cache_step,
+        )
+
+    def test_fast_path_equals_uniform_scan(self):
+        """Greedy generate via prefill+decode must emit byte-identical
+        output to the uniform scan (forced via prompt_lens) — the fast path
+        is an execution-schedule change, not a semantics change."""
+        model, params = _model_and_params(seq=16)
+        rng = np.random.default_rng(7)
+        prompt = jnp.asarray(rng.integers(0, 256, (2, 5)), jnp.int32)
+        fast = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+        )
+        scan = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+            prompt_lens=jnp.asarray([5, 5], jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(scan))
+
+    def test_fast_path_eos_pads(self):
+        """EOS stop-and-pad semantics hold on the two-phase path, including
+        an EOS sampled as the very FIRST generated token (the done seed)."""
+        model, params = _model_and_params(seq=16)
+        prompt = jnp.asarray([[7, 7, 2]], jnp.int32)
+        free = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+        )
+        first = int(np.asarray(free)[0, 3])
+        out = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0, eos_id=first,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out)[0, 3:], np.full(6, first)
+        )
+
+
 class TestGenerate:
     @pytest.mark.slow
     def test_greedy_matches_iterated_full_forward(self):
