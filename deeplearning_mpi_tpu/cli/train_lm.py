@@ -129,17 +129,6 @@ def main(argv: list[str] | None = None) -> int:
         num_workers=args.num_workers,
     )
 
-    if args.attention_window and args.attention == "ring":
-        # The ring schedule's rotating K/V shards would need window-aware
-        # rotation skipping (not built); Ulysses composes (its inner core
-        # sees the full sequence). Reject before any compile.
-        print(
-            "--attention_window is not supported with --attention ring; "
-            "use ulysses, flash, or dense",
-            file=sys.stderr,
-        )
-        return 1
-
     attention_fn = None
     if args.attention == "flash":
         # The BHSD-native entry: Attention sees .layout == 'bhsd' and

@@ -214,8 +214,10 @@ class Attention(nn.Module):
     #: oracle or flash kernels, which skip out-of-window blocks), AND the
     #: KV-cached decode walk (which then starts at the window's first cache
     #: block: O(window) HBM reads per token however long the generation).
-    #: Ulysses sequence parallelism composes (full-sequence inner core);
-    #: the ring schedule rejects a window (rotation skipping not built).
+    #: Both SP schedules compose: Ulysses passes the window through to its
+    #: full-sequence inner core; the ring statically trims its rotation
+    #: schedule to the shards any query's window reaches (rotation
+    #: skipping, ``parallel.ring_attention.windowed_rotations``).
     window: int = 0
 
     @nn.compact

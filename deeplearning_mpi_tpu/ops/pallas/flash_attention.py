@@ -49,17 +49,25 @@ def _swap_sh(x: jax.Array) -> jax.Array:
 
 
 def _blocks_interact(i, j, *, causal: bool, window: int | None,
-                     block_q: int, block_k: int):
+                     block_q: int, block_k: int, shift: int = 0):
     """Whether (q block ``i``, kv block ``j``) has any unmasked pair — the
     ``pl.when`` gate that skips whole tiles. Causal skips kv blocks wholly in
     the future; ``window`` (sliding-window attention) additionally skips kv
     blocks wholly before every query's window, which is where the O(S·W)
     cost of windowed attention comes from (the per-element mask alone would
-    still pay O(S²/2) matmuls)."""
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else True
+    still pay O(S²/2) matmuls).
+
+    ``shift`` is a STATIC global offset added to every q position: the ring
+    schedule calls the kernels once per rotation with the visiting K/V block
+    ``t`` shards behind the resident Q shard, i.e. every query sits
+    ``shift = t * s_local`` positions after the keys — the same trimmed-grid
+    arithmetic then windows the off-diagonal rotations (rotation skipping's
+    in-block half)."""
+    q_hi = i * block_q + block_q - 1 + shift
+    run = (j * block_k <= q_hi) if causal else True
     if window is not None:
         newest_key = (j + 1) * block_k - 1
-        oldest_window_pos = i * block_q - (window - 1)
+        oldest_window_pos = i * block_q + shift - (window - 1)
         run = run & (newest_key >= oldest_window_pos)
     return run
 
@@ -84,10 +92,11 @@ def _window_span(window: int, block_stream: int, block_resident: int,
 
 
 def _pair_mask(s_shape, i, j, *, window: int | None,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, shift: int = 0):
     """Causal (+ window) mask for one ``[bq, bk]`` score tile, in global
-    coordinates."""
-    q_pos = i * block_q + lax.broadcasted_iota(jnp.int32, s_shape, 0)
+    coordinates (``shift`` = static q-position offset, see
+    :func:`_blocks_interact`)."""
+    q_pos = i * block_q + shift + lax.broadcasted_iota(jnp.int32, s_shape, 0)
     k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, s_shape, 1)
     mask = q_pos >= k_pos
     if window is not None:
@@ -98,7 +107,7 @@ def _pair_mask(s_shape, i, j, *, window: int | None,
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, *rest,
     causal: bool, scale: float, block_q: int, block_k: int, with_lse: bool,
-    window: int | None = None,
+    window: int | None = None, shift: int = 0, n_kv_blocks: int = 0,
 ):
     if with_lse:
         lse_ref, acc_ref, m_ref, l_ref = rest
@@ -116,15 +125,22 @@ def _fwd_kernel(
 
     # Under a window the kv grid axis is TRIMMED (see _window_span): grid
     # step jj maps to global kv block j anchored at this q block's causal
-    # frontier. Without one, the axis is the full kv range and jj == j.
+    # frontier — clamped to the last real kv block, since a nonzero shift
+    # pushes the frontier past the buffer (the span still covers the whole
+    # window; over-enumerated stale blocks gate off). Without a window, the
+    # axis is the full kv range and jj == j.
     if window is not None:
-        j = ((i + 1) * block_q - 1) // block_k - (nk - 1) + jj
+        anchor = jnp.minimum(
+            ((i + 1) * block_q - 1 + shift) // block_k, n_kv_blocks - 1
+        )
+        j = anchor - (nk - 1) + jj
     else:
         j = jj
     # Causal: skip kv blocks wholly in the future; window: also wholly-stale
     # ones and the clamped-to-0 reads below the sequence start.
     run = _blocks_interact(
-        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k
+        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k,
+        shift=shift,
     )
     if window is not None:
         run = run & (j >= 0)
@@ -139,7 +155,8 @@ def _fwd_kernel(
         ) * scale  # [bq, bk]
         if causal:
             mask = _pair_mask(
-                s.shape, i, j, window=window, block_q=block_q, block_k=block_k
+                s.shape, i, j, window=window, block_q=block_q,
+                block_k=block_k, shift=shift,
             )
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[:, :1]  # [bq, 1]
@@ -187,6 +204,7 @@ def _fwd_pallas(
     out_dtype: jax.typing.DTypeLike | None = None,
     native_bhsd: bool = False,
     window: int | None = None,
+    shift: int = 0,
 ) -> tuple[jax.Array, jax.Array | None]:
     """Run the kernel on BHSD-transposed inputs; returns BSHD output plus
     (when ``with_lse``, i.e. under grad) the per-row logsumexp
@@ -198,7 +216,11 @@ def _fwd_pallas(
     :func:`_bwd_pallas`; the accumulator is f32 in VMEM either way, this
     only changes the final store). ``native_bhsd``: inputs and output are
     already ``[B, H, S, D]`` — no transposes at either boundary (the
-    zero-copy layout path; see :func:`flash_attention_bhsd`)."""
+    zero-copy layout path; see :func:`flash_attention_bhsd`). ``shift``:
+    static global q-position offset for the ring's off-diagonal rotations
+    (see :func:`_blocks_interact`; requires ``window``)."""
+    if shift and window is None:
+        raise ValueError("shift requires window (ring rotation use only)")
     if native_bhsd:
         batch, heads, seq, head_dim = q.shape
         qt, kt, vt = q, k, v
@@ -210,11 +232,14 @@ def _fwd_pallas(
     if window is not None:
         # Trimmed kv axis: each q block streams only the blocks its window
         # can reach, anchored at its causal frontier — O(S·W) grid steps and
-        # K/V DMAs, not just gated-off compute (see _window_span).
+        # K/V DMAs, not just gated-off compute (see _window_span). The
+        # anchor clamps to the last real kv block: a nonzero shift pushes
+        # the causal frontier past the buffer.
         njj = _window_span(window, bk, bq, nk)
 
         def kv_index(b, h, i, jj):
-            j = ((i + 1) * bq - 1) // bk - (njj - 1) + jj
+            anchor = jnp.minimum(((i + 1) * bq - 1 + shift) // bk, nk - 1)
+            j = anchor - (njj - 1) + jj
             return (b, h, jnp.maximum(j, 0), 0)
     else:
         njj = nk
@@ -236,7 +261,7 @@ def _fwd_pallas(
         functools.partial(
             _fwd_kernel,
             causal=causal, scale=head_dim**-0.5, block_q=bq, block_k=bk,
-            with_lse=with_lse, window=window,
+            with_lse=with_lse, window=window, shift=shift, n_kv_blocks=nk,
         ),
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         grid=grid,
@@ -266,7 +291,7 @@ def _fwd_pallas(
 def _tile_p_ds(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     i, j, *, causal: bool, scale: float, block_q: int, block_k: int,
-    window: int | None = None,
+    window: int | None = None, shift: int = 0,
 ):
     """Shared backward tile math: returns ``(p, ds, do_f32)`` for the
     (q block i, kv block j) tile.
@@ -294,7 +319,8 @@ def _tile_p_ds(
     ) * scale
     if causal:
         mask = _pair_mask(
-            s.shape, i, j, window=window, block_q=block_q, block_k=block_k
+            s.shape, i, j, window=window, block_q=block_q, block_k=block_k,
+            shift=shift,
         )
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse)  # [bq, bk]
@@ -309,7 +335,7 @@ def _tile_p_ds(
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, dq_acc,
     *, causal: bool, scale: float, block_q: int, block_k: int,
-    window: int | None = None,
+    window: int | None = None, shift: int = 0, n_kv_blocks: int = 0,
 ):
     """dq for one q block, streaming kv blocks (sequential last grid axis)."""
     i = pl.program_id(2)
@@ -320,13 +346,18 @@ def _bwd_dq_kernel(
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    # Trimmed kv axis under a window — same anchoring as _fwd_kernel.
+    # Trimmed kv axis under a window — same anchoring (and shift clamp) as
+    # _fwd_kernel.
     if window is not None:
-        j = ((i + 1) * block_q - 1) // block_k - (nk - 1) + jj
+        anchor = jnp.minimum(
+            ((i + 1) * block_q - 1 + shift) // block_k, n_kv_blocks - 1
+        )
+        j = anchor - (nk - 1) + jj
     else:
         j = jj
     run = _blocks_interact(
-        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k
+        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k,
+        shift=shift,
     )
     if window is not None:
         run = run & (j >= 0)
@@ -336,7 +367,7 @@ def _bwd_dq_kernel(
         _, ds, _ = _tile_p_ds(
             q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            window=window,
+            window=window, shift=shift,
         )
         k = k_ref[0, 0]
         dq_acc[...] += lax.dot_general(
@@ -353,7 +384,7 @@ def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, causal: bool, scale: float, block_q: int, block_k: int,
-    window: int | None = None, n_q_blocks: int = 0,
+    window: int | None = None, n_q_blocks: int = 0, shift: int = 0,
 ):
     """dk/dv for one kv block, streaming q blocks (sequential last grid axis)."""
     j = pl.program_id(2)  # kv block
@@ -371,9 +402,12 @@ def _bwd_dkv_kernel(
     # anchor overshoots n_q - 1, and without the clamp the top of the span
     # gets gated off while the bottom never shifts down to compensate,
     # silently dropping the earliest in-window q blocks from dk/dv.
+    # A nonzero shift moves every q block `shift` positions later, so the
+    # last in-window q block comes `shift` positions earlier.
     if window is not None:
         i_anchor = jnp.minimum(
-            ((j + 1) * block_k + window - 2) // block_q, n_q_blocks - 1
+            ((j + 1) * block_k + window - 2 - shift) // block_q,
+            n_q_blocks - 1,
         )
         i = i_anchor - (nq - 1) + ii
     else:
@@ -382,7 +416,8 @@ def _bwd_dkv_kernel(
     # blocks strictly before this kv block (causal) or with every query
     # past this block's window (sliding window) contribute nothing.
     run = _blocks_interact(
-        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k
+        i, j, causal=causal, window=window, block_q=block_q, block_k=block_k,
+        shift=shift,
     )
     if window is not None:
         run = run & (i >= 0)
@@ -392,7 +427,7 @@ def _bwd_dkv_kernel(
         p, ds, do = _tile_p_ds(
             q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, i, j,
             causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-            window=window,
+            window=window, shift=shift,
         )
         q = q_ref[0, 0]
         # p in the input dtype: bf16 inputs get the bf16 MXU rate (an f32 p
@@ -419,6 +454,7 @@ def _bwd_pallas(
     grad_dtype: jax.typing.DTypeLike | None = None,
     native_bhsd: bool = False,
     window: int | None = None,
+    shift: int = 0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Fused flash backward: two kernels (dq; dk+dv), O(S) memory, no HBM
     probability matrices — replaces the blockwise-JAX backward whose
@@ -428,7 +464,11 @@ def _bwd_pallas(
     overrides the output dtype (default: match the inputs) — the ring
     schedule requests f32 so its cross-rotation accumulation never rounds a
     partial to bf16 first. ``native_bhsd``: all tensors (and the returned
-    grads) are ``[B, H, S, D]`` — no boundary transposes."""
+    grads) are ``[B, H, S, D]`` — no boundary transposes. ``shift``: static
+    global q-position offset for the ring's off-diagonal rotations
+    (requires ``window``; see :func:`_blocks_interact`)."""
+    if shift and window is None:
+        raise ValueError("shift requires window (ring rotation use only)")
     if native_bhsd:
         batch, heads, seq, head_dim = q.shape
         qt, kt, vt, ot, dot_ = q, k, v, o, do
@@ -454,13 +494,16 @@ def _bwd_pallas(
         nii = _window_span(window, bq, bk, n_q)
 
         def kv_at_jj(b, h, i, jj):
-            j = ((i + 1) * bq - 1) // bk - (njj - 1) + jj
+            anchor = jnp.minimum(((i + 1) * bq - 1 + shift) // bk, n_k - 1)
+            j = anchor - (njj - 1) + jj
             return (b, h, jnp.maximum(j, 0), 0)
 
         def q_at_ii(b, h, j, ii):
             # Anchor clamped BEFORE subtracting the span — must match the
             # kernel's i_anchor exactly (see _bwd_dkv_kernel's clamp note).
-            i_anchor = jnp.minimum(((j + 1) * bk + window - 2) // bq, n_q - 1)
+            i_anchor = jnp.minimum(
+                ((j + 1) * bk + window - 2 - shift) // bq, n_q - 1
+            )
             return (b, h, jnp.maximum(i_anchor - (nii - 1) + ii, 0), 0)
     else:
         njj, nii = n_k, n_q
@@ -476,7 +519,7 @@ def _bwd_pallas(
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
-            window=window,
+            window=window, shift=shift, n_kv_blocks=n_k,
         ),
         out_shape=jax.ShapeDtypeStruct((batch, heads, seq, head_dim), dq_dtype),
         grid=(batch, heads, seq // bq, njj),
@@ -501,7 +544,7 @@ def _bwd_pallas(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq, block_k=bk,
-            window=window, n_q_blocks=n_q,
+            window=window, n_q_blocks=n_q, shift=shift,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((batch, heads, seq, head_dim), dk_dtype),

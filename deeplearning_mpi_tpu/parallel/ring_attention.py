@@ -51,12 +51,15 @@ def _block_update(
     causal: bool,
     q_offset: jax.Array | int,
     kv_offset: jax.Array | int,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One online-softmax accumulation step over a K/V block.
 
     ``acc = (o, l, m)``: running un-normalized output ``[B, Sq, H, D]`` (f32),
     running softmax denominator ``[B, Sq, H]`` (f32), running row max
     ``[B, Sq, H]`` (f32). The standard flash-attention recurrence.
+    ``window``: sliding-window mask in the same global coordinates as the
+    causal mask (requires ``causal``).
     """
     o, l, m = acc
     q_len, kv_len = q.shape[-3], k.shape[-3]
@@ -70,6 +73,8 @@ def _block_update(
         q_pos = q_offset + lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 0)
         k_pos = kv_offset + lax.broadcasted_iota(jnp.int32, (q_len, kv_len), 1)
         valid = q_pos >= k_pos
+        if window is not None:
+            valid &= q_pos - k_pos < window
         scores = jnp.where(valid, scores, NEG_INF)
     m_block = jnp.max(scores, axis=-1)  # [B, H, Sq]
     m_new = jnp.maximum(m, m_block.transpose(0, 2, 1))  # [B, Sq, H]
@@ -89,6 +94,21 @@ def _block_update(
     return o_new, l_new, m_new
 
 
+def windowed_rotations(window: int | None, s_local: int, n: int) -> int:
+    """Number of ring rotations that can contribute under a sliding window
+    — rotation skipping's STATIC schedule trim. Rotation ``t`` delivers the
+    shard ``t`` steps behind each Q shard; its newest key is ``t*s_local -
+    ... `` positions stale, so only ``t <= ceil((window-1)/s_local)``
+    rotations intersect ANY query's window (wrapped deliveries are in the
+    future and causally dead on every device). Beyond parity with the
+    trimmed-grid kernels: iteration count AND ICI volume become O(window),
+    not O(S_global)."""
+    if window is None:
+        return n
+    delta = (window - 1 + s_local - 1) // s_local
+    return min(n, delta + 1)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -96,6 +116,7 @@ def ring_attention(
     *,
     causal: bool = True,
     axis_name: str = AXIS_SEQ,
+    window: int | None = None,
 ) -> jax.Array:
     """Blockwise ring attention over sequence shards (call inside shard_map).
 
@@ -104,13 +125,23 @@ def ring_attention(
     ``S_local * axis_size(axis_name)`` and shard ``i`` holds rows
     ``[i*S_local, (i+1)*S_local)``.
 
+    ``window``: sliding-window attention (requires ``causal``). The global-
+    coordinate mask composes with the causal mask, and the rotation
+    schedule is statically TRIMMED to the ``windowed_rotations`` shards any
+    query's window can reach — each device rotates O(window/S_local)
+    neighbor blocks instead of the full circle, so the long-context memory
+    scaling of SP composes with the O(S·W) compute of windowed attention.
+
     Returns the attention output for this device's Q shard, same shape and
     dtype as ``q``.
     """
+    if window is not None and not causal:
+        raise ValueError("window attention is causal by definition")
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     s_local = q.shape[-3]
     q_offset = my_idx * s_local
+    n_upd = windowed_rotations(window, s_local, n)
 
     batch, _, heads, head_dim = q.shape
     acc0 = (
@@ -133,19 +164,21 @@ def ring_attention(
         acc = _block_update(
             q, k_blk, v_blk, acc,
             causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+            window=window,
         )
         return k_nxt, v_nxt, acc
 
-    # n-1 rotations, then the last block's update outside the loop — the
-    # final iteration's K/V transfer would be discarded, and inside a
+    # n_upd - 1 rotations, then the last block's update outside the loop —
+    # the final iteration's K/V transfer would be discarded, and inside a
     # compiled while loop dead ppermutes are NOT eliminated (1/n of the
-    # ring's ICI volume). n == 1 degrades to a single local update.
-    if n > 1:
-        k, v, acc0 = lax.fori_loop(0, n - 1, ring_step, (k, v, acc0))
+    # ring's ICI volume). n_upd == 1 degrades to a single local update.
+    if n_upd > 1:
+        k, v, acc0 = lax.fori_loop(0, n_upd - 1, ring_step, (k, v, acc0))
     o, l, _ = _block_update(
         q, k, v, acc0,
         causal=causal, q_offset=q_offset,
-        kv_offset=((my_idx - (n - 1)) % n) * s_local,
+        kv_offset=((my_idx - (n_upd - 1)) % n) * s_local,
+        window=window,
     )
     out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None], 0.0)
     return out.astype(q.dtype)
@@ -176,20 +209,20 @@ def make_ring_attention_fn(
     if flash is None:
         flash = mesh.devices.flat[0].platform == "tpu"
 
-    @functools.lru_cache(maxsize=2)
+    @functools.lru_cache(maxsize=4)
     def _sharded(causal: bool, window: int | None = None):
-        # window is rejected upstream (with_divisibility_fallback,
-        # supports_window=False) so BOTH paths — sharded and the batch-1
-        # init fallback — refuse it; honoring it here would need rotation
-        # skipping (only ceil(W/S_local)+1 neighbor shards contribute).
-        del window
-
         @functools.partial(
             jax.shard_map, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
         def fn(q, k, v):
+            # Windows at or beyond the GLOBAL sequence are plain causal —
+            # normalized here (inside shard_map q is the local shard, so
+            # the global length is shard * ring size).
+            w = window
+            if w is not None and w >= q.shape[1] * lax.axis_size(seq_axis):
+                w = None
             if flash:
                 from deeplearning_mpi_tpu.parallel.ring_flash import (
                     ring_flash_attention,
@@ -197,20 +230,16 @@ def make_ring_attention_fn(
 
                 return ring_flash_attention(
                     q, k, v, causal=causal, axis_name=seq_axis,
-                    block_q=block_q, block_k=block_k,
+                    block_q=block_q, block_k=block_k, window=w,
                 )
-            return ring_attention(q, k, v, causal=causal, axis_name=seq_axis)
+            return ring_attention(
+                q, k, v, causal=causal, axis_name=seq_axis, window=w
+            )
 
         return fn
 
     from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
 
     return with_divisibility_fallback(
-        mesh, batch_axes, seq_axis, _sharded, dense_attention,
-        supports_window=False,
-        window_error=(
-            "ring attention does not support sliding-window attention; "
-            "use --attention ulysses (window passes through its "
-            "full-sequence inner core) or flash"
-        ),
+        mesh, batch_axes, seq_axis, _sharded, dense_attention
     )

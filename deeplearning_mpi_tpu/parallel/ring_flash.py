@@ -62,24 +62,39 @@ def _merge(o, lse, o_b, lse_b):
     return o * w + o_b.astype(jnp.float32) * w_b, lse_new
 
 
-def _block_fwd(q, k_blk, v_blk, *, causal, block_q, block_k, interpret):
+def _block_fwd(q, k_blk, v_blk, *, causal, block_q, block_k, interpret,
+               window=None, shift=0):
     """One visiting block through the flash kernel → (o_b, lse_b rows).
 
     ``out_dtype=f32``: the kernel's accumulator is f32 in VMEM; storing the
     partial in q.dtype (bf16 in training) would round each of the n
     rotations before the f32 logsumexp merge — the exact drift the backward
     already avoids via ``grad_dtype=f32``. The single cast to q.dtype
-    happens once, after the final merge."""
+    happens once, after the final merge. ``window``/``shift``: the windowed
+    ring's trimmed-grid masking (shift = rotation distance × shard length,
+    static per unrolled rotation)."""
     o_b, lse128 = flash_fwd_block(
         q, k_blk, v_blk, causal, block_q, block_k, interpret, with_lse=True,
-        out_dtype=jnp.float32,
+        out_dtype=jnp.float32, window=window, shift=shift,
     )
     # lane-replicated [B, H, S, 128] -> per-row [B, S, H]
     return o_b, lse128[..., 0].transpose(0, 2, 1)
 
 
-def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret):
-    """All n rotations; returns (o f32 [B,S,H,D], lse f32 [B,S,H])."""
+def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret,
+                   window=None):
+    """All contributing rotations; returns (o f32 [B,S,H,D], lse f32 [B,S,H]).
+
+    ``window`` switches to the rotation-skipping schedule: a PYTHON loop
+    over the ``windowed_rotations`` shards any query's window can reach —
+    unrolled because each rotation's kernels take the rotation distance as
+    a STATIC ``shift`` (the trimmed-grid anchoring is compile-time block
+    arithmetic; a traced distance would force per-element masking of the
+    full grid and give back the O(S·W) win). Wrapped deliveries (device
+    index < rotation) are future shards — their merge is skipped under
+    ``lax.cond`` (same per-device control flow the unwindowed ring's
+    lax.switch uses).
+    """
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     batch, s_local, heads, head_dim = q.shape
@@ -89,6 +104,40 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret):
     o0 = jnp.zeros((batch, s_local, heads, head_dim), jnp.float32)
     lse0 = jnp.full((batch, s_local, heads), NEG_INF, jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if window is not None:
+        from deeplearning_mpi_tpu.parallel.ring_attention import (
+            windowed_rotations,
+        )
+
+        n_upd = windowed_rotations(window, s_local, n)
+        o, lse = o0, lse0
+        k_blk, v_blk = k, v
+        for t in range(n_upd):
+            if t < n_upd - 1:  # issue next transfer before this compute
+                k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+                v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+            if t == 0:
+                # Diagonal: shared offset — plain local causal+window.
+                o_b, lse_b = block(
+                    q, k_blk, v_blk, causal=True,
+                    window=window if window < s_local else None,
+                )
+                o, lse = _merge(o, lse, o_b, lse_b)
+            else:
+                def contribute(o, lse, *, _t=t, _k=k_blk, _v=v_blk):
+                    o_b, lse_b = block(
+                        q, _k, _v, causal=True, window=window,
+                        shift=_t * s_local,
+                    )
+                    return _merge(o, lse, o_b, lse_b)
+
+                o, lse = lax.cond(
+                    my_idx >= t, contribute, lambda o, lse: (o, lse), o, lse
+                )
+            if t < n_upd - 1:
+                k_blk, v_blk = k_nxt, v_nxt
+        return o, lse
 
     def update(src, k_blk, v_blk, o, lse):
         if not causal:
@@ -126,19 +175,26 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret):
     return update((my_idx - (n - 1)) % n, k, v, o, lse)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret):
-    o, _ = _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, causal, axis_name, block_q, block_k, interpret,
+                window=None):
+    o, _ = _ring_fwd_pass(
+        q, k, v, causal, axis_name, block_q, block_k, interpret, window
+    )
     return o.astype(q.dtype)
 
 
-def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret):
-    o, lse = _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret)
+def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret,
+                    window=None):
+    o, lse = _ring_fwd_pass(
+        q, k, v, causal, axis_name, block_q, block_k, interpret, window
+    )
     o = o.astype(q.dtype)
     return o, (q, k, v, o, lse)
 
 
-def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res, do):
+def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, window,
+                    res, do):
     q, k, v, o, lse = res
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -155,6 +211,55 @@ def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, res, do):
     )
     zeros = lambda ref: jnp.zeros(ref.shape, jnp.float32)  # noqa: E731
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if window is not None:
+        # Rotation-skipping backward, mirroring the unrolled forward: the
+        # global lse makes every per-rotation p tile globally normalized
+        # (and zeroes masked pairs — finite lse, NEG_INF scores), dq
+        # accumulates locally, and dK/dV accumulators travel WITH their
+        # K/V blocks for the trimmed n_upd rotations. They then ride ONE
+        # collective-permute home (shift -(n_upd-1)) instead of completing
+        # the circle — backward ICI volume is O(window), like the forward.
+        from deeplearning_mpi_tpu.parallel.ring_attention import (
+            windowed_rotations,
+        )
+
+        s_local = q.shape[1]
+        n_upd = windowed_rotations(window, s_local, n)
+        dq = zeros(q)
+        k_blk, v_blk = k, v
+        dk_blk, dv_blk = zeros(k), zeros(v)
+        for t in range(n_upd):
+            if t < n_upd - 1:
+                k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+                v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+
+            def acc_grads(dq, dk_c, dv_c, *, _t=t, _k=k_blk, _v=v_blk):
+                dq_b, dk_b, dv_b = bwd(
+                    q, _k, _v, o, do, lse128, causal=True,
+                    window=window if (_t or window < s_local) else None,
+                    shift=_t * s_local,
+                )
+                return dq + dq_b, dk_c + dk_b, dv_c + dv_b
+
+            if t == 0:
+                dq, dk_blk, dv_blk = acc_grads(dq, dk_blk, dv_blk)
+            else:
+                dq, dk_blk, dv_blk = lax.cond(
+                    my_idx >= t, acc_grads,
+                    lambda a, b, c: (a, b, c), dq, dk_blk, dv_blk,
+                )
+            if t < n_upd - 1:
+                k_blk, v_blk = k_nxt, v_nxt
+                dk_blk = lax.ppermute(dk_blk, axis_name, perm=perm)
+                dv_blk = lax.ppermute(dv_blk, axis_name, perm=perm)
+        if n_upd > 1:
+            home = [(i, (i - (n_upd - 1)) % n) for i in range(n)]
+            dk_blk = lax.ppermute(dk_blk, axis_name, perm=home)
+            dv_blk = lax.ppermute(dv_blk, axis_name, perm=home)
+        return (
+            dq.astype(q.dtype), dk_blk.astype(k.dtype), dv_blk.astype(v.dtype)
+        )
 
     def update(src, k_blk, v_blk, dq, dk, dv):
         def skip(dq, dk, dv):
@@ -212,19 +317,25 @@ def ring_flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Ring attention over sequence shards with the Pallas flash inner.
 
     Same contract as :func:`~deeplearning_mpi_tpu.parallel.ring_attention.
-    ring_attention` (call inside shard_map on ``[B, S_local, H, D]`` shards);
-    local sequences the blocks can't tile fall back to the XLA ring.
+    ring_attention` (call inside shard_map on ``[B, S_local, H, D]`` shards,
+    ``window`` = sliding-window attention with rotation skipping); local
+    sequences the blocks can't tile fall back to the XLA ring.
     """
+    if window is not None and not causal:
+        raise ValueError("window attention is causal by definition")
     seq = q.shape[1]
     bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
     if not usable_blocks(bq, bk, seq):
         from deeplearning_mpi_tpu.parallel.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, causal=causal, axis_name=axis_name)
+        return ring_attention(
+            q, k, v, causal=causal, axis_name=axis_name, window=window
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if lax.axis_size(axis_name) == 1:
@@ -234,6 +345,7 @@ def ring_flash_attention(
         from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, block_q=bq, block_k=bk, interpret=interpret
+            q, k, v, causal=causal, block_q=bq, block_k=bk,
+            interpret=interpret, window=window,
         )
-    return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret)
+    return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret, window)

@@ -155,8 +155,10 @@ def add_lm_model_flags(parser: argparse.ArgumentParser) -> "argparse._ArgumentGr
                        "decode all honor it (decode then reads O(N) cache "
                        "rows per token). Flash kernels skip out-of-window "
                        "blocks: attention cost becomes O(S*N). Composes "
-                       "with --attention ulysses (full-sequence inner); "
-                       "not valid with --attention ring")
+                       "with --attention ulysses (full-sequence inner) AND "
+                       "--attention ring (rotation skipping: each device "
+                       "rotates only the O(N/shard) neighbor K/V blocks "
+                       "its queries' windows reach)")
     group.add_argument("--moe_routing", default="token_choice",
                        choices=("token_choice", "expert_choice"),
                        help="token_choice = GShard top-k + balance aux loss; "

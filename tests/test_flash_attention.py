@@ -163,6 +163,68 @@ class TestSlidingWindow:
             dense_attention(q, k, v, causal=False, window=8)
 
 
+class TestShiftedWindow:
+    """The static ``shift`` (q-position offset) the ring's off-diagonal
+    rotations use: queries sit ``shift = t * s_local`` positions after the
+    visiting K/V block. Oracle: ``dense_attention(q_offset=shift)``."""
+
+    @pytest.mark.parametrize("block_q,block_k", [(16, 16), (8, 32), (32, 8)])
+    @pytest.mark.parametrize("shift,window", [
+        (64, 40),    # partial overlap; rows 39.. fully masked (zero rows)
+        (64, 80),    # every row keeps some in-window keys
+        (64, 200),   # rotation fully inside the window (mask all-true)
+        (128, 150),  # distance-2 rotation, partial overlap
+    ])
+    def test_forward_matches_offset_dense(self, shift, window,
+                                          block_q, block_k):
+        from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+            flash_fwd_block,
+        )
+
+        q, k, v = qkv()
+        out, _ = flash_fwd_block(
+            q, k, v, True, block_q, block_k, True, with_lse=False,
+            window=window, shift=shift,
+        )
+        ref = dense_attention(
+            q, k, v, causal=True, window=window, q_offset=shift
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("shift,window", [(64, 80), (64, 200)])
+    def test_backward_matches_offset_dense(self, shift, window):
+        """Shifted backward vs dense-oracle grads. Windows keep every q row
+        at least one valid key (window > shift): a standalone single-block
+        call has no global lse to rescue fully-masked rows (p = exp(0) = 1
+        garbage, the documented _tile_p_ds caveat) — the RING covers that
+        regime end-to-end with its finite global lse."""
+        from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+            flash_bwd_block,
+            flash_fwd_block,
+        )
+
+        q, k, v = qkv(S=64)
+        o, lse = flash_fwd_block(
+            q, k, v, True, 16, 16, True, with_lse=True,
+            window=window, shift=shift,
+        )
+
+        def dense_loss(q, k, v):
+            return jnp.sum(dense_attention(
+                q, k, v, causal=True, window=window, q_offset=shift
+            ) ** 2)
+
+        g_ref = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        do = 2.0 * o
+        g_out = flash_bwd_block(
+            q, k, v, o, do, lse, True, 16, 16, True,
+            window=window, shift=shift,
+        )
+        for a, b in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
 def test_indivisible_seq_falls_back_to_dense():
     q, k, v = qkv(S=48)  # 48 % 32 != 0 after clamping
     out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)

@@ -188,9 +188,10 @@ class TestTrainLMCLI:
         ])
         assert rc == 0
 
-    def test_sliding_window_rejects_ring(self, tmp_path):
-        # The ring schedule's rotating K/V shards can't honor a window —
-        # the CLI must reject the combination up front, not mid-trace.
+    def test_sliding_window_composes_with_ring(self, tmp_path):
+        # Rotation-skipping ring (r5): window x the O(S/N)-memory SP path —
+        # a full CLI epoch with --attention ring --attention_window must
+        # train green (window 16 over sp=4 shards of 16 = 2 rotations).
         from deeplearning_mpi_tpu.cli import train_lm
 
         rc = train_lm.main([
@@ -202,7 +203,7 @@ class TestTrainLMCLI:
             "--model_dir", str(tmp_path / "ckpt"),
             "--log_dir", str(tmp_path / "logs"),
         ])
-        assert rc == 1
+        assert rc == 0
 
     def test_ring_attention_sequence_parallel(self, tmp_path):
         # --sp 4 over the 8 virtual devices: the ring schedule through the
