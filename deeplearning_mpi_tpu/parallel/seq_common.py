@@ -15,9 +15,6 @@ def with_divisibility_fallback(
     seq_axis: str,
     sharded: Callable[[bool, int | None], Callable],
     fallback: Callable,
-    *,
-    supports_window: bool = True,
-    window_error: str | None = None,
 ) -> Callable:
     """Wrap a seq-parallel attention schedule with a static-shape fallback.
 
@@ -28,12 +25,10 @@ def with_divisibility_fallback(
     failing shard_map's divisibility check. The decision is static
     (trace-time shapes), so jit caches one program per shape as usual.
 
-    ``window`` is forwarded to both paths; a schedule that cannot honor it
-    passes ``supports_window=False`` with its own ``window_error`` message
-    (the caller knows its name and the alternatives to suggest) and the
-    wrapper rejects the kwarg up front — HERE, not inside ``sharded``,
-    because the batch-1 init fallback never reaches the sharded factory and
-    would otherwise silently accept the window on the dense core.
+    ``window`` is forwarded to BOTH paths — every current schedule honors
+    it (Ulysses passes it to the full-sequence inner; the ring trims its
+    rotation schedule), and the batch-1 init fallback masks it on the
+    dense core.
     """
     batch_list = [batch_axes] if isinstance(batch_axes, str) else list(batch_axes)
     dp = 1
@@ -42,12 +37,6 @@ def with_divisibility_fallback(
     sp = mesh.shape[seq_axis if seq_axis else AXIS_SEQ]
 
     def attention_fn(q, k, v, *, causal: bool = True, window: int | None = None):
-        if window is not None and not supports_window:
-            raise ValueError(
-                window_error
-                or "this attention schedule does not support sliding-window "
-                "attention"
-            )
         if q.shape[0] % dp == 0 and q.shape[1] % sp == 0:
             return sharded(causal, window)(q, k, v)
         if q.shape[0] == 1:

@@ -58,9 +58,9 @@ def ulysses_attention(
 
     ``window`` (sliding-window attention) composes for free: the inner core
     runs on the FULL sequence per head group, so the window is just passed
-    through — unlike the ring schedule, whose rotating K/V shards would need
-    window-aware rotation skipping (not implemented; the ring factory
-    rejects a window).
+    through. (The ring schedule composes differently — rotation skipping,
+    ``parallel.ring_attention.windowed_rotations`` — and keeps O(S/N)
+    sequence memory where Ulysses holds the full sequence per device.)
     """
     n = lax.axis_size(axis_name)
     heads = q.shape[-2]
