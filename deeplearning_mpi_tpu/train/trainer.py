@@ -35,7 +35,12 @@ import optax
 from jax.sharding import Mesh
 
 from deeplearning_mpi_tpu.data.loader import prefetch
-from deeplearning_mpi_tpu.models.moe import AUX_COLLECTION, collect_aux_loss
+from deeplearning_mpi_tpu.models.moe import (
+    AUX_COLLECTION,
+    METRIC_COLLECTION,
+    collect_aux_loss,
+    collect_dropped_fraction,
+)
 from deeplearning_mpi_tpu.ops import (
     chunked_lm_loss,
     dice_loss,
@@ -190,6 +195,11 @@ def make_train_step(
         return jnp.asarray(1.0, jnp.float32)
 
     def step(state: TrainState, batch: Batch) -> tuple[TrainState, dict[str, jax.Array]]:
+        # Trace-time flag: whether the model sows the MoE dropped-token
+        # metric (collection presence is static under jit) — gates the
+        # metric's inclusion so dense runs don't log a meaningless 0.0.
+        moe_drop_seen: list[bool] = []
+
         def loss_and_grads(batch_stats, chunk, data_scale=None, aux_scale=None):
             # data_scale/aux_scale (grad-accum only) fold the cross-chunk
             # weights INTO the differentiated scalar, so data loss and aux
@@ -203,14 +213,19 @@ def make_train_step(
                     {"params": params, "batch_stats": batch_stats},
                     chunk[input_key],
                     train=True,
-                    mutable=["batch_stats", AUX_COLLECTION],
+                    mutable=["batch_stats", AUX_COLLECTION, METRIC_COLLECTION],
                 )
                 loss = loss_fn(outputs, chunk)
                 total = loss if data_scale is None else data_scale * loss
                 if aux_weight:
                     a = aux_weight if aux_scale is None else aux_scale
                     total = total + a * collect_aux_loss(mutated)
-                return total, (loss, mutated.get("batch_stats", {}))
+                drop = collect_dropped_fraction(mutated)
+                if drop is not None and not moe_drop_seen:
+                    moe_drop_seen.append(True)
+                if drop is None:
+                    drop = jnp.zeros((), jnp.float32)
+                return total, (loss, mutated.get("batch_stats", {}), drop)
 
             (_, aux), grads = jax.value_and_grad(
                 compute_loss, has_aux=True
@@ -218,7 +233,9 @@ def make_train_step(
             return *aux, grads
 
         if grad_accum == 1:
-            loss, new_batch_stats, grads = loss_and_grads(state.batch_stats, batch)
+            loss, new_batch_stats, drop_frac, grads = loss_and_grads(
+                state.batch_stats, batch
+            )
         else:
             def split(x):
                 if x.shape[0] % grad_accum:
@@ -244,19 +261,27 @@ def make_train_step(
                 w_total = float(grad_accum)
 
             def body(carry, chunk):
-                stats, grad_sum, loss_sum = carry
+                stats, grad_sum, loss_sum, drop_sum = carry
                 w = chunk_weight(chunk) / w_total
-                loss, new_stats, grads = loss_and_grads(
+                loss, new_stats, drop, grads = loss_and_grads(
                     stats, chunk,
                     data_scale=w, aux_scale=aux_weight / grad_accum,
                 )
                 grad_sum = jax.tree.map(jnp.add, grad_sum, grads)
-                return (new_stats, grad_sum, loss_sum + w * loss), None
+                # Equal chunk shares (like the aux loss): the drop fraction
+                # covers every routed token, masked or not.
+                return (
+                    new_stats, grad_sum, loss_sum + w * loss,
+                    drop_sum + drop / grad_accum,
+                ), None
 
             zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-            (new_batch_stats, grads, loss), _ = jax.lax.scan(
+            (new_batch_stats, grads, loss, drop_frac), _ = jax.lax.scan(
                 body,
-                (state.batch_stats, zero_grads, jnp.zeros((), jnp.float32)),
+                (
+                    state.batch_stats, zero_grads,
+                    jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                ),
                 chunks,
             )
 
@@ -291,6 +316,9 @@ def make_train_step(
                 ),
                 ema,
             )
+        metrics = {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)}
+        if moe_drop_seen:
+            metrics["moe_dropped_frac"] = drop_frac
         return (
             state.replace(
                 step=state.step + 1,
@@ -299,7 +327,7 @@ def make_train_step(
                 opt_state=keep(new_opt_state, state.opt_state),
                 ema_params=ema,
             ),
-            {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)},
+            metrics,
         )
 
     return jax.jit(
@@ -524,7 +552,7 @@ class Trainer:
         from deeplearning_mpi_tpu.utils.profiling import StepTimer
 
         t0 = time.perf_counter()
-        loss_sum = finite_sum = None
+        loss_sum = finite_sum = drop_sum = None
         n_batches = 0
         images = 0
         timer = StepTimer(sync_every=25) if self.time_steps else None
@@ -550,6 +578,9 @@ class Trainer:
                 metrics["finite"] if finite_sum is None
                 else finite_sum + metrics["finite"]
             )
+            if "moe_dropped_frac" in metrics:
+                d = metrics["moe_dropped_frac"]
+                drop_sum = d if drop_sum is None else drop_sum + d
             n_batches += 1
             images += batch[_INPUTS[self.task]].shape[0]
         if not n_batches:
@@ -566,6 +597,11 @@ class Trainer:
             "duration_s": duration,
             "images_per_s": images / duration,
         }
+        if drop_sum is not None:
+            # Epoch-mean over-capacity dropped-token fraction (MoE
+            # token-choice runs only) — rides stats into the .metrics.jsonl
+            # sidecar so a collapsing router is visible, not silent.
+            stats["moe_dropped_frac"] = float(drop_sum) / n_batches
         if timer is not None:
             stats.update(timer.summary(items_per_step=images // max(n_batches, 1)))
         if n_finite < n_batches:

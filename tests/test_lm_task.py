@@ -124,6 +124,33 @@ class TestExpertChoiceGuard:
 
 @pytest.mark.slow
 class TestTrainLMCLI:
+    def test_moe_dropped_frac_in_metrics_sidecar(self, tmp_path):
+        """A --moe_experts run must surface the over-capacity dropped-token
+        fraction in its .metrics.jsonl epoch records (round-4 weak #6) —
+        low capacity_factor is not exposed on the CLI, so assert presence
+        and range rather than forcing a collapse."""
+        import json
+
+        from deeplearning_mpi_tpu.cli import train_lm
+
+        rc = train_lm.main([
+            "--num_epochs", "1", "--batch_size", "8", "--seq_len", "32",
+            "--num_layers", "1", "--num_heads", "2", "--head_dim", "4",
+            "--d_model", "8", "--d_ff", "16", "--moe_experts", "4",
+            "--train_sequences", "32",
+            "--model_dir", str(tmp_path / "ckpt"),
+            "--log_dir", str(tmp_path / "logs"),
+        ])
+        assert rc == 0
+        records = [
+            json.loads(line)
+            for f in sorted((tmp_path / "logs").glob("*.metrics.jsonl"))
+            for line in f.read_text().splitlines()
+        ]
+        epochs = [r for r in records if r.get("kind") == "epoch"]
+        assert epochs and all("moe_dropped_frac" in r for r in epochs)
+        assert all(0.0 <= r["moe_dropped_frac"] <= 1.0 for r in epochs)
+
     def test_one_epoch_synthetic(self, tmp_path):
         from deeplearning_mpi_tpu.cli import train_lm
 

@@ -45,8 +45,9 @@ class TestMoEMLP:
         logits = x @ params["params"]["router"]["kernel"]
         probs = jax.nn.softmax(logits, axis=-1)
         capacity = 5  # ceil(2 * 8 * 1.25 / 4)
-        combine, aux = model._expert_choice(probs, capacity)
+        combine, aux, uncovered = model._expert_choice(probs, capacity)
         assert aux is None
+        assert 0.0 <= float(uncovered) <= 1.0
         dispatch = (combine > 0).astype(np.float32)  # [B, S, E, C]
         # every (expert, slot) holds exactly one token
         np.testing.assert_array_equal(
@@ -116,6 +117,72 @@ class TestMoEMLP:
 
     def test_collect_aux_loss_empty_tree_is_zero(self):
         assert float(collect_aux_loss({})) == 0.0
+
+    def test_dropped_fraction_sown_nonzero_under_forced_imbalance(self):
+        """capacity 1 with 16 tokens on 2 experts: >= 14/16 of claims must
+        overflow — the sown dropped fraction surfaces it (round-4 weak #6:
+        routing collapse degraded silently)."""
+        from deeplearning_mpi_tpu.models.moe import (
+            METRIC_COLLECTION,
+            collect_dropped_fraction,
+        )
+
+        model = MoEMLP(
+            d_ff=8, dtype=jnp.float32, num_experts=2, top_k=1,
+            capacity_factor=1e-6,  # floors to capacity=1
+        )
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(1, 16, 8)), jnp.float32)
+        params = _init(model, x)
+        _, mutated = model.apply(
+            params, x, mutable=[AUX_COLLECTION, METRIC_COLLECTION]
+        )
+        drop = collect_dropped_fraction(mutated)
+        assert drop is not None
+        assert float(drop) >= 14 / 16
+
+    def test_dropped_fraction_zero_when_capacity_ample(self):
+        from deeplearning_mpi_tpu.models.moe import (
+            METRIC_COLLECTION,
+            collect_dropped_fraction,
+        )
+
+        # capacity_factor E/k makes every expert able to absorb all tokens.
+        model = MoEMLP(
+            d_ff=8, dtype=jnp.float32, num_experts=2, top_k=1,
+            capacity_factor=2.0,
+        )
+        x = jnp.asarray(np.random.default_rng(3).normal(size=(1, 8, 8)), jnp.float32)
+        params = _init(model, x)
+        _, mutated = model.apply(
+            params, x, mutable=[AUX_COLLECTION, METRIC_COLLECTION]
+        )
+        assert float(collect_dropped_fraction(mutated)) == 0.0
+
+    def test_dropped_fraction_none_for_dense_tree(self):
+        from deeplearning_mpi_tpu.models.moe import collect_dropped_fraction
+
+        assert collect_dropped_fraction({}) is None
+
+    def test_expert_choice_sows_uncovered_token_fraction(self):
+        """EC fills every capacity SLOT by construction, but a token picked
+        by no expert still skips its MLP — with capacity 1, two experts
+        cover at most 2 of 8 tokens, so the sown fraction must be >= 6/8."""
+        from deeplearning_mpi_tpu.models.moe import (
+            METRIC_COLLECTION,
+            collect_dropped_fraction,
+        )
+
+        model = MoEMLP(
+            d_ff=8, dtype=jnp.float32, num_experts=2, top_k=1,
+            capacity_factor=1e-6, routing="expert_choice",
+        )
+        x = jnp.asarray(np.random.default_rng(4).normal(size=(1, 8, 8)), jnp.float32)
+        params = _init(model, x)
+        _, mutated = model.apply(
+            params, x, mutable=[AUX_COLLECTION, METRIC_COLLECTION]
+        )
+        drop = collect_dropped_fraction(mutated)
+        assert drop is not None and float(drop) >= 6 / 8
 
     def test_grads_flow_to_experts_and_router(self):
         model = MoEMLP(d_ff=8, dtype=jnp.float32, num_experts=2, top_k=2)

@@ -231,6 +231,67 @@ class TestPipelinedLM:
         assert aux_got > 0.0
         np.testing.assert_allclose(aux_got, aux_ref, rtol=1e-5)
 
+    def test_moe_drop_metric_threads_through_pipeline(self):
+        """The dropped-token fraction must survive the scan/ppermute schedule
+        like the aux loss does (review r5: it was silently discarded), and
+        equal the flat model's per-microbatch mean; dense pipelines emit no
+        metric."""
+        from deeplearning_mpi_tpu.models.moe import (
+            METRIC_COLLECTION,
+            collect_dropped_fraction,
+        )
+
+        mesh = pipe_mesh(pipe=2, data=4)
+        cfg = TransformerConfig.tiny_moe()
+        num_micro = 2
+        pipelined = PipelinedLM(
+            cfg, mesh, num_microbatches=num_micro, dtype=jnp.float32
+        )
+        tokens = jnp.asarray(
+            np.random.default_rng(5).integers(0, cfg.vocab_size, (4, 16)),
+            jnp.int32,
+        )
+        variables = pipelined.init(jax.random.key(1), tokens)
+        _, mutated = pipelined.apply(
+            variables, tokens, mutable=[METRIC_COLLECTION]
+        )
+        drop = collect_dropped_fraction(mutated)
+        assert drop is not None and 0.0 <= float(drop) <= 1.0
+
+        # Oracle: flat model with remapped weights, per-microbatch mean.
+        p = variables["params"]
+        blocks_per_stage = cfg.num_layers // 2
+        dense_params = {
+            "embed": p["embed_head"]["embed"],
+            "final_norm": p["embed_head"]["final_norm"],
+        }
+        for s in range(2):
+            for j in range(blocks_per_stage):
+                dense_params[f"layer_{s * blocks_per_stage + j}"] = jax.tree.map(
+                    lambda leaf: leaf[s], p["stages"][f"block_{j}"]
+                )
+        flat = TransformerLM(config=cfg, dtype=jnp.float32)
+        mb = tokens.reshape(num_micro, -1, tokens.shape[1])
+        ref = np.mean([
+            float(collect_dropped_fraction(
+                flat.apply(
+                    {"params": dense_params}, mb[i],
+                    mutable=[METRIC_COLLECTION],
+                )[1]
+            ))
+            for i in range(num_micro)
+        ])
+        np.testing.assert_allclose(float(drop), ref, rtol=1e-5)
+
+        # Dense pipeline: no metric collection in the mutated dict.
+        dense_cfg = TransformerConfig.tiny()
+        dense_pipe = PipelinedLM(
+            dense_cfg, mesh, num_microbatches=num_micro, dtype=jnp.float32
+        )
+        dvars = dense_pipe.init(jax.random.key(2), tokens)
+        _, dmut = dense_pipe.apply(dvars, tokens, mutable=[METRIC_COLLECTION])
+        assert collect_dropped_fraction(dmut) is None
+
     @pytest.mark.slow
     def test_moe_router_gets_aux_gradient(self):
         """The aux loss must backpropagate through the pipeline to the router
