@@ -135,7 +135,8 @@ class MoEMLP(nn.Module):
     routing: str = "token_choice"
 
     def _token_choice(self, probs: jax.Array, capacity: int):
-        """GShard dispatch: (combine [B,S,E,C] f32, aux scalar)."""
+        """GShard dispatch: (combine [B,S,E,C] f32, aux scalar, dropped
+        claim fraction)."""
         batch, seq, n_exp = probs.shape
         k = self.top_k
         gates, expert_idx = jax.lax.top_k(probs, k)  # [B, S, k]

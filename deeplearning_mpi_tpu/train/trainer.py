@@ -598,9 +598,10 @@ class Trainer:
             "images_per_s": images / duration,
         }
         if drop_sum is not None:
-            # Epoch-mean over-capacity dropped-token fraction (MoE
-            # token-choice runs only) — rides stats into the .metrics.jsonl
-            # sidecar so a collapsing router is visible, not silent.
+            # Epoch-mean dropped/unserved-token fraction (MoE runs only;
+            # semantics per routing — see moe.METRIC_COLLECTION) — rides
+            # stats into the .metrics.jsonl sidecar so a collapsing router
+            # is visible, not silent.
             stats["moe_dropped_frac"] = float(drop_sum) / n_batches
         if timer is not None:
             stats.update(timer.summary(items_per_step=images // max(n_batches, 1)))

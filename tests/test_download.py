@@ -49,6 +49,84 @@ class TestCifar10:
         assert download.main(["cifar10", "--check", "--data_dir", str(tmp_path)]) == 1
 
 
+def _mini_cifar_tarball(tmp_path, n=4):
+    """Synthesize a loadable cifar-10-python.tar.gz: real pickle batches
+    (the format data.cifar10.CIFAR10 reads) with n tiny examples each."""
+    import pickle
+    import tarfile
+
+    src = tmp_path / "src" / "cifar-10-batches-py"
+    src.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        entry = {
+            "data": rng.integers(0, 256, (n, 3 * 32 * 32), np.uint8),
+            "labels": rng.integers(0, 10, n).tolist(),
+        }
+        with open(src / name, "wb") as f:
+            pickle.dump(entry, f)
+    tarball = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tarball, "w:gz") as tar:
+        tar.add(src, arcname="cifar-10-batches-py")
+    return tarball
+
+
+class TestFromFile:
+    """--from_file: the offline ingest path (round-4 missing #1 — the real
+    accuracy run becomes one file-copy away on an air-gapped box)."""
+
+    def test_ingest_verifies_md5_and_extracts_loadable_layout(self, tmp_path):
+        tarball = _mini_cifar_tarball(tmp_path)
+        digest = download._md5(tarball)
+        data_dir = tmp_path / "data"
+        rc = download.main([
+            "cifar10", "--from_file", str(tarball),
+            "--md5", digest, "--data_dir", str(data_dir),
+        ])
+        assert rc == 0
+        assert download.check_cifar10(data_dir)
+        # The extracted layout must actually LOAD through the training
+        # dataset — same post-extract contract as the download path.
+        from deeplearning_mpi_tpu.data.cifar10 import CIFAR10
+
+        ds = CIFAR10(data_dir, train=True)
+        assert len(ds) == 20  # 5 batches x 4 examples
+        ex = ds[0]
+        assert ex["image"].shape == (32, 32, 3)
+
+    def test_ingest_rejects_bad_md5(self, tmp_path, capsys):
+        tarball = _mini_cifar_tarball(tmp_path)
+        rc = download.main([
+            "cifar10", "--from_file", str(tarball),
+            "--data_dir", str(tmp_path / "data"),  # default md5 = official
+        ])
+        assert rc == 1
+        assert "md5 mismatch" in capsys.readouterr().err
+        assert not (tmp_path / "data" / "cifar-10-batches-py").exists()
+
+    def test_ingest_md5_none_skips_check(self, tmp_path):
+        tarball = _mini_cifar_tarball(tmp_path)
+        rc = download.main([
+            "cifar10", "--from_file", str(tarball),
+            "--md5", "none", "--data_dir", str(tmp_path / "data"),
+        ])
+        assert rc == 0
+
+    def test_ingest_missing_file_fails_cleanly(self, tmp_path, capsys):
+        rc = download.main([
+            "cifar10", "--from_file", str(tmp_path / "nope.tar.gz"),
+            "--md5", "none", "--data_dir", str(tmp_path / "data"),
+        ])
+        assert rc == 1
+        assert "not a file" in capsys.readouterr().err
+
+    def test_from_file_rejected_for_carvana(self, tmp_path):
+        with pytest.raises(SystemExit):
+            download.main([
+                "carvana", "--from_file", str(tmp_path / "x.tar.gz"),
+            ])
+
+
 def _write_pair(root, stem, img_hw=(8, 8), mask_hw=None):
     img = np.zeros((*img_hw, 3), np.uint8)
     mask = np.zeros(mask_hw or img_hw, np.uint8)
