@@ -75,14 +75,19 @@ def _verify_and_extract(
             tar.extractall(data_dir, filter="data")
         except TypeError:  # filter= needs py>=3.10.12/3.11.4/3.12
             # Manual tar-slip guard for the no-filter fallback: the ingest
-            # path can run UNVERIFIED (--md5 none), so member names must be
-            # checked before a bare extractall.
+            # path can run UNVERIFIED (--md5 none), so members must be
+            # checked before a bare extractall — names for traversal, AND
+            # symlink/hardlink members (a link pointing outside data_dir
+            # redirects a later member's write; name checks alone don't see
+            # it). CIFAR tarballs contain only regular files + dirs.
             bad = [
                 m.name for m in tar.getmembers()
-                if m.name.startswith(("/", "..")) or ".." in Path(m.name).parts
+                if m.name.startswith(("/", ".."))
+                or ".." in Path(m.name).parts
+                or m.issym() or m.islnk()
             ]
             if bad:
-                print(f"refusing unsafe tar member paths: {bad[:3]}",
+                print(f"refusing unsafe tar members: {bad[:3]}",
                       file=sys.stderr)
                 return 1
             tar.extractall(data_dir)  # noqa: S202 — members validated above
@@ -222,6 +227,12 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.from_file and args.dataset != "cifar10":
         ap.error("--from_file applies to cifar10 only")
+    if args.from_file and args.check:
+        ap.error("--check validates existing data; it never reads "
+                 "--from_file — drop one of the two")
+    if args.md5 != CIFAR10_MD5 and not args.from_file:
+        ap.error("--md5 only applies to --from_file (the download path "
+                 "always verifies against the official digest)")
     if args.dataset == "cifar10":
         if args.check:
             return 0 if check_cifar10(data_dir) else 1

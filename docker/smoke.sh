@@ -19,7 +19,9 @@
 # no docker daemon (`docker info` fails), so this script is the committed,
 # runnable definition of "the image works" for any host that does — it is NOT
 # a substitute run log. Run it wherever docker exists before shipping the
-# image.
+# image. The no-docker analog — clean venv, pip install -e ., same entry
+# points — HAS executed on this box: tools/venv_smoke.sh, passing transcript
+# at docs/runs/venv_smoke/ (round-5).
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
