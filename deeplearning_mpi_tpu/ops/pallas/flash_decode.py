@@ -1,0 +1,174 @@
+"""Fused flash-decode kernel: one grid over the KV cache's filled prefix.
+
+The long-buffer decode schedule (`ops.attention.decode_attention`'s blockwise
+walk) pays a measured ~40 µs of loop overhead per `lax.fori_loop` iteration —
+~45% of the HBM roofline at block 512, amortized but not gone at 2048
+(`docs/PERF_ANALYSIS.md` §9). This kernel replaces the host-orchestrated walk
+with ONE `pallas_call`: the kv-block axis is a sequential grid dimension, the
+online-softmax accumulator lives in VMEM scratch, and the dynamic fill level
+rides a scalar-prefetch argument:
+
+- the **index map clamps** out-of-prefix grid steps to the last filled block
+  — Mosaic skips the DMA when consecutive steps map to the same block, so
+  HBM traffic stays O(index), the walk's defining advantage over the
+  read-everything dense path;
+- the **compute gate** (`pl.when(j < n_valid)`) skips their FLOPs;
+- masking inside the boundary block uses the prefetched `index` scalar.
+
+Layout: the cache is BSHD (`[B, L, Hkv, D]`) and the kernel blocks over L
+only, keeping each row's full `Hkv x D` contiguous — the same access pattern
+the dense einsum path achieves roofline with. Grouped-query heads are
+consumed natively (Hkv < H reads Hkv rows, like the walk). No reference
+analog (the reference has no attention at all — SURVEY.md §5.7).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning_mpi_tpu.ops.attention import NEG_INF
+
+
+def _decode_kernel(
+    idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l,
+    *, block: int, kv_heads: int, group: int, scale: float,
+):
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, NEG_INF)
+        l[...] = jnp.zeros_like(l)
+
+    index = idx_ref[0]
+    n_valid = (index + block) // block  # blocks with >= 1 filled row
+
+    @pl.when(j < n_valid)
+    def _update():
+        # Rows beyond the filled prefix are masked (only the boundary block
+        # has any; interior blocks mask nothing and the where folds away).
+        pos = j * block + lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        valid = pos <= index  # [1, block]
+        for h in range(kv_heads):
+            q_h = q_ref[0, 0, h * group : (h + 1) * group, :]  # [G, D]
+            k_h = k_ref[0, :, h, :]  # [block, D]
+            v_h = v_ref[0, :, h, :]
+            s = lax.dot_general(
+                q_h, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, block]
+            s = jnp.where(valid, s, NEG_INF)
+            rows = slice(h * group, (h + 1) * group)
+            m_prev = m[rows, :1]  # [G, 1]
+            l_prev = l[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            p = jnp.where(valid, p, 0.0)  # finite NEG_INF ⇒ re-zero masked
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            pv = lax.dot_general(
+                p.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, D]
+            acc[rows, :] = acc[rows, :] * alpha + pv
+            m[rows, :] = jnp.broadcast_to(m_new, (group, m.shape[1]))
+            l[rows, :] = jnp.broadcast_to(l_new, (group, l.shape[1]))
+
+    @pl.when(j == nb - 1)
+    def _finalize():
+        # Block 0 always holds >= 1 filled row (index >= 0), so l > 0 on
+        # the real rows; scratch is sublane-padded, so slice them out.
+        heads = kv_heads * group
+        o_ref[0, 0] = (acc[:heads, :] / l[:heads, :1]).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jax.Array,
+    k_buf: jax.Array,
+    v_buf: jax.Array,
+    index: jax.Array,
+    *,
+    block: int = 1024,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """One fused decode step over the cache's filled prefix.
+
+    Same contract as the blockwise walk in
+    :func:`~deeplearning_mpi_tpu.ops.attention.decode_attention`: ``q``
+    ``[B, 1, H, D]``, grouped cache buffers ``[B, L, Hkv, D]``, positions
+    ``0..index`` filled; returns ``[B, 1, H, D]``. Caller guarantees
+    ``L % block == 0`` (see :func:`decode_block_fits`).
+    """
+    batch, q_len, heads, head_dim = q.shape
+    length, kv_heads = k_buf.shape[1], k_buf.shape[2]
+    group = heads // kv_heads
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_blocks = length // block
+
+    def q_map(b, j, idx_ref):
+        del idx_ref, j
+        return (b, 0, 0, 0)
+
+    def kv_map(b, j, idx_ref):
+        # Index maps receive the prefetched scalar AFTER the grid indices,
+        # as a (1,)-shaped ref.
+        n_valid = (idx_ref[0] + block) // block
+        # Clamp: steps past the prefix revisit the last filled block, whose
+        # DMA Mosaic then skips (consecutive identical indices).
+        return (b, jnp.minimum(j, n_valid - 1), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(batch, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, heads, head_dim), q_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, kv_heads, head_dim), kv_map,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, kv_heads, head_dim), kv_map,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, heads, head_dim), q_map,
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            # Rows padded to the 8-row sublane (H = 12 at the 110M config);
+            # the kernel touches only the first `heads` rows.
+            pltpu.VMEM((-(-heads // 8) * 8, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((-(-heads // 8) * 8, 128), jnp.float32),  # running max
+            pltpu.VMEM((-(-heads // 8) * 8, 128), jnp.float32),  # denom
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            block=block, kv_heads=kv_heads, group=group,
+            scale=head_dim**-0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((batch, 1, heads, head_dim), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(index, jnp.int32).reshape(1), q, k_buf, v_buf)
+
+
+def decode_block_fits(block: int, length: int) -> int | None:
+    """Largest ``fit_block``-shrunk block that tiles ``length``, or None.
+
+    Decode buffers are ``prompt + max_new`` (arbitrary), so non-tileable
+    lengths fall back to the XLA walk rather than constraining the CLI.
+    """
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import fit_block
+
+    b = fit_block(block, length)
+    return None if (length % b or b % 8) else b

@@ -26,7 +26,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
                     head_dim: int, kv_heads: int = 0,
-                    steps: int = 50, window: int = 0) -> list[dict]:
+                    steps: int = 50, window: int = 0,
+                    kernel: bool = False) -> list[dict]:
     """Per-token decode attention: dense-masked vs windowed, same inputs.
 
     ``kv_heads`` (GQA) sizes the K/V buffers at fewer heads than the query;
@@ -91,6 +92,31 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
         if window
         else None
     )
+    # Fourth arm (--kernel): the fused Pallas decode kernel — the
+    # measurement that decides whether decode_attention's auto-select
+    # flips it on (ops/attention.py use_kernel docstring). Refuse lengths
+    # the kernel can't tile instead of silently timing the walk fallback
+    # under the kernel's name; and add a SHIPPED-config walk arm
+    # (block=2048) so kernel_vs_walk compares against what the dispatcher
+    # would actually replace, not the block=512 measurement arm.
+    fused = shipped_walk = None
+    if kernel:
+        from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
+            decode_block_fits,
+        )
+
+        if decode_block_fits(1024, max_len) is None:
+            raise SystemExit(
+                f"--kernel: max_len {max_len} not tileable by the decode "
+                "kernel (needs a power-of-two-halved block dividing it); "
+                "the arm would silently time the walk fallback"
+            )
+        fused = functools.partial(
+            decode_attention, block=1024, dense_max=0, use_kernel=True
+        )
+        shipped_walk = functools.partial(
+            decode_attention, block=2048, dense_max=0
+        )
 
     def make_loop(fn):
         # Device-looped timing: ONE dispatch runs `n` serialized executions
@@ -148,6 +174,12 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
             us_slide = clock(sliding, q, k_buf, v_buf, i)
             rows[-1]["sliding_window"] = window
             rows[-1]["sliding_us_per_token"] = round(us_slide, 1)
+        if fused is not None:
+            us_kern = clock(fused, q, k_buf, v_buf, i)
+            us_ship = clock(shipped_walk, q, k_buf, v_buf, i)
+            rows[-1]["kernel_us_per_token"] = round(us_kern, 1)
+            rows[-1]["walk2048_us_per_token"] = round(us_ship, 1)
+            rows[-1]["kernel_vs_shipped_walk"] = round(us_ship / us_kern, 2)
         print(json.dumps(rows[-1]))
     return rows
 
@@ -234,6 +266,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="sliding-window size: adds a third arm timing "
                         "the O(window)-reads decode walk, which should be "
                         "FLAT in the fill")
+    parser.add_argument("--kernel", action="store_true",
+                        help="add a fourth arm timing the fused Pallas "
+                        "decode kernel (ops/pallas/flash_decode.py) — the "
+                        "on-chip measurement that decides the dispatcher's "
+                        "auto-select")
     parser.add_argument("--e2e", action="store_true",
                         help="also run the ~110M-LM generate() end-to-end")
     parser.add_argument("--quantize", default="none", choices=("none", "int8"),
@@ -250,7 +287,7 @@ def main(argv: list[str] | None = None) -> int:
     bench_attention(
         args.max_len, fills,
         batch=args.batch, heads=args.heads, head_dim=args.head_dim,
-        kv_heads=args.num_kv_heads, window=args.window,
+        kv_heads=args.num_kv_heads, window=args.window, kernel=args.kernel,
     )
     if args.e2e:
         bench_e2e(
