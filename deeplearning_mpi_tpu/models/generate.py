@@ -387,6 +387,14 @@ def beam_search(
     ranking, and a nonzero α is rejected rather than silently ignored.
 
     Deterministic — no rng. Returns the highest-scoring beam per batch row.
+
+    Two-phase like :func:`generate`: one batched :func:`prefill` forward at
+    batch ``B`` fills ONE cache per row (the prompt is beam-invariant —
+    the old uniform scan prefilled at ``B*W``, W× redundant sequential
+    work), the cache fans out to the ``B*W`` beam-flattened buffers with a
+    row repeat, and the seed step comes straight from the prefill logits:
+    the top-W tokens of each row's last-position distribution ARE the W
+    starting beams. The scan then covers only the generated positions.
     """
     if eos_id is None and length_penalty != 0.0:
         raise ValueError(
@@ -399,22 +407,39 @@ def beam_search(
     W = num_beams
     NEG = jnp.float32(-1e30)
 
-    # Beam-flattened cache: [B*W, total, ...] buffers.
-    cache = decode_model.init(
-        jax.random.key(0), jnp.zeros((batch * W, total), jnp.int32)
-    )["cache"]
-    # prompt broadcast over beams, flattened to [B*W, P]
-    flat_prompt = jnp.repeat(prompt, W, axis=0)
+    # Phase 1: prefill at batch B, fan the cache out to [B*W, ...] (row b's
+    # beams are flat rows b*W..(b+1)*W-1, matching the repeat layout the
+    # parent gather below uses).
+    cache_b, last_logits = prefill(model, params, prompt, total_len=total)
+    cache = jax.tree.map(
+        lambda x: jnp.repeat(x, W, axis=0)
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch
+        else x,  # cache_index scalars — same for every beam
+        cache_b,
+    )
+
+    # Seed: the top-W candidates of each row's next-token distribution,
+    # taken over the beam-0-biased [W, V] candidate table (NOT a bare
+    # top_k(logp0, W): exhaustive-search uses W > vocab, where the extra
+    # beams must exist as NEG-scored dead entries that later selections
+    # never pick — the same table the old uniform scan built at i = P-1).
+    logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+    vocab0 = logp0.shape[-1]
+    seed_cand = jnp.full((batch, W, vocab0), NEG).at[:, 0, :].set(logp0)
+    scores, seed_idx = lax.top_k(seed_cand.reshape(batch, W * vocab0), W)
+    seed_tok = (seed_idx % vocab0).astype(jnp.int32)
+    finished = (
+        seed_tok == eos_id if eos_id is not None
+        else jnp.zeros((batch, W), bool)
+    )
+    lengths = jnp.ones((batch, W), jnp.int32)
 
     identity = jnp.broadcast_to(jnp.arange(W), (batch, W))
 
     def body(carry, i):
         cache, prev_tok, scores, finished, lengths = carry
-        # prev_tok [B, W] int32; scores [B, W] f32
-        prompt_tok = lax.dynamic_index_in_dim(
-            flat_prompt, jnp.minimum(i, prompt_len - 1), axis=1, keepdims=False
-        ).reshape(batch, W)
-        tok = jnp.where(i < prompt_len, prompt_tok, prev_tok)
+        # prev_tok [B, W] int32 — the token at position i; scores [B, W] f32
+        tok = prev_tok
         logits, mutated = decode_model.apply(
             {"params": params, "cache": cache},
             tok.reshape(batch * W, 1),
@@ -430,17 +455,11 @@ def beam_search(
             eos_row = jnp.full((vocab,), NEG).at[eos_id].set(0.0)
             logprobs = jnp.where(finished[..., None], eos_row, logprobs)
 
-        # Step i's selection chooses the token FED at position i+1, so the
-        # beam update is live from the last prompt position (i = P-1, the
-        # seed step) through total-2; the final step's would-be selection
-        # lies outside the returned window and must not touch scores.
-        seed = i == prompt_len - 1
-        update = (i >= prompt_len - 1) & (i < total - 1)
-        # Seed bias: at the seed step only beam 0 competes, so the top-k
-        # over W*V yields the top-W tokens of one distribution — W distinct
-        # starting beams, no branch.
-        beam_bias = jnp.where(seed & (jnp.arange(W) > 0), NEG, 0.0)  # [W]
-        cand = scores[:, :, None] + logprobs + beam_bias[None, :, None]
+        # Step i's selection chooses the token FED at position i+1; the
+        # final step's would-be selection lies outside the returned window
+        # and must not touch scores.
+        update = i < total - 1
+        cand = scores[:, :, None] + logprobs
         top_scores, top_idx = lax.top_k(cand.reshape(batch, W * vocab), W)
         parent = top_idx // vocab  # [B, W]
         next_tok = (top_idx % vocab).astype(jnp.int32)
@@ -462,9 +481,7 @@ def beam_search(
             new_finished, new_lengths = finished, lengths
 
         # Reindex beam-major cache by parent (flat index b*W + parent) —
-        # only when a real update happened; prefill parents are identity
-        # and the O(W·cache) copy every prompt position would double
-        # prefill HBM traffic for nothing.
+        # only when a real update happened.
         flat_parent = (
             jnp.arange(batch)[:, None] * W + new_parent
         ).reshape(-1)
@@ -483,34 +500,32 @@ def beam_search(
             (tok, new_parent),
         )
 
-    init = (
-        cache,
-        jnp.zeros((batch, W), jnp.int32),
-        jnp.zeros((batch, W), jnp.float32),
-        jnp.zeros((batch, W), bool),
-        jnp.zeros((batch, W), jnp.int32),
-    )
+    init = (cache, seed_tok, scores, finished, lengths)
     (_, _, scores, _, lengths), (consumed, parents) = lax.scan(
-        body, init, jnp.arange(total)
+        body, init, jnp.arange(prompt_len, total)
     )
-    # consumed[i] is the [B, W] token fed at position i in the beam
-    # numbering ENTERING step i (frame N_i); parents[i] maps frame N_{i+1}
-    # back to N_i. The final scores/numbering live in frame N_total. Beam w
-    # at the end is NOT beam w throughout — survivors reorder every step —
-    # so each final beam's token sequence is recovered by walking its
-    # ancestry backward: map the index into the earlier frame FIRST, then
-    # read that frame's token.
+    # consumed[t] is the [B, W] token fed at position prompt_len + t in the
+    # beam numbering ENTERING that step (frame N_t); parents[t] maps frame
+    # N_{t+1} back to N_t. The final scores/numbering live in the last
+    # frame. Beam w at the end is NOT beam w throughout — survivors reorder
+    # every step — so each final beam's generated tokens are recovered by
+    # walking its ancestry backward: map the index into the earlier frame
+    # FIRST, then read that frame's token.
     def backtrace(beam, step):
-        tok_i, parent_i = step
-        prev_beam = jnp.take_along_axis(parent_i, beam, axis=1)  # -> N_i
-        tok = jnp.take_along_axis(tok_i, prev_beam, axis=1)
+        tok_t, parent_t = step
+        prev_beam = jnp.take_along_axis(parent_t, beam, axis=1)  # -> N_t
+        tok = jnp.take_along_axis(tok_t, prev_beam, axis=1)
         return prev_beam, tok
 
     final_beam = identity
     _, toks_rev = lax.scan(
         backtrace, final_beam, (consumed[::-1], parents[::-1])
     )
-    beams = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, W, total]
+    gen = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, W, max_new]
+    beams = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None, :], (batch, W, prompt_len)), gen],
+        axis=2,
+    )  # [B, W, total]
 
     ranks = scores
     if eos_id is not None and length_penalty != 0.0:

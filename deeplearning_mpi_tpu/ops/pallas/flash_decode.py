@@ -162,13 +162,26 @@ def flash_decode(
     )(jnp.asarray(index, jnp.int32).reshape(1), q, k_buf, v_buf)
 
 
+#: Smallest block the kernel accepts: below this the grid degenerates into
+#: the near-scalar slicing the walk's full-size-block design exists to
+#: avoid (attention.py's non-dividing-length comment) — fall back to the
+#: walk instead of silently running a 100+-step tiny-block grid.
+_MIN_DECODE_BLOCK = 256
+
+
 def decode_block_fits(block: int, length: int) -> int | None:
     """Largest ``fit_block``-shrunk block that tiles ``length``, or None.
 
     Decode buffers are ``prompt + max_new`` (arbitrary), so non-tileable
-    lengths fall back to the XLA walk rather than constraining the CLI.
+    lengths (and lengths only tileable by degenerate tiny blocks) fall
+    back to the XLA walk rather than constraining the CLI.
     """
     from deeplearning_mpi_tpu.ops.pallas.flash_attention import fit_block
 
     b = fit_block(block, length)
-    return None if (length % b or b % 8) else b
+    # Floor scales down with an explicitly small requested block (tests use
+    # 16-row blocks on tiny buffers); the dispatcher's production request
+    # (1024) gets the full floor.
+    if length % b or b % 8 or b < min(_MIN_DECODE_BLOCK, block):
+        return None
+    return b

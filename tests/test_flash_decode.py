@@ -95,12 +95,18 @@ class TestDispatcher:
     def test_windowed_keeps_walk(self):
         # The walk's windowed start-block skip already gives O(window)
         # traffic; the kernel doesn't take window and must not be selected.
+        # Bitwise equality with the explicit walk is the proof — a dropped
+        # window-guard would produce full-prefix (wrong but finite) values.
         q, k, v = _bufs(idx=50)
         out = decode_attention(
             q, k, v, jnp.int32(50), block=16, dense_max=0, window=8,
             use_kernel=True,
         )
-        assert bool(jnp.all(jnp.isfinite(out)))
+        ref = decode_attention(
+            q, k, v, jnp.int32(50), block=16, dense_max=0, window=8,
+            use_kernel=False,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
     def test_cpu_auto_keeps_walk(self):
         # use_kernel=None on CPU: the walk (fast XLA) — the interpreter
@@ -118,3 +124,6 @@ def test_decode_block_fits():
     assert decode_block_fits(1024, 1536) == 512
     assert decode_block_fits(16, 64) == 16
     assert decode_block_fits(1024, 20) is None
+    # 1048 is only tileable by a degenerate 8-row block — a 131-step
+    # near-scalar grid must fall back to the walk, not run (review r5).
+    assert decode_block_fits(1024, 1048) is None
