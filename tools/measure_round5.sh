@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Round-5 on-chip measurement runbook, executable form. Run on a machine
+# whose TPU tunnel is ALIVE — the round-5 build session lost the tunnel
+# for hours mid-round (a timed-out kill landed mid-compile; see
+# BASELINE.md tunnel notes), so everything chip-bound queued up here.
+#
+# Same bounding strategy as measure_round4.sh: a 120 s probe gates entry
+# and re-runs between steps; generous per-step timeouts are a last resort
+# against an already-dead tunnel, never a scheduler. A failed step does
+# not stop later ones but fails the exit status.
+#
+# What the results feed:
+#   steps 1-2  -> BENCH_r05 serving split + BASELINE.md "Established
+#                 baselines" (prefill/decode tokens/s at B=1/8/32)
+#   step  3    -> the flash-decode kernel's go/no-go: if
+#                 kernel_vs_shipped_walk > 1 at 8k/16k fills, flip
+#                 decode_attention's auto-select (ops/attention.py
+#                 use_kernel docstring) and re-run this step
+#   step  4    -> windowed-ring on-chip sanity (rotation skipping compiles
+#                 and trains at 32k over sp=1... single chip: ring=1 is
+#                 degenerate — this is a compile/parity check, not a
+#                 scaling claim; real scaling needs a pod)
+#   step  5    -> PERF_ANALYSIS "LM whole-step attribution" (round-4
+#                 verdict #2): per-op table from the profiler trace
+#
+# Results go to stdout (JSON lines / tables); append to BASELINE.md and
+# docs/PERF_ANALYSIS.md §10.
+
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+probe() {
+    timeout -k 10 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+step() {  # step <name> <timeout_s> <cmd...>
+    local name=$1 t=$2; shift 2
+    echo "== $name =="
+    if ! probe; then
+        echo "TUNNEL DEAD before '$name' — skipping remaining steps" >&2
+        rc=2
+        exit $rc
+    fi
+    if ! timeout -k 30 "$t" "$@"; then
+        echo "STEP FAILED: $name" >&2
+        rc=1
+    fi
+}
+
+step "1. full bench (incl. the new lm_serving_2k prefill/decode split)" 2400 \
+    python bench.py
+step "2. decode micro-bench with the fused-kernel arm, 8k buffer" 1500 \
+    python tools/bench_decode.py --kernel --max_len 8192 \
+    --fills 1024 4096 8192
+step "3. fused-kernel arm at 16k buffer" 1500 \
+    python tools/bench_decode.py --kernel --max_len 16384 \
+    --fills 4096 16384
+step "4. windowed ring compile check (sp degenerates to 1 on one chip)" 1200 \
+    python -m deeplearning_mpi_tpu.cli.train_lm \
+    --seq_len 32768 --attention ring --attention_window 4096 --remat \
+    --loss_chunk 2048 --batch_size 1 --num_epochs 1 --train_sequences 2 \
+    --dtype bfloat16 --num_layers 12 --num_heads 12 --head_dim 64 \
+    --d_model 768 --d_ff 3072 \
+    --model_dir /tmp/m5_ckpt --log_dir /tmp/m5_logs
+step "5. LM whole-step trace attribution (2k flash step)" 1500 \
+    python tools/profile_lm.py
+
+exit $rc

@@ -25,7 +25,7 @@ from tools.profile_resnet import analyze_trace  # noqa: E402
 
 
 def run_traced_steps(seq_len: int, batch_size: int, trace_dir: str,
-                     steps: int = 6) -> dict:
+                     steps: int = 6, layout: str = "bhsd") -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -33,14 +33,21 @@ def run_traced_steps(seq_len: int, batch_size: int, trace_dir: str,
         TransformerConfig,
         TransformerLM,
     )
-    from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+        flash_attention,
+        flash_attention_bhsd,
+    )
     from deeplearning_mpi_tpu.train import create_train_state, make_train_step
     from deeplearning_mpi_tpu.train.trainer import build_optimizer
     from deeplearning_mpi_tpu.utils.profiling import host_sync
 
     config = TransformerConfig()
+    # Default = the BHSD-kernel-native path bench_lm ships (projections
+    # emit the kernel layout, no transposes) — the attribution must profile
+    # the flagship configuration, not the older BSHD entry.
+    attn = flash_attention_bhsd if layout == "bhsd" else flash_attention
     model = TransformerLM(
-        config=config, dtype=jnp.bfloat16, attention_fn=flash_attention
+        config=config, dtype=jnp.bfloat16, attention_fn=attn
     )
     tx = build_optimizer("adam", 3e-4, clip_norm=1.0)
     state = create_train_state(
@@ -86,10 +93,13 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--trace_dir", default="/tmp/lm_trace")
     ap.add_argument("--top_k", type=int, default=40)
+    ap.add_argument("--layout", default="bhsd", choices=("bhsd", "bshd"),
+                    help="attention entry: bhsd = the kernel-native "
+                    "flagship path bench_lm ships (default)")
     args = ap.parse_args()
 
     res = run_traced_steps(args.seq_len, args.batch_size, args.trace_dir,
-                           args.steps)
+                           args.steps, layout=args.layout)
     print(f"step {res['step_time_ms']:.2f} ms, "
           f"{res['tokens_per_s']:.0f} tokens/s, {res['n_params']:,} params")
     analyze_trace(args.trace_dir, args.steps, args.top_k)
