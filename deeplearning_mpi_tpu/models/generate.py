@@ -247,6 +247,7 @@ def generate(
     top_p: float = 1.0,
     eos_id: int | None = None,
     prompt_lens: jax.Array | None = None,
+    shared_prefix: int = 0,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` ``[B, P]``.
 
@@ -255,8 +256,12 @@ def generate(
     :func:`prefill` forward over the prompt (MXU-bound, flash-kernel
     capable), then a :func:`decode_tokens` scan over ONLY the new tokens —
     O(P) sequential steps cheaper than scanning every position. Ragged
-    batches keep the uniform scan (each row switches from prompt to samples
-    at its own length mid-scan, which has no single prefill boundary).
+    batches keep the per-row-switch scan, but ``shared_prefix`` (a STATIC
+    length the caller knows, normally ``min(prompt_lens)`` read host-side)
+    prefills the first ``shared_prefix`` positions in the same batched
+    forward and scans only from there — the CLI's ``--prompts_file`` path
+    pays sequential steps only for the ragged tail. The caller must
+    guarantee ``shared_prefix <= min(prompt_lens)``.
 
     ``eos_id``: once a row SAMPLES that token, every later position in the
     row is forced to ``eos_id`` (the scan's shapes are static, so "stop"
@@ -292,11 +297,35 @@ def generate(
     decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
     plens = prompt_lens.astype(jnp.int32)
 
-    # Decode-mode init with the full-length input shapes the cache buffers;
-    # params from init are discarded (we use the trained ones).
-    cache = decode_model.init(
-        jax.random.key(0), jnp.zeros((batch, total), jnp.int32)
-    )["cache"]
+    start = int(shared_prefix)
+    if start > 0:
+        # Batched prefill of the shared prefix; the scan resumes at `start`
+        # with the carry the step-(start-1) iteration would have produced:
+        # the sampled candidate for position `start` (only rows whose whole
+        # prompt fit the prefix use it — longer rows keep feeding prompt),
+        # with the EOS done-seed gated to exactly those rows (the old
+        # step's `i >= plens - 1` at i = start - 1). Equivalence note: the
+        # full scan split the rng `start` times before this point where
+        # this path splits once, so SAMPLED (temperature > 0) realizations
+        # differ by prefix length — same distribution, different stream;
+        # greedy output is bitwise identical (pinned in tests).
+        cache, logits = prefill(
+            model, params, prompt[:, :start], total_len=total
+        )
+        first, done0, rng = first_token(
+            logits, rng, temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_id=eos_id,
+        )
+        done0 = done0 & (plens == start)
+        init_tok = first
+    else:
+        # Decode-mode init with the full-length input shapes the cache
+        # buffers; params from init are discarded (we use the trained ones).
+        cache = decode_model.init(
+            jax.random.key(0), jnp.zeros((batch, total), jnp.int32)
+        )["cache"]
+        init_tok = jnp.zeros((batch,), jnp.int32)
+        done0 = jnp.zeros((batch,), bool)
 
     def body(carry, i):
         cache, prev_tok, rng, done = carry
@@ -324,16 +353,18 @@ def generate(
             done = done | sampled_eos
         return (mutated["cache"], next_tok, rng, done), tok
 
-    init = (
-        cache, jnp.zeros((batch,), jnp.int32), rng,
-        jnp.zeros((batch,), bool),
-    )
-    (_, _, _, _), consumed = lax.scan(body, init, jnp.arange(total))
-    # consumed[i] is the token fed at position i: prompt tokens for i < P,
-    # and for i >= P the sample produced at step i-1 — i.e. exactly the
-    # generated continuation. (The final step's sample would be the token
-    # for position `total`, outside the window, and is discarded.)
-    return jnp.moveaxis(consumed, 0, 1)  # [B, total]
+    init = (cache, init_tok, rng, done0)
+    (_, _, _, _), consumed = lax.scan(body, init, jnp.arange(start, total))
+    # consumed[t] is the token fed at position start + t: prompt tokens
+    # while t < plens - start, afterwards the sample produced at the
+    # previous step — i.e. exactly the generated continuation. (The final
+    # step's sample would be the token for position `total`, outside the
+    # window, and is discarded.) Positions before `start` were fed by the
+    # prefill and are the prompt verbatim.
+    tail = jnp.moveaxis(consumed, 0, 1)  # [B, total - start]
+    if start > 0:
+        return jnp.concatenate([prompt[:, :start], tail], axis=1)
+    return tail  # [B, total]
 
 
 def generate_jit(model: TransformerLM, **static_kwargs: Any):

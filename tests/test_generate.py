@@ -524,6 +524,66 @@ class TestRaggedBatch:
         np.testing.assert_array_equal(np.asarray(out)[0, :2], [8, 1])
         assert not np.array_equal(np.asarray(out)[0, 2:5], [31, 31, 31])
 
+    def test_shared_prefix_matches_full_scan(self):
+        """shared_prefix (the CLI's min-length hint) must be an execution-
+        schedule change only: greedy outputs equal the full per-row-switch
+        scan for every prefix length up to min(prompt_lens)."""
+        cfg = dataclasses.replace(TransformerConfig.tiny(), vocab_size=32)
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        padded = jnp.asarray(
+            [[5, 9, 11, 2, 7], [8, 1, 0, 0, 0]], jnp.int32
+        )
+        plens = jnp.asarray([5, 2], jnp.int32)
+        base = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+        )
+        for prefix in (1, 2):  # up to min(plens)
+            out = generate(
+                model, params, padded, max_new_tokens=4,
+                rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+                shared_prefix=prefix,
+            )
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+    def test_shared_prefix_composes_with_eos(self):
+        """EOS done-seed at the prefix boundary: a row whose whole prompt
+        fits the prefix and whose FIRST sample is the EOS must pad from
+        there, exactly like the full scan."""
+        cfg = dataclasses.replace(TransformerConfig.tiny(), vocab_size=32)
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        padded = jnp.asarray(
+            [[5, 9, 11, 2, 7], [8, 1, 0, 0, 0]], jnp.int32
+        )
+        plens = jnp.asarray([5, 2], jnp.int32)
+        # Row b's first greedy token (position 2) becomes the EOS.
+        free = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+        )
+        eos = int(np.asarray(free)[1, 2])
+        base = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+            eos_id=eos,
+        )
+        out = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+            eos_id=eos, shared_prefix=2,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+        # And row b really padded with EOS from its first generated slot.
+        np.testing.assert_array_equal(
+            np.asarray(out)[1, 2:6], np.full(4, eos)
+        )
+
     def test_ragged_batch_composes_with_eos(self):
         # Per-row EOS selection windows (i >= plens[b]-1) with per-row
         # prompt switches: each ragged row must equal its solo run under
