@@ -149,14 +149,15 @@ def decode_attention(
     trade.
 
     ``use_kernel``: the fused Pallas decode kernel
-    (``ops.pallas.flash_decode``) for long non-windowed buffers — one grid
-    instead of the walk's ``lax.fori_loop`` (whose ~40 µs/iteration host
-    overhead caps the walk at ~45% of the HBM roofline,
-    PERF_ANALYSIS §9), keeping O(index) HBM traffic via its clamped index
-    map. ``True`` selects it when the buffer tiles (the interpreter
-    off-TPU); ``None``/``False`` keep the walk — auto-selection waits on
-    an on-chip Mosaic validation + measurement (tools/bench_decode.py
-    ``--kernel``), at which point ``None`` should flip to TPU-auto.
+    (``ops.pallas.flash_decode``) for long buffers — one grid instead of
+    the walk's ``lax.fori_loop`` (whose ~40 µs/iteration host overhead
+    caps the walk at ~45% of the HBM roofline, PERF_ANALYSIS §9), keeping
+    O(index) — O(window) for sliding-window models — HBM traffic via its
+    two-sided clamped index map. ``True`` selects it when the buffer tiles
+    (the interpreter off-TPU); ``None``/``False`` keep the walk —
+    auto-selection waits on an on-chip Mosaic validation + measurement
+    (tools/bench_decode.py ``--kernel``), at which point ``None`` should
+    flip to TPU-auto.
 
     Not differentiable (dynamic trip count) — decode is inference-only.
     """
@@ -192,7 +193,7 @@ def decode_attention(
             preferred_element_type=jnp.float32,
         )
         return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)
-    if window is None and use_kernel:
+    if use_kernel:
         from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
             decode_block_fits,
             flash_decode,
@@ -200,7 +201,9 @@ def decode_attention(
 
         fitted = decode_block_fits(min(block, 1024), length)
         if fitted is not None:
-            return flash_decode(q, k_buf, v_buf, index, block=fitted)
+            return flash_decode(
+                q, k_buf, v_buf, index, block=fitted, window=window
+            )
     # Blocks stay full-size whatever the buffer length (a CLI cache is
     # prompt+max_new — arbitrary): the final block's start is clamped back
     # so it never runs off the buffer, and rows it re-reads from the

@@ -8,12 +8,15 @@ with ONE `pallas_call`: the kv-block axis is a sequential grid dimension, the
 online-softmax accumulator lives in VMEM scratch, and the dynamic fill level
 rides a scalar-prefetch argument:
 
-- the **index map clamps** out-of-prefix grid steps to the last filled block
-  — Mosaic skips the DMA when consecutive steps map to the same block, so
-  HBM traffic stays O(index), the walk's defining advantage over the
+- the **index map clamps both ends**: out-of-prefix grid steps collapse
+  onto the last filled block, and (for sliding-window models) pre-window
+  steps onto the window's first block — Mosaic skips the DMA when
+  consecutive steps map to the same block, so HBM traffic stays O(index)
+  (O(window) with a window), the walk's defining advantage over the
   read-everything dense path;
-- the **compute gate** (`pl.when(j < n_valid)`) skips their FLOPs;
-- masking inside the boundary block uses the prefetched `index` scalar.
+- the **compute gate** (`pl.when(j_lo <= j < n_valid)`) skips their FLOPs;
+- masking inside the boundary blocks uses the prefetched `index` scalar
+  (both the filled-prefix end and the window's trailing edge).
 
 Layout: the cache is BSHD (`[B, L, Hkv, D]`) and the kernel blocks over L
 only, keeping each row's full `Hkv x D` contiguous — the same access pattern
@@ -35,9 +38,18 @@ from jax.experimental.pallas import tpu as pltpu
 from deeplearning_mpi_tpu.ops.attention import NEG_INF
 
 
+def _window_start_block(index, window: int, block: int):
+    """First cache block intersecting the window — ONE definition shared by
+    the kernel's compute gate and the index map's clamp: if the two drift,
+    a gated-on grid step could score a block whose DMA was collapsed onto
+    a different one (silently wrong output)."""
+    return jnp.maximum(index - window + 1, 0) // block
+
+
 def _decode_kernel(
     idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l,
     *, block: int, kv_heads: int, group: int, scale: float,
+    window: int | None = None,
 ):
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -50,13 +62,22 @@ def _decode_kernel(
 
     index = idx_ref[0]
     n_valid = (index + block) // block  # blocks with >= 1 filled row
+    run = j < n_valid
+    if window is not None:
+        # Sliding-window models: blocks wholly before the window are
+        # skipped (their DMAs collapse onto the window's first block via
+        # the clamped index map) — O(window) traffic per token, like the
+        # walk's start-block skip.
+        run = run & (j >= _window_start_block(index, window, block))
 
-    @pl.when(j < n_valid)
+    @pl.when(run)
     def _update():
         # Rows beyond the filled prefix are masked (only the boundary block
         # has any; interior blocks mask nothing and the where folds away).
         pos = j * block + lax.broadcasted_iota(jnp.int32, (1, block), 1)
         valid = pos <= index  # [1, block]
+        if window is not None:
+            valid &= pos > index - window
         for h in range(kv_heads):
             q_h = q_ref[0, 0, h * group : (h + 1) * group, :]  # [G, D]
             k_h = k_ref[0, :, h, :]  # [block, D]
@@ -98,14 +119,16 @@ def flash_decode(
     *,
     block: int = 1024,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """One fused decode step over the cache's filled prefix.
 
     Same contract as the blockwise walk in
     :func:`~deeplearning_mpi_tpu.ops.attention.decode_attention`: ``q``
     ``[B, 1, H, D]``, grouped cache buffers ``[B, L, Hkv, D]``, positions
-    ``0..index`` filled; returns ``[B, 1, H, D]``. Caller guarantees
-    ``L % block == 0`` (see :func:`decode_block_fits`).
+    ``0..index`` filled (``window``: attend the last ``window`` of them
+    only); returns ``[B, 1, H, D]``. Caller guarantees ``L % block == 0``
+    (see :func:`decode_block_fits`).
     """
     batch, q_len, heads, head_dim = q.shape
     length, kv_heads = k_buf.shape[1], k_buf.shape[2]
@@ -121,10 +144,17 @@ def flash_decode(
     def kv_map(b, j, idx_ref):
         # Index maps receive the prefetched scalar AFTER the grid indices,
         # as a (1,)-shaped ref.
-        n_valid = (idx_ref[0] + block) // block
-        # Clamp: steps past the prefix revisit the last filled block, whose
-        # DMA Mosaic then skips (consecutive identical indices).
-        return (b, jnp.minimum(j, n_valid - 1), 0, 0)
+        idx = idx_ref[0]
+        n_valid = (idx + block) // block
+        # Clamp both ends: steps past the prefix revisit the last filled
+        # block, pre-window steps the window's first block — Mosaic skips
+        # the DMA on consecutive identical indices either way.
+        j_eff = jnp.minimum(j, n_valid - 1)
+        if window is not None:
+            j_eff = jnp.maximum(
+                j_eff, _window_start_block(idx, window, block)
+            )
+        return (b, j_eff, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -151,7 +181,7 @@ def flash_decode(
         functools.partial(
             _decode_kernel,
             block=block, kv_heads=kv_heads, group=group,
-            scale=head_dim**-0.5,
+            scale=head_dim**-0.5, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((batch, 1, heads, head_dim), q.dtype),
         grid_spec=grid_spec,

@@ -92,21 +92,35 @@ class TestDispatcher:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
-    def test_windowed_keeps_walk(self):
-        # The walk's windowed start-block skip already gives O(window)
-        # traffic; the kernel doesn't take window and must not be selected.
-        # Bitwise equality with the explicit walk is the proof — a dropped
-        # window-guard would produce full-prefix (wrong but finite) values.
+    @pytest.mark.parametrize("window", [8, 16, 40, 100])
+    def test_windowed_kernel_matches_windowed_walk(self, window):
+        # Sliding-window decode through the kernel: the two-sided clamp
+        # (pre-window AND post-prefix steps collapse onto boundary blocks)
+        # must reproduce the windowed walk at every window size — inside a
+        # block, block-aligned, spanning blocks, and >= fill (plain prefix).
         q, k, v = _bufs(idx=50)
         out = decode_attention(
-            q, k, v, jnp.int32(50), block=16, dense_max=0, window=8,
+            q, k, v, jnp.int32(50), block=16, dense_max=0, window=window,
             use_kernel=True,
         )
         ref = decode_attention(
-            q, k, v, jnp.int32(50), block=16, dense_max=0, window=8,
+            q, k, v, jnp.int32(50), block=16, dense_max=0, window=window,
             use_kernel=False,
         )
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_windowed_kernel_skips_prewindow_blocks(self):
+        """Poison blocks wholly before the window AND wholly after the
+        prefix: the clamped index map must read neither."""
+        q, k, v = _bufs(B=1, L=128, idx=79)  # window 16 -> rows 64..79
+        k = np.array(k); v = np.array(v)
+        k[:, :48] = np.nan; v[:, :48] = np.nan   # pre-window blocks (16-row)
+        k[:, 96:] = np.nan; v[:, 96:] = np.nan   # past the boundary block
+        out = flash_decode(
+            q, jnp.asarray(k), jnp.asarray(v), jnp.int32(79), block=16,
+            interpret=True, window=16,
+        )
+        assert np.all(np.isfinite(np.asarray(out)))
 
     def test_cpu_auto_keeps_walk(self):
         # use_kernel=None on CPU: the walk (fast XLA) — the interpreter

@@ -180,6 +180,15 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
             rows[-1]["kernel_us_per_token"] = round(us_kern, 1)
             rows[-1]["walk2048_us_per_token"] = round(us_ship, 1)
             rows[-1]["kernel_vs_shipped_walk"] = round(us_ship / us_kern, 2)
+            if window:
+                us_kw = clock(
+                    functools.partial(
+                        decode_attention, block=1024, dense_max=0,
+                        use_kernel=True, window=window,
+                    ),
+                    q, k_buf, v_buf, i,
+                )
+                rows[-1]["kernel_windowed_us_per_token"] = round(us_kw, 1)
         print(json.dumps(rows[-1]))
     return rows
 
