@@ -46,11 +46,31 @@ def _window_start_block(index, window: int, block: int):
     return jnp.maximum(index - window + 1, 0) // block
 
 
+def quantize_kv(buf: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-row-per-head int8 for KV cache buffers.
+
+    ``[B, L, Hkv, D]`` float → ``(int8 [B, L, Hkv, D], f32 scales
+    [B, L, Hkv])``. Halves the decode phase's per-row cache bytes — the
+    term batching cannot amortize (PERF_ANALYSIS §10: ~75 MB/step/row at
+    2k MHA vs the 220 MB batch-invariant weight read) — at a per-element
+    quantization error ≤ scale/2, the same contract as the weight-only
+    int8 kernels (`ops/quant.py`).
+    """
+    amax = jnp.max(jnp.abs(buf.astype(jnp.float32)), axis=-1)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.round(buf.astype(jnp.float32) / scales[..., None])
+    return q.astype(jnp.int8), scales
+
+
 def _decode_kernel(
-    idx_ref, q_ref, k_ref, v_ref, o_ref, acc, m, l,
-    *, block: int, kv_heads: int, group: int, scale: float,
-    window: int | None = None,
+    idx_ref, q_ref, *refs,
+    block: int, kv_heads: int, group: int, scale: float,
+    window: int | None = None, quantized: bool = False,
 ):
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, acc, m, l = refs
+    else:
+        (k_ref, v_ref, o_ref, acc, m, l), ks_ref, vs_ref = refs, None, None
     j = pl.program_id(1)
     nb = pl.num_programs(1)
 
@@ -80,12 +100,20 @@ def _decode_kernel(
             valid &= pos > index - window
         for h in range(kv_heads):
             q_h = q_ref[0, 0, h * group : (h + 1) * group, :]  # [G, D]
-            k_h = k_ref[0, :, h, :]  # [block, D]
-            v_h = v_ref[0, :, h, :]
+            # int8 buffers: cast to the q dtype for fast MXU dots and
+            # factor the per-row scales OUT of the contractions (the
+            # QuantDense dot-then-scale form, ops/quant.py — bf16's 8
+            # mantissa bits represent ±127 exactly): the K scales multiply
+            # the score columns after the dot, the V scales fold into p
+            # before the V dot — O(block) scale work, not O(block·D).
+            k_h = k_ref[0, :, h, :].astype(q_h.dtype)  # [block, D]
+            v_h = v_ref[0, :, h, :].astype(q_h.dtype)
             s = lax.dot_general(
                 q_h, k_h, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [G, block]
+            if quantized:
+                s = s * ks_ref[0, :, h][None, :]
             s = jnp.where(valid, s, NEG_INF)
             rows = slice(h * group, (h + 1) * group)
             m_prev = m[rows, :1]  # [G, 1]
@@ -95,6 +123,8 @@ def _decode_kernel(
             p = jnp.where(valid, p, 0.0)  # finite NEG_INF ⇒ re-zero masked
             alpha = jnp.exp(m_prev - m_new)
             l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            if quantized:
+                p = p * vs_ref[0, :, h][None, :]
             pv = lax.dot_general(
                 p.astype(v_h.dtype), v_h, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -120,6 +150,8 @@ def flash_decode(
     block: int = 1024,
     interpret: bool | None = None,
     window: int | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """One fused decode step over the cache's filled prefix.
 
@@ -129,7 +161,20 @@ def flash_decode(
     ``0..index`` filled (``window``: attend the last ``window`` of them
     only); returns ``[B, 1, H, D]``. Caller guarantees ``L % block == 0``
     (see :func:`decode_block_fits`).
+
+    ``k_scale``/``v_scale`` (``[B, L, Hkv]`` f32, from :func:`quantize_kv`)
+    switch the buffers to int8: the kernel reads half the cache bytes per
+    step — the batched-decode term §10's roofline says batching can't
+    amortize — and dequantizes per block in VMEM.
     """
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if quantized and (k_buf.dtype != jnp.int8 or v_buf.dtype != jnp.int8):
+        raise ValueError(
+            f"scales given but buffers are not int8 (k={k_buf.dtype}, "
+            f"v={v_buf.dtype}) — quantize BOTH with quantize_kv first"
+        )
     batch, q_len, heads, head_dim = q.shape
     length, kv_heads = k_buf.shape[1], k_buf.shape[2]
     group = heads // kv_heads
@@ -156,17 +201,29 @@ def flash_decode(
             )
         return (b, j_eff, 0, 0)
 
+    kv_spec = pl.BlockSpec((1, block, kv_heads, head_dim), kv_map,
+                           memory_space=pltpu.VMEM)
+    scale_spec = pl.BlockSpec(
+        (1, block, kv_heads), lambda b, j, idx_ref: kv_map(b, j, idx_ref)[:3],
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, heads, head_dim), q_map, memory_space=pltpu.VMEM),
+        kv_spec,
+    ]
+    operands = [q, k_buf]
+    if quantized:
+        in_specs.append(scale_spec)
+        operands.append(k_scale)
+    in_specs.append(kv_spec)
+    operands.append(v_buf)
+    if quantized:
+        in_specs.append(scale_spec)
+        operands.append(v_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(batch, n_blocks),
-        in_specs=[
-            pl.BlockSpec((1, 1, heads, head_dim), q_map,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, kv_heads, head_dim), kv_map,
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, kv_heads, head_dim), kv_map,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, heads, head_dim), q_map,
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -181,7 +238,7 @@ def flash_decode(
         functools.partial(
             _decode_kernel,
             block=block, kv_heads=kv_heads, group=group,
-            scale=head_dim**-0.5, window=window,
+            scale=head_dim**-0.5, window=window, quantized=quantized,
         ),
         out_shape=jax.ShapeDtypeStruct((batch, 1, heads, head_dim), q.dtype),
         grid_spec=grid_spec,
@@ -189,7 +246,7 @@ def flash_decode(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(jnp.asarray(index, jnp.int32).reshape(1), q, k_buf, v_buf)
+    )(jnp.asarray(index, jnp.int32).reshape(1), *operands)
 
 
 #: Smallest block the kernel accepts: below this the grid degenerates into

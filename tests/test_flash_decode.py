@@ -68,6 +68,53 @@ class TestFlashDecodeKernel:
         )
 
 
+class TestInt8KV:
+    """int8 KV-cache variant: half the cache bytes, VMEM dequantization."""
+
+    @pytest.mark.parametrize("hkv", [4, 2], ids=["mha", "gqa2"])
+    @pytest.mark.parametrize("window", [None, 24])
+    def test_matches_walk_on_dequantized_buffers(self, hkv, window):
+        """The kernel on (int8, scales) must equal the walk on the
+        DEQUANTIZED buffers — quantization error is quantize_kv's contract,
+        not the kernel's; the kernel itself must be exact."""
+        from deeplearning_mpi_tpu.ops.pallas.flash_decode import quantize_kv
+
+        q, k, v = _bufs(Hkv=hkv, idx=50)
+        k8, ks = quantize_kv(k)
+        v8, vs = quantize_kv(v)
+        k_dq = k8.astype(jnp.float32) * ks[..., None]
+        v_dq = v8.astype(jnp.float32) * vs[..., None]
+        ref = decode_attention(
+            q, k_dq, v_dq, jnp.int32(50), block=16, dense_max=0,
+            use_kernel=False, window=window,
+        )
+        out = flash_decode(
+            q, k8, v8, jnp.int32(50), block=16, interpret=True,
+            window=window, k_scale=ks, v_scale=vs,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_quantization_error_bounded(self):
+        from deeplearning_mpi_tpu.ops.pallas.flash_decode import quantize_kv
+
+        _, k, _ = _bufs(idx=63)
+        k8, ks = quantize_kv(k)
+        k_dq = np.asarray(k8, np.float32) * np.asarray(ks)[..., None]
+        err = np.abs(k_dq - np.asarray(k))
+        assert np.all(err <= np.asarray(ks)[..., None] / 2 + 1e-6)
+
+    def test_scales_without_int8_rejected(self):
+        from deeplearning_mpi_tpu.ops.pallas.flash_decode import quantize_kv
+
+        q, k, v = _bufs(idx=20)
+        _, ks = quantize_kv(k)
+        with pytest.raises(ValueError, match="int8"):
+            flash_decode(
+                q, k, v, jnp.int32(20), block=16, interpret=True,
+                k_scale=ks, v_scale=ks,
+            )
+
+
 class TestDispatcher:
     def test_use_kernel_true_matches_walk(self):
         q, k, v = _bufs(idx=50)

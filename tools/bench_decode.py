@@ -99,13 +99,16 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
     # under the kernel's name; and add a SHIPPED-config walk arm
     # (block=2048) so kernel_vs_walk compares against what the dispatcher
     # would actually replace, not the block=512 measurement arm.
-    fused = shipped_walk = None
+    fused = shipped_walk = fused_q8 = None
     if kernel:
         from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
             decode_block_fits,
+            flash_decode,
+            quantize_kv,
         )
 
-        if decode_block_fits(1024, max_len) is None:
+        fitted = decode_block_fits(1024, max_len)
+        if fitted is None:
             raise SystemExit(
                 f"--kernel: max_len {max_len} not tileable by the decode "
                 "kernel (needs a power-of-two-halved block dividing it); "
@@ -117,6 +120,18 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
         shipped_walk = functools.partial(
             decode_attention, block=2048, dense_max=0
         )
+        # int8-KV arm: half the cache bytes — the batching-resistant term
+        # of the serving roofline (PERF_ANALYSIS §10). Same FITTED block as
+        # the fused arm (a hardcoded 1024 would silently truncate attention
+        # for non-multiple max_len). Exactness vs the dequantized oracle is
+        # pinned in tests; this times the HBM win.
+        k8_buf, k8_scale = quantize_kv(k_buf)
+        v8_buf, v8_scale = quantize_kv(v_buf)
+
+        def fused_q8(q, k8, v8, i, _b=fitted, _ks=k8_scale, _vs=v8_scale):
+            return flash_decode(
+                q, k8, v8, i, block=_b, k_scale=_ks, v_scale=_vs
+            )
 
     def make_loop(fn):
         # Device-looped timing: ONE dispatch runs `n` serialized executions
@@ -189,6 +204,9 @@ def bench_attention(max_len: int, fills: list[int], *, batch: int, heads: int,
                     q, k_buf, v_buf, i,
                 )
                 rows[-1]["kernel_windowed_us_per_token"] = round(us_kw, 1)
+            us_q8 = clock(fused_q8, q, k8_buf, v8_buf, i)
+            rows[-1]["kernel_int8kv_us_per_token"] = round(us_q8, 1)
+            rows[-1]["int8kv_vs_kernel"] = round(us_kern / us_q8, 2)
         print(json.dumps(rows[-1]))
     return rows
 
