@@ -19,7 +19,19 @@ from deeplearning_mpi_tpu.models.resnet import (  # noqa: F401
     resnet101,
     resnet152,
 )
-from deeplearning_mpi_tpu.models.moe import MoEMLP, collect_aux_loss  # noqa: F401
+from deeplearning_mpi_tpu.models.generate import (  # noqa: F401
+    beam_search,
+    beam_search_jit,
+    decode_tokens,
+    generate,
+    generate_jit,
+    prefill,
+)
+from deeplearning_mpi_tpu.models.moe import (  # noqa: F401
+    MoEMLP,
+    collect_aux_loss,
+    collect_dropped_fraction,
+)
 from deeplearning_mpi_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,
     TransformerLM,
