@@ -67,4 +67,11 @@ step "4. windowed ring compile check (sp degenerates to 1 on one chip)" 1200 \
 step "5. LM whole-step trace attribution (2k flash step)" 1500 \
     python tools/profile_lm.py
 
+# Candidate MFU lever for the attribution's likely top line: the 2k step
+# materializes [8, 2048, 32000] f32 logits (~2 GB) through forward AND
+# backward; the chunked head+loss path (built for 64k) never does. If
+# this wins, make loss_chunk the bench_lm default and re-attribute.
+step "6. LM 2k with chunked head+loss (vs step 1's lm entry)" 1200 \
+    python -c "import bench, json; print(json.dumps(bench.bench_lm(steps=8, loss_chunk=512)))"
+
 exit $rc
