@@ -55,6 +55,20 @@ def attention_fn_layout(fn: AttentionFn | None) -> str:
     return "bshd"
 
 
+def attention_fn_accepts_gqa(fn: AttentionFn | None) -> bool:
+    """Whether the attention fn consumes GROUPED K/V natively (its
+    ``gqa_native`` attribute, through ``partial`` chains — same mechanics
+    as :func:`attention_fn_layout`). The ring factory sets it: rotating
+    Hkv-head blocks divides ring ICI volume by H/Hkv; everything else
+    receives ``repeat_kv``'d tensors as before."""
+    while fn is not None:
+        native = getattr(fn, "gqa_native", None)
+        if native is not None:
+            return bool(native)
+        fn = getattr(fn, "func", None)
+    return False
+
+
 def apply_rope(
     x: jax.Array,
     positions: jax.Array,
@@ -261,10 +275,15 @@ class Attention(nn.Module):
             ctx = self._cached_attention(q, k, v)
         else:
             attn = self.attention_fn or dense_attention
-            ctx = attn(
-                q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal,
-                **self._window_kw(),
-            )
+            if attention_fn_accepts_gqa(attn):
+                # GQA-native schedule (the ring): grouped K/V go straight
+                # in — the repeat happens inside, after the ICI hop.
+                ctx = attn(q, k, v, causal=causal, **self._window_kw())
+            else:
+                ctx = attn(
+                    q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal,
+                    **self._window_kw(),
+                )
         ctx = ctx.reshape(batch, seq, features)
         # "out_proj" triggers tensor_parallel's row-parallel (input-dim) rule.
         return dense(x.shape[-1], "out_proj")(ctx)
@@ -320,10 +339,13 @@ class Attention(nn.Module):
             # training-path full-sequence attention (flash kernel capable,
             # O(seq) memory), not seq sequential cache walks. Correct only
             # when the cache was empty (i == 0, untracked here — traced);
-            # the prefill twin's contract. GQA repeats K/V for the
-            # full-sequence core like the non-decode path does.
-            rep = q.shape[2] // k.shape[2]
+            # the prefill twin's contract. Same GQA dispatch as the
+            # non-decode path: native schedules get grouped K/V, the rest
+            # get repeated.
             attn = self.attention_fn or dense_attention
+            if attention_fn_accepts_gqa(attn):
+                return attn(q, k, v, causal=True, **self._window_kw())
+            rep = q.shape[2] // k.shape[2]
             return attn(
                 q, repeat_kv(k, rep), repeat_kv(v, rep), causal=True,
                 **self._window_kw(),

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
+from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention, repeat_kv
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
 
 
@@ -132,6 +132,12 @@ def ring_attention(
     neighbor blocks instead of the full circle, so the long-context memory
     scaling of SP composes with the O(S·W) compute of windowed attention.
 
+    GQA-native: ``k``/``v`` may carry FEWER heads than ``q`` (``Hkv``
+    dividing ``H``) — the GROUPED buffers rotate the ring (ICI volume drops
+    by ``H/Hkv``, the ring's scarce resource) and each rotation repeats
+    them in local memory just before its block update (a fused broadcast,
+    not a transfer).
+
     Returns the attention output for this device's Q shard, same shape and
     dtype as ``q``.
     """
@@ -142,6 +148,7 @@ def ring_attention(
     s_local = q.shape[-3]
     q_offset = my_idx * s_local
     n_upd = windowed_rotations(window, s_local, n)
+    rep = q.shape[-2] // k.shape[-2]  # GQA: repeat per rotation, post-hop
 
     batch, _, heads, head_dim = q.shape
     acc0 = (
@@ -162,7 +169,7 @@ def ring_attention(
         v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
         kv_offset = ((my_idx - t) % n) * s_local
         acc = _block_update(
-            q, k_blk, v_blk, acc,
+            q, repeat_kv(k_blk, rep), repeat_kv(v_blk, rep), acc,
             causal=causal, q_offset=q_offset, kv_offset=kv_offset,
             window=window,
         )
@@ -175,7 +182,7 @@ def ring_attention(
     if n_upd > 1:
         k, v, acc0 = lax.fori_loop(0, n_upd - 1, ring_step, (k, v, acc0))
     o, l, _ = _block_update(
-        q, k, v, acc0,
+        q, repeat_kv(k, rep), repeat_kv(v, rep), acc0,
         causal=causal, q_offset=q_offset,
         kv_offset=((my_idx - (n_upd - 1)) % n) * s_local,
         window=window,
@@ -240,6 +247,19 @@ def make_ring_attention_fn(
 
     from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
 
-    return with_divisibility_fallback(
-        mesh, batch_axes, seq_axis, _sharded, dense_attention
+    def dense_fallback(q, k, v, *, causal=True, **kw):
+        # The batch-1 init fallback receives GROUPED K/V too (gqa_native
+        # below); the dense core wants matching head counts.
+        r = q.shape[2] // k.shape[2]
+        return dense_attention(
+            q, repeat_kv(k, r), repeat_kv(v, r), causal=causal, **kw
+        )
+
+    fn = with_divisibility_fallback(
+        mesh, batch_axes, seq_axis, _sharded, dense_fallback
     )
+    #: models.transformer.Attention reads this to pass GROUPED K/V (GQA):
+    #: the ring then rotates Hkv-head blocks — ICI volume, the ring's
+    #: scarce resource, drops by H/Hkv — and repeats locally per rotation.
+    fn.gqa_native = True
+    return fn

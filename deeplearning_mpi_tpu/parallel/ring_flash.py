@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning_mpi_tpu.ops.attention import NEG_INF
+from deeplearning_mpi_tpu.ops.attention import NEG_INF, repeat_kv
 from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
     fit_block,
     flash_bwd_block,
@@ -98,9 +98,16 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret,
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     batch, s_local, heads, head_dim = q.shape
-    block = functools.partial(
+    # GQA-native: grouped K/V rotate (ICI volume / rep); repeat per
+    # rotation, locally, just before the kernel.
+    rep = heads // k.shape[2]
+    _block = functools.partial(
         _block_fwd, block_q=block_q, block_k=block_k, interpret=interpret
     )
+
+    def block(q, k_blk, v_blk, **kw):
+        return _block(q, repeat_kv(k_blk, rep), repeat_kv(v_blk, rep), **kw)
+
     o0 = jnp.zeros((batch, s_local, heads, head_dim), jnp.float32)
     lse0 = jnp.full((batch, s_local, heads), NEG_INF, jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -204,11 +211,29 @@ def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, window,
     lse128 = jnp.broadcast_to(lse_bhs[..., None], (*lse_bhs.shape, 128))
     # grad_dtype=f32: each per-rotation partial leaves the kernel already in
     # f32 — rounding it to bf16 first would defeat the f32 accumulators.
-    bwd = functools.partial(
+    _bwd = functools.partial(
         flash_bwd_block,
         block_q=block_q, block_k=block_k, interpret=interpret,
         grad_dtype=jnp.float32,
     )
+    # GQA-native mirror of the forward: kernels run at full head count on
+    # locally-repeated blocks; dK/dV group-sum back to the GROUPED shape
+    # before joining the traveling accumulators (jnp.repeat adjacency:
+    # full head h_kv*rep + r), so the backward's ring traffic shrinks by
+    # rep exactly like the forward's.
+    rep = q.shape[2] // k.shape[2]
+
+    def bwd(q_, k_blk, v_blk, o_, do_, lse_, **kw):
+        dq_b, dk_b, dv_b = _bwd(
+            q_, repeat_kv(k_blk, rep), repeat_kv(v_blk, rep), o_, do_,
+            lse_, **kw,
+        )
+        if rep > 1:
+            b_, s_, hf, d_ = dk_b.shape
+            dk_b = dk_b.reshape(b_, s_, hf // rep, rep, d_).sum(3)
+            dv_b = dv_b.reshape(b_, s_, hf // rep, rep, d_).sum(3)
+        return dq_b, dk_b, dv_b
+
     zeros = lambda ref: jnp.zeros(ref.shape, jnp.float32)  # noqa: E731
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -341,11 +366,14 @@ def ring_flash_attention(
     if lax.axis_size(axis_name) == 1:
         # Degenerate ring: the plain flash entry skips the primal lse write
         # (the ring needs lse for its cross-rotation merge; one shard has
-        # nothing to merge).
+        # nothing to merge). It wants matching head counts — repeat any
+        # GQA-grouped K/V here (the one path with no rotation to repeat
+        # after; review r5 caught it receiving grouped buffers).
         from deeplearning_mpi_tpu.ops.pallas.flash_attention import flash_attention
 
+        r = q.shape[2] // k.shape[2]
         return flash_attention(
-            q, k, v, causal=causal, block_q=bq, block_k=bk,
-            interpret=interpret, window=window,
+            q, repeat_kv(k, r), repeat_kv(v, r), causal=causal,
+            block_q=bq, block_k=bk, interpret=interpret, window=window,
         )
     return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret, window)
