@@ -245,18 +245,13 @@ def make_ring_attention_fn(
 
         return fn
 
-    from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
-
-    def dense_fallback(q, k, v, *, causal=True, **kw):
-        # The batch-1 init fallback receives GROUPED K/V too (gqa_native
-        # below); the dense core wants matching head counts.
-        r = q.shape[2] // k.shape[2]
-        return dense_attention(
-            q, repeat_kv(k, r), repeat_kv(v, r), causal=causal, **kw
-        )
+    from deeplearning_mpi_tpu.parallel.seq_common import (
+        repeat_grouped,
+        with_divisibility_fallback,
+    )
 
     fn = with_divisibility_fallback(
-        mesh, batch_axes, seq_axis, _sharded, dense_fallback
+        mesh, batch_axes, seq_axis, _sharded, repeat_grouped(dense_attention)
     )
     #: models.transformer.Attention reads this to pass GROUPED K/V (GQA):
     #: the ring then rotates Hkv-head blocks — ICI volume, the ring's

@@ -6,7 +6,25 @@ from typing import Any, Callable
 
 from jax.sharding import Mesh
 
+from deeplearning_mpi_tpu.ops.attention import repeat_kv
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_SEQ
+
+
+def repeat_grouped(core: Callable) -> Callable:
+    """Wrap a matching-head-count attention core to accept GROUPED K/V.
+
+    The gqa_native factories' fallback paths (batch-1 init, divisibility
+    fallback) receive grouped buffers like the sharded path does but hand
+    them to single-device cores that want ``H == Hkv`` — ONE shim instead
+    of a copy per factory (the sharded paths repeat after their collective
+    hop; this repeats before the core).
+    """
+
+    def fn(q, k, v, *, causal: bool = True, **kw):
+        r = q.shape[2] // k.shape[2]
+        return core(q, repeat_kv(k, r), repeat_kv(v, r), causal=causal, **kw)
+
+    return fn
 
 
 def with_divisibility_fallback(

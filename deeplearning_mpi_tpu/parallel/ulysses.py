@@ -34,7 +34,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning_mpi_tpu.ops.attention import dense_attention
+from deeplearning_mpi_tpu.ops.attention import dense_attention, repeat_kv
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
 
 # (q, k, v [B,S,H,D], causal=...) -> [B,S,H,D], run on full sequences.
@@ -61,9 +61,23 @@ def ulysses_attention(
     through. (The ring schedule composes differently — rotation skipping,
     ``parallel.ring_attention.windowed_rotations`` — and keeps O(S/N)
     sequence memory where Ulysses holds the full sequence per device.)
+
+    GQA-native: ``k``/``v`` may carry FEWER heads (``Hkv`` dividing ``H``).
+    When ``Hkv % n == 0`` the GROUPED buffers ride the all-to-alls (K/V
+    collective bytes drop by ``H/Hkv``) and repeat locally afterwards —
+    the head-chunk correspondence is exact: q chunk ``i`` covers q heads
+    ``[i·H/n, (i+1)·H/n)``, whose kv heads are precisely kv chunk ``i``,
+    and within the chunk ``repeat_kv``'s adjacency matches the local q
+    ordering. Otherwise K/V are repeated before the collective (the old
+    behavior — correctness never depends on the divisibility).
     """
     n = lax.axis_size(axis_name)
     heads = q.shape[-2]
+    if heads % k.shape[-2] != 0:
+        raise ValueError(
+            f"GQA K/V heads ({k.shape[-2]}) must divide q heads ({heads})"
+        )
+    rep = heads // k.shape[-2]
     if heads % n != 0:
         raise ValueError(
             f"ulysses attention needs heads ({heads}) divisible by the "
@@ -71,12 +85,18 @@ def ulysses_attention(
         )
     kw = {"window": window} if window is not None else {}
     if n == 1:
-        return inner(q, k, v, causal=causal, **kw)
+        return inner(q, repeat_kv(k, rep), repeat_kv(v, rep), causal=causal, **kw)
     # seq-sharded -> head-sharded: split heads (axis 2), gather sequence (1).
     to_heads = functools.partial(
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
     )
-    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)  # [B, S, H/n, D]
+    qh = to_heads(q)  # [B, S, H/n, D]
+    if rep > 1 and k.shape[-2] % n == 0:
+        kh, vh = to_heads(k), to_heads(v)  # grouped: bytes / rep
+        kh, vh = repeat_kv(kh, rep), repeat_kv(vh, rep)
+    else:
+        kh = to_heads(repeat_kv(k, rep))
+        vh = to_heads(repeat_kv(v, rep))
     ctx = inner(qh, kh, vh, causal=causal, **kw)
     # head-sharded -> seq-sharded: split sequence (1), gather heads (2).
     return lax.all_to_all(
@@ -113,6 +133,16 @@ def make_ulysses_attention_fn(
 
         return fn
 
-    from deeplearning_mpi_tpu.parallel.seq_common import with_divisibility_fallback
+    from deeplearning_mpi_tpu.parallel.seq_common import (
+        repeat_grouped,
+        with_divisibility_fallback,
+    )
 
-    return with_divisibility_fallback(mesh, batch_axes, seq_axis, _sharded, inner)
+    fn = with_divisibility_fallback(
+        mesh, batch_axes, seq_axis, _sharded, repeat_grouped(inner)
+    )
+    #: models.transformer.Attention reads this to pass GROUPED K/V (GQA):
+    #: the K/V all-to-alls then move Hkv-head chunks — collective bytes
+    #: drop by H/Hkv — and repeat locally after (see ulysses_attention).
+    fn.gqa_native = True
+    return fn
