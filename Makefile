@@ -1,0 +1,29 @@
+# Developer entry points. `make verify` is the tier-1 gate (the exact
+# ROADMAP.md command, byte-for-byte); `make check` adds the telemetry
+# report selftest.
+
+SHELL := /bin/bash
+
+.PHONY: verify selftest check smoke
+
+# Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify").
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
+selftest:
+	env JAX_PLATFORMS=cpu python tools/metrics_report.py --selftest
+
+check: verify selftest
+
+# 30-second observability demo: tiny CPU-mesh LM run with telemetry on,
+# rendered by the report tool (docs/OBSERVABILITY.md walks through it).
+smoke:
+	rm -rf /tmp/dmt_smoke
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.train_lm \
+		--n_virtual_devices 8 --num_epochs 1 --batch_size 16 \
+		--train_sequences 64 --seq_len 64 --num_layers 2 --d_model 64 \
+		--d_ff 128 --num_heads 4 --head_dim 16 --eval_every 1 \
+		--metrics_dir /tmp/dmt_smoke/metrics --log_dir /tmp/dmt_smoke/logs \
+		--model_dir /tmp/dmt_smoke/models
+	python tools/metrics_report.py /tmp/dmt_smoke/metrics/metrics.jsonl

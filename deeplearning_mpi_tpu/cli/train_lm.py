@@ -225,7 +225,53 @@ def main(argv: list[str] | None = None) -> int:
             zero=args.zero, ema_decay=args.ema,
         )
         trainer.place_state()
-        config.build_observability(args, trainer)
+        # Analytic per-step cost estimates feed the telemetry registry's
+        # MFU and collective-byte epoch stats (telemetry/flops.py,
+        # telemetry/comms.py): gradient sync over data, plus whichever
+        # sequence/pipeline/expert collectives this run's flags engaged.
+        from deeplearning_mpi_tpu.telemetry import comms
+        from deeplearning_mpi_tpu.telemetry.flops import transformer_train_flops
+
+        dp = mesh.shape.get("data", 1)
+        sp = mesh.shape.get("seq", 1)
+        pp = mesh.shape.get("pipe", 1)
+        ep = mesh.shape.get("expert", 1)
+        batch_local = max(args.batch_size // max(dp, 1), 1)
+        comm_bytes = comms.dp_grad_allreduce_bytes(
+            comms.param_count(trainer.state.params), dp, zero=args.zero
+        )
+        act_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+        if args.attention == "ulysses":
+            comm_bytes += comms.ulysses_attention_bytes(
+                batch_local, max(args.seq_len // sp, 1), args.num_heads,
+                args.head_dim, sp, kv_heads=args.num_kv_heads or None,
+                num_layers=args.num_layers, dtype=act_dtype,
+            )
+        elif args.attention == "ring":
+            comm_bytes += comms.ring_attention_bytes(
+                batch_local, max(args.seq_len // sp, 1), args.num_heads,
+                args.head_dim, sp, kv_heads=args.num_kv_heads or None,
+                num_layers=args.num_layers, dtype=act_dtype,
+            )
+        if pp > 1:
+            comm_bytes += comms.pipeline_bytes(
+                (max(batch_local // args.microbatches, 1), args.seq_len,
+                 args.d_model),
+                args.microbatches, pp, dtype=act_dtype,
+            )
+        if args.moe_experts and ep > 1:
+            comm_bytes += comms.moe_dispatch_bytes(
+                batch_local * args.seq_len, args.d_model, ep,
+                top_k=args.moe_top_k, num_layers=args.num_layers,
+                dtype=act_dtype,
+            )
+        config.build_observability(
+            args, trainer,
+            flops_per_step=transformer_train_flops(
+                cfg, args.batch_size, args.seq_len
+            ),
+            comm_bytes_per_step=comm_bytes,
+        )
         config.execute_training(
             trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
             state_factory=state_factory,

@@ -150,7 +150,16 @@ def main(argv: list[str] | None = None) -> int:
             ema_decay=args.ema,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
-        config.build_observability(args, trainer)
+        # Analytic train FLOPs → MFU (vit_* has no table entry yet; the DP
+        # gradient-sync bytes are derived inside build_observability).
+        flops_per_step = None
+        if args.arch.startswith("resnet"):
+            from deeplearning_mpi_tpu.telemetry.flops import resnet_train_flops
+
+            flops_per_step = resnet_train_flops(
+                args.arch, args.batch_size, 32, stem=args.stem
+            )
+        config.build_observability(args, trainer, flops_per_step=flops_per_step)
         config.execute_training(
             trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
             state_factory=state_factory,

@@ -177,7 +177,22 @@ def main(argv: list[str] | None = None) -> int:
             ema_decay=args.ema,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
-        config.build_observability(args, trainer)
+        # Analytic train FLOPs → MFU. Non-square folder images collapse to
+        # the voxel-preserving equivalent square/cube edge (conv FLOPs scale
+        # with voxel count, so the estimate is exact up to boundary effects).
+        from deeplearning_mpi_tpu.telemetry.flops import unet_train_flops
+
+        dim = 3 if args.volumetric else 2
+        voxels = 1.0
+        for s in sample_hw:
+            voxels *= float(s)
+        config.build_observability(
+            args, trainer,
+            flops_per_step=unet_train_flops(
+                args.batch_size, voxels ** (1.0 / dim),
+                in_channels=channels, out_channels=1, dim=dim,
+            ),
+        )
         config.execute_training(
             trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
             state_factory=state_factory,

@@ -35,6 +35,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from deeplearning_mpi_tpu.telemetry.trace import annotate
+
 #: Flax collection + name under which each MoE layer sows its scalar
 #: load-balance loss. Collect with ``collect_aux_loss``.
 AUX_COLLECTION = "moe_losses"
@@ -241,13 +243,16 @@ class MoEMLP(nn.Module):
 
         xe = x.astype(self.dtype)
         # dispatch: groups g = batch rows. [B,S,E,C] x [B,S,d] -> [E,B,C,d]
-        expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xe)
-        hidden = nn.silu(
-            jnp.einsum("egcd,edf->egcf", expert_in, w_gate)
-        ) * jnp.einsum("egcd,edf->egcf", expert_in, w_up)
-        expert_out = jnp.einsum("egcf,efd->egcd", hidden, w_down)
+        with annotate("moe/dispatch"):
+            expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xe)
+        with annotate("moe/experts"):
+            hidden = nn.silu(
+                jnp.einsum("egcd,edf->egcf", expert_in, w_gate)
+            ) * jnp.einsum("egcd,edf->egcf", expert_in, w_up)
+            expert_out = jnp.einsum("egcf,efd->egcd", hidden, w_down)
         # combine carries the gate weights; dropped tokens get exact zeros
         # (residual passthrough in the enclosing block).
-        return jnp.einsum(
-            "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
-        )
+        with annotate("moe/combine"):
+            return jnp.einsum(
+                "gsec,egcd->gsd", combine.astype(self.dtype), expert_out
+            )

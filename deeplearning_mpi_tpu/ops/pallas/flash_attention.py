@@ -40,6 +40,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning_mpi_tpu.runtime.compat import tpu_compiler_params
+from deeplearning_mpi_tpu.telemetry.trace import annotate
+
 from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention
 
 
@@ -279,7 +282,7 @@ def _fwd_pallas(
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-replicated)
             pltpu.VMEM((bq, 128), jnp.float32),  # running denom
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -535,7 +538,7 @@ def _bwd_pallas(
             (1, 1, bq, head_dim), row_specs["q@i"], memory_space=pltpu.VMEM
         ),
         scratch_shapes=[pltpu.VMEM((bq, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -567,7 +570,7 @@ def _bwd_pallas(
             pltpu.VMEM((bk, head_dim), jnp.float32),
             pltpu.VMEM((bk, head_dim), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -705,7 +708,8 @@ def flash_attention(
         return dense_attention(q, k, v, causal=causal, window=window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, bq, bk, interpret, False, window)
+    with annotate("pallas/flash_attention"):
+        return _flash(q, k, v, causal, bq, bk, interpret, False, window)
 
 
 def flash_attention_bhsd(
@@ -747,7 +751,8 @@ def flash_attention_bhsd(
         return _swap_sh(bshd)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, bq, bk, interpret, True, window)
+    with annotate("pallas/flash_attention_bhsd"):
+        return _flash(q, k, v, causal, bq, bk, interpret, True, window)
 
 
 #: models.transformer.Attention reads this to project q/k/v directly into

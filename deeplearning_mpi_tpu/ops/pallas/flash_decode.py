@@ -35,6 +35,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning_mpi_tpu.runtime.compat import tpu_compiler_params
+from deeplearning_mpi_tpu.telemetry.trace import annotate
+
 from deeplearning_mpi_tpu.ops.attention import NEG_INF
 
 
@@ -234,19 +237,20 @@ def flash_decode(
             pltpu.VMEM((-(-heads // 8) * 8, 128), jnp.float32),  # denom
         ],
     )
-    return pl.pallas_call(
-        functools.partial(
-            _decode_kernel,
-            block=block, kv_heads=kv_heads, group=group,
-            scale=head_dim**-0.5, window=window, quantized=quantized,
-        ),
-        out_shape=jax.ShapeDtypeStruct((batch, 1, heads, head_dim), q.dtype),
-        grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(jnp.asarray(index, jnp.int32).reshape(1), *operands)
+    with annotate("pallas/flash_decode"):
+        return pl.pallas_call(
+            functools.partial(
+                _decode_kernel,
+                block=block, kv_heads=kv_heads, group=group,
+                scale=head_dim**-0.5, window=window, quantized=quantized,
+            ),
+            out_shape=jax.ShapeDtypeStruct((batch, 1, heads, head_dim), q.dtype),
+            grid_spec=grid_spec,
+            compiler_params=tpu_compiler_params(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(jnp.asarray(index, jnp.int32).reshape(1), *operands)
 
 
 #: Smallest block the kernel accepts: below this the grid degenerates into

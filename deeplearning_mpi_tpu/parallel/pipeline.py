@@ -36,7 +36,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning_mpi_tpu.runtime.compat import pcast, shard_map
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_PIPE
+from deeplearning_mpi_tpu.telemetry.trace import annotate
 
 PyTree = Any
 #: stage_fn(stage_params, activations) -> activations (same pytree structure
@@ -117,7 +119,7 @@ def pipeline_apply(
     x_specs = jax.tree.map(lambda _: P(), microbatches)
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(param_specs, x_specs),
         out_specs=jax.tree.map(lambda _: P(), microbatches),
@@ -134,9 +136,7 @@ def pipeline_apply(
         # The scan carry becomes pipe-varying inside the loop (each stage holds
         # a different microbatch), so the zero-initialized carry must be typed
         # varying too or the carry types won't match under vma checking.
-        varying = lambda t: jax.tree.map(  # noqa: E731
-            lambda a: lax.pcast(a, (axis,), to="varying"), t
-        )
+        varying = lambda t: pcast(t, (axis,), to="varying")  # noqa: E731
         state0 = varying(jax.tree.map(lambda x: jnp.zeros_like(x[0]), xs))
         outs0 = varying(jax.tree.map(jnp.zeros_like, xs))
         perm = [(i, i + 1) for i in range(num_stages - 1)]
@@ -150,10 +150,12 @@ def pipeline_apply(
             x_in = jax.tree.map(
                 lambda f, st: jnp.where(stage == 0, f, st), feed, state
             )
-            y = stage_fn(params, x_in)
+            with annotate("pipeline/stage_fn"):
+                y = stage_fn(params, x_in)
             # Shift down the ring; stage 0 receives zeros (no sender), the
             # last stage's send is dropped.
-            y_next = jax.tree.map(lambda a: lax.ppermute(a, axis, perm), y)
+            with annotate("pipeline/shift_activations"):
+                y_next = jax.tree.map(lambda a: lax.ppermute(a, axis, perm), y)
             # The last stage's step-t output is microbatch t-(S-1)'s result.
             out_idx = t - (num_stages - 1)
             clamped = jnp.maximum(out_idx, 0)
@@ -180,4 +182,9 @@ def pipeline_apply(
             outs,
         )
 
+    # NOTE: on jax 0.4.x, partial-manual shard_map (non-empty auto axes)
+    # only works when traced into an enclosing jit — its eager impl raises
+    # NotImplementedError, and a bare jit wrapper here trips the SPMD
+    # partitioner ("PartitionId instruction is not supported"). Call this
+    # inside a jitted step (as the Trainer does) on such versions.
     return run(stage_params, microbatches)

@@ -39,7 +39,9 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning_mpi_tpu.ops.attention import NEG_INF, dense_attention, repeat_kv
+from deeplearning_mpi_tpu.runtime.compat import axis_size as compat_axis_size, shard_map
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
+from deeplearning_mpi_tpu.telemetry.trace import annotate
 
 
 def _block_update(
@@ -143,7 +145,7 @@ def ring_attention(
     """
     if window is not None and not causal:
         raise ValueError("window attention is causal by definition")
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     s_local = q.shape[-3]
     q_offset = my_idx * s_local
@@ -165,14 +167,16 @@ def ring_attention(
         # Issue the transfer of the *next* block first; it depends only on the
         # incoming K/V, so XLA's latency-hiding scheduler overlaps the
         # collective-permute DMA with this step's einsums (double buffering).
-        k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
-        v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
+        with annotate("ring_attention/rotate_kv"):
+            k_nxt = lax.ppermute(k_blk, axis_name, perm=perm)
+            v_nxt = lax.ppermute(v_blk, axis_name, perm=perm)
         kv_offset = ((my_idx - t) % n) * s_local
-        acc = _block_update(
-            q, repeat_kv(k_blk, rep), repeat_kv(v_blk, rep), acc,
-            causal=causal, q_offset=q_offset, kv_offset=kv_offset,
-            window=window,
-        )
+        with annotate("ring_attention/block_update"):
+            acc = _block_update(
+                q, repeat_kv(k_blk, rep), repeat_kv(v_blk, rep), acc,
+                causal=causal, q_offset=q_offset, kv_offset=kv_offset,
+                window=window,
+            )
         return k_nxt, v_nxt, acc
 
     # n_upd - 1 rotations, then the last block's update outside the loop —
@@ -219,7 +223,7 @@ def make_ring_attention_fn(
     @functools.lru_cache(maxsize=4)
     def _sharded(causal: bool, window: int | None = None):
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
@@ -228,20 +232,22 @@ def make_ring_attention_fn(
             # normalized here (inside shard_map q is the local shard, so
             # the global length is shard * ring size).
             w = window
-            if w is not None and w >= q.shape[1] * lax.axis_size(seq_axis):
+            if w is not None and w >= q.shape[1] * compat_axis_size(seq_axis):
                 w = None
             if flash:
                 from deeplearning_mpi_tpu.parallel.ring_flash import (
                     ring_flash_attention,
                 )
 
-                return ring_flash_attention(
-                    q, k, v, causal=causal, axis_name=seq_axis,
-                    block_q=block_q, block_k=block_k, window=w,
+                with annotate("ring_attention/flash"):
+                    return ring_flash_attention(
+                        q, k, v, causal=causal, axis_name=seq_axis,
+                        block_q=block_q, block_k=block_k, window=w,
+                    )
+            with annotate("ring_attention"):
+                return ring_attention(
+                    q, k, v, causal=causal, axis_name=seq_axis, window=w
                 )
-            return ring_attention(
-                q, k, v, causal=causal, axis_name=seq_axis, window=w
-            )
 
         return fn
 

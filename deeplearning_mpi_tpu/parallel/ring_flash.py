@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning_mpi_tpu.runtime.compat import axis_size as compat_axis_size
+
 from deeplearning_mpi_tpu.ops.attention import NEG_INF, repeat_kv
 from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
     fit_block,
@@ -95,7 +97,7 @@ def _ring_fwd_pass(q, k, v, causal, axis_name, block_q, block_k, interpret,
     ``lax.cond`` (same per-device control flow the unwindowed ring's
     lax.switch uses).
     """
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     batch, s_local, heads, head_dim = q.shape
     # GQA-native: grouped K/V rotate (ICI volume / rep); repeat per
@@ -203,7 +205,7 @@ def _ring_flash_fwd(q, k, v, causal, axis_name, block_q, block_k, interpret,
 def _ring_flash_bwd(causal, axis_name, block_q, block_k, interpret, window,
                     res, do):
     q, k, v, o, lse = res
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     # The kernels take the lane-replicated layout; one broadcast outside the
     # ring loop (lse is rotation-invariant — it is already global).
@@ -363,7 +365,7 @@ def ring_flash_attention(
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if lax.axis_size(axis_name) == 1:
+    if compat_axis_size(axis_name) == 1:
         # Degenerate ring: the plain flash entry skips the primal lse write
         # (the ring needs lse for its cross-rotation merge; one shard has
         # nothing to merge). It wants matching head counts — repeat any
@@ -376,4 +378,7 @@ def ring_flash_attention(
             q, repeat_kv(k, r), repeat_kv(v, r), causal=causal,
             block_q=bq, block_k=bk, interpret=interpret, window=window,
         )
-    return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret, window)
+    from deeplearning_mpi_tpu.telemetry.trace import annotate
+
+    with annotate("ring_flash_attention"):
+        return _ring_flash(q, k, v, causal, axis_name, bq, bk, interpret, window)

@@ -190,5 +190,8 @@ def shard_state(
     — exactly pure DP. ``zero=True`` additionally shards optimizer-state
     leaves over ``data`` (ZeRO-1; see ``parallel.zero``).
     """
+    from deeplearning_mpi_tpu.telemetry.trace import annotate
+
     shardings = infer_state_sharding(state, mesh, tp_axis=tp_axis, zero=zero)
-    return jax.tree.map(jax.device_put, state, shardings)
+    with annotate("zero/shard_state" if zero else "tp/shard_state"):
+        return jax.tree.map(jax.device_put, state, shardings)

@@ -35,7 +35,9 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning_mpi_tpu.ops.attention import dense_attention, repeat_kv
+from deeplearning_mpi_tpu.runtime.compat import axis_size as compat_axis_size, shard_map
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQ
+from deeplearning_mpi_tpu.telemetry.trace import annotate
 
 # (q, k, v [B,S,H,D], causal=...) -> [B,S,H,D], run on full sequences.
 InnerAttentionFn = Callable[..., jax.Array]
@@ -71,7 +73,7 @@ def ulysses_attention(
     ordering. Otherwise K/V are repeated before the collective (the old
     behavior — correctness never depends on the divisibility).
     """
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     heads = q.shape[-2]
     if heads % k.shape[-2] != 0:
         raise ValueError(
@@ -90,18 +92,21 @@ def ulysses_attention(
     to_heads = functools.partial(
         lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
     )
-    qh = to_heads(q)  # [B, S, H/n, D]
-    if rep > 1 and k.shape[-2] % n == 0:
-        kh, vh = to_heads(k), to_heads(v)  # grouped: bytes / rep
-        kh, vh = repeat_kv(kh, rep), repeat_kv(vh, rep)
-    else:
-        kh = to_heads(repeat_kv(k, rep))
-        vh = to_heads(repeat_kv(v, rep))
-    ctx = inner(qh, kh, vh, causal=causal, **kw)
+    with annotate("ulysses/all_to_all_qkv"):
+        qh = to_heads(q)  # [B, S, H/n, D]
+        if rep > 1 and k.shape[-2] % n == 0:
+            kh, vh = to_heads(k), to_heads(v)  # grouped: bytes / rep
+            kh, vh = repeat_kv(kh, rep), repeat_kv(vh, rep)
+        else:
+            kh = to_heads(repeat_kv(k, rep))
+            vh = to_heads(repeat_kv(v, rep))
+    with annotate("ulysses/inner_attention"):
+        ctx = inner(qh, kh, vh, causal=causal, **kw)
     # head-sharded -> seq-sharded: split sequence (1), gather heads (2).
-    return lax.all_to_all(
-        ctx, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
-    )
+    with annotate("ulysses/all_to_all_out"):
+        return lax.all_to_all(
+            ctx, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
 
 
 def make_ulysses_attention_fn(
@@ -121,7 +126,7 @@ def make_ulysses_attention_fn(
     @functools.lru_cache(maxsize=4)
     def _sharded(causal: bool, window: int | None = None):
         @functools.partial(
-            jax.shard_map, mesh=mesh,
+            shard_map, mesh=mesh,
             in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )

@@ -33,13 +33,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning_mpi_tpu.runtime.compat import axis_size as compat_axis_size
+
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA
 
 PyTree = Any
 
 
 def axis_size(axis_name: str = AXIS_DATA) -> int:
-    return lax.axis_size(axis_name)
+    return compat_axis_size(axis_name)
 
 
 def axis_index(axis_name: str = AXIS_DATA) -> jax.Array:
@@ -88,7 +90,7 @@ def ring_shift(x: jax.Array, axis_name: str = AXIS_DATA, *, offset: int = 1) -> 
     ``lax.ppermute``, which XLA lowers to collective-permute riding ICI
     neighbor links — also the inner step of ring attention.
     """
-    n = lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm=perm)
 

@@ -1,0 +1,96 @@
+"""Version shims for the JAX APIs this codebase uses across releases.
+
+The codebase targets the current JAX surface (``jax.shard_map`` with
+``check_vma``/``axis_names``, ``lax.pcast``, ``pltpu.CompilerParams``); on
+jax 0.4.x those names live elsewhere or don't exist yet
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``/``auto``,
+no ``pcast``, ``pltpu.TPUCompilerParams``). One shim module resolves each
+name once at import and every call site routes through it, so the rest of
+the tree never version-checks:
+
+- :func:`shard_map` — the new keyword surface everywhere. ``check_vma``
+  maps to 0.4.x's ``check_rep``; ``axis_names`` (manual-over-these-axes)
+  maps to its complement ``auto`` (automatic-over-those-axes).
+- :func:`pcast` — varying-type casts exist only under the VMA checker;
+  where ``lax.pcast`` is absent the rep checker needs no cast and the
+  shim is an identity.
+- :func:`tpu_compiler_params` — the Pallas TPU compiler-params dataclass
+  under whichever of its two names this JAX exports.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+__all__ = ["axis_size", "shard_map", "pcast", "tpu_compiler_params"]
+
+_NEW_SHARD_MAP = getattr(jax, "shard_map", None)
+if _NEW_SHARD_MAP is None:
+    from jax.experimental.shard_map import shard_map as _OLD_SHARD_MAP
+else:
+    _OLD_SHARD_MAP = None
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool | None = None,
+    axis_names: Any = None,
+):
+    """``jax.shard_map`` with the current keyword surface on every JAX.
+
+    ``axis_names`` (when given) is the set of mesh axes the function is
+    MANUAL over — the new-API meaning; on 0.4.x it becomes the complement
+    ``auto`` set. ``check_vma=None`` takes the library default.
+    """
+    if _NEW_SHARD_MAP is not None:
+        kw: dict[str, Any] = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return _NEW_SHARD_MAP(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    return _OLD_SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis, inside shard_map/pmap bodies.
+
+    ``lax.axis_size`` where it exists; otherwise ``lax.psum(1, axis)``,
+    which constant-folds to a Python int for non-tracer operands on 0.4.x.
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast(x, axis_names, *, to: str = "varying"):
+    """``lax.pcast`` where it exists; identity where the VMA type system
+    (and therefore the cast) doesn't."""
+    if hasattr(lax, "pcast"):
+        return jax.tree.map(lambda a: lax.pcast(a, tuple(axis_names), to=to), x)
+    return x
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` / ``pltpu.TPUCompilerParams`` — renamed
+    between releases; same fields (``dimension_semantics`` et al.)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

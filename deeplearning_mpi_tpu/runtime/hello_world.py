@@ -32,10 +32,10 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning_mpi_tpu.runtime import collectives
+from deeplearning_mpi_tpu.runtime.compat import shard_map
 from deeplearning_mpi_tpu.runtime.mesh import AXIS_DATA, create_mesh
 
 

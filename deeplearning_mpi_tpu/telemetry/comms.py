@@ -1,0 +1,182 @@
+"""Static collective-byte accounting — bytes per step from shapes alone.
+
+Every collective this framework issues has a closed-form per-device byte
+cost on a ring/bidirectional-ICI topology (the standard algorithmic-
+bandwidth accounting, e.g. the NCCL/ICI literature):
+
+- all-reduce:      2·(n−1)/n · B   (reduce-scatter + all-gather halves)
+- reduce-scatter:    (n−1)/n · B
+- all-gather:        (n−1)/n · B
+- all-to-all:        (n−1)/n · B   (each device keeps 1/n locally)
+- ppermute:                    B   (every element moves one hop)
+
+``B`` is the device-local buffer size in bytes. These are *per device*;
+multiply by the axis size for fleet totals. Static accounting at wrap time
+is deliberately chosen over runtime measurement: it costs nothing per step,
+it is exact for the SPMD programs this repo builds (the collectives are in
+the compiled program, not data-dependent), and disagreement between this
+number and a measured profile is itself diagnostic (XLA fused or elided
+something).
+
+The per-model helpers mirror where the collectives actually are:
+``dp_grad_allreduce_bytes`` (every model, backward), plus the LM extras —
+Ulysses all-to-alls, ring-attention K/V rotations, pipeline activation
+shifts, MoE dispatch/combine — with backward costed as a mirror of forward
+(each forward collective's transpose is a collective of the same volume).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _nbytes(shape: tuple[int, ...], dtype: Any = jnp.float32) -> float:
+    size = 1
+    for s in shape:
+        size *= s
+    return float(size * jnp.dtype(dtype).itemsize)
+
+
+def allreduce_bytes(buffer_bytes: float, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * buffer_bytes
+
+
+def reduce_scatter_bytes(buffer_bytes: float, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * buffer_bytes
+
+
+def all_gather_bytes(buffer_bytes: float, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * buffer_bytes
+
+
+def all_to_all_bytes(buffer_bytes: float, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * buffer_bytes
+
+
+def ppermute_bytes(buffer_bytes: float, n: int) -> float:
+    return buffer_bytes if n > 1 else 0.0
+
+
+def param_count(params: Any) -> int:
+    """Leaf-size sum of a pytree (device arrays never fetched)."""
+    return sum(int(jnp.size(leaf)) for leaf in jax.tree.leaves(params))
+
+
+def dp_grad_allreduce_bytes(
+    n_params: int, dp: int, *, dtype: Any = jnp.float32, zero: bool = False
+) -> float:
+    """Per-device gradient-sync bytes per step over the ``data`` axis.
+
+    Plain DP all-reduces the full gradient. ZeRO-1 replaces it with
+    reduce-scatter (grads) + all-gather (updated params) — same leading
+    term, so the byte cost is identical; the win is optimizer-state memory,
+    not wire volume.
+    """
+    buf = n_params * float(jnp.dtype(dtype).itemsize)
+    if zero:
+        return reduce_scatter_bytes(buf, dp) + all_gather_bytes(buf, dp)
+    return allreduce_bytes(buf, dp)
+
+
+def ulysses_attention_bytes(
+    batch_local: int,
+    seq_local: int,
+    heads: int,
+    head_dim: int,
+    seq_axis: int,
+    *,
+    kv_heads: int | None = None,
+    num_layers: int = 1,
+    dtype: Any = jnp.bfloat16,
+    training: bool = True,
+) -> float:
+    """Per-device bytes for the Ulysses schedule: 4 all-to-alls per layer
+    forward (q, k, v in; context out — k/v at the GROUPED head count when
+    GQA rides the collective), mirrored in backward when training."""
+    if seq_axis <= 1:
+        return 0.0
+    kv = kv_heads or heads
+    q_buf = _nbytes((batch_local, seq_local, heads, head_dim), dtype)
+    kv_buf = _nbytes((batch_local, seq_local, kv, head_dim), dtype)
+    fwd = (
+        all_to_all_bytes(q_buf, seq_axis) * 2      # q in, context out
+        + all_to_all_bytes(kv_buf, seq_axis) * 2   # k, v in
+    )
+    return num_layers * fwd * (2.0 if training else 1.0)
+
+
+def ring_attention_bytes(
+    batch_local: int,
+    seq_local: int,
+    heads: int,
+    head_dim: int,
+    seq_axis: int,
+    *,
+    kv_heads: int | None = None,
+    rotations: int | None = None,
+    num_layers: int = 1,
+    dtype: Any = jnp.bfloat16,
+    training: bool = True,
+) -> float:
+    """Per-device bytes for ring attention: (rotations − 1) K/V ppermute
+    pairs per layer (the final rotation's send is elided — see
+    ``parallel.ring_attention``), GQA-grouped, mirrored in backward."""
+    if seq_axis <= 1:
+        return 0.0
+    kv = kv_heads or heads
+    n_rot = (rotations if rotations is not None else seq_axis) - 1
+    if n_rot <= 0:
+        return 0.0
+    kv_buf = _nbytes((batch_local, seq_local, kv, head_dim), dtype)
+    fwd = num_layers * n_rot * 2 * ppermute_bytes(kv_buf, seq_axis)
+    return fwd * (2.0 if training else 1.0)
+
+
+def pipeline_bytes(
+    microbatch_shape: tuple[int, ...],
+    num_microbatches: int,
+    pipe_axis: int,
+    *,
+    dtype: Any = jnp.bfloat16,
+    training: bool = True,
+) -> float:
+    """Per-device bytes for the GPipe schedule: one activation ppermute per
+    schedule step, ``M + S − 1`` steps, mirrored in backward."""
+    if pipe_axis <= 1:
+        return 0.0
+    buf = _nbytes(microbatch_shape, dtype)
+    steps = num_microbatches + pipe_axis - 1
+    fwd = steps * ppermute_bytes(buf, pipe_axis)
+    return fwd * (2.0 if training else 1.0)
+
+
+def moe_dispatch_bytes(
+    tokens_local: int,
+    d_model: int,
+    expert_axis: int,
+    *,
+    top_k: int = 1,
+    capacity_factor: float = 1.0,
+    num_layers: int = 1,
+    dtype: Any = jnp.bfloat16,
+    training: bool = True,
+) -> float:
+    """Per-device bytes for expert-parallel MoE: dispatch + combine are each
+    an all-to-all of the routed token activations (top_k · capacity_factor
+    slots per token upper bound), per MoE layer, mirrored in backward."""
+    if expert_axis <= 1:
+        return 0.0
+    buf = _nbytes((tokens_local, d_model), dtype) * top_k * capacity_factor
+    fwd = num_layers * 2 * all_to_all_bytes(buf, expert_axis)
+    return fwd * (2.0 if training else 1.0)

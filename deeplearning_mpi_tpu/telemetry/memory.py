@@ -1,0 +1,52 @@
+"""Live HBM accounting via ``device.memory_stats()``.
+
+On TPU (and GPU) backends every device reports ``bytes_in_use`` /
+``bytes_limit`` and peak counters; on CPU the method returns ``None`` (there
+is no device allocator to meter). Everything here is None-safe: the metrics
+records simply omit HBM columns on CPU meshes rather than inventing numbers
+— unlike MFU, where a nominal peak keeps the column defined (see
+``telemetry.flops``), fake memory numbers would mask real OOM headroom.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import jax
+
+
+def device_memory_stats(device: Any) -> dict[str, float] | None:
+    """One device's allocator stats, or None where unsupported (CPU)."""
+    stats = None
+    try:
+        stats = device.memory_stats()
+    except (AttributeError, NotImplementedError, RuntimeError):
+        return None
+    if not stats:
+        return None
+    return {k: float(v) for k, v in stats.items() if isinstance(v, (int, float))}
+
+
+def hbm_usage(devices: Iterable[Any] | None = None) -> dict[str, float] | None:
+    """Fleet-level HBM summary for the metrics record.
+
+    Returns ``{"hbm_bytes_in_use", "hbm_bytes_limit", "hbm_peak_bytes",
+    "hbm_utilization"}`` aggregated over the *max-loaded* device (the one
+    that OOMs first is the one that matters), or None when no device
+    reports stats.
+    """
+    if devices is None:
+        devices = jax.local_devices()
+    per_device = [s for d in devices if (s := device_memory_stats(d))]
+    if not per_device:
+        return None
+    worst = max(per_device, key=lambda s: s.get("bytes_in_use", 0.0))
+    out = {"hbm_bytes_in_use": worst.get("bytes_in_use", 0.0)}
+    limit = worst.get("bytes_limit")
+    if limit:
+        out["hbm_bytes_limit"] = limit
+        out["hbm_utilization"] = out["hbm_bytes_in_use"] / limit
+    peak = worst.get("peak_bytes_in_use")
+    if peak is not None:
+        out["hbm_peak_bytes"] = peak
+    return out

@@ -518,7 +518,16 @@ class Trainer:
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
         zero: bool = False,  # ZeRO-1: shard optimizer state over the data axis
+        metrics: Any = None,  # telemetry.MetricsRegistry (one is built if None)
+        metrics_every: int = 1,  # record every Nth step's scalars (0 = off)
+        flops_per_step: float | None = None,  # analytic train FLOPs -> MFU
+        comm_bytes_per_step: float | None = None,  # static collective bytes
     ) -> None:
+        from deeplearning_mpi_tpu.telemetry.registry import (
+            LoggerSink,
+            MetricsRegistry,
+        )
+
         self.state = state
         self.task = task
         self.mesh = mesh
@@ -529,6 +538,21 @@ class Trainer:
         self.heartbeat = heartbeat
         self.time_steps = time_steps
         self.zero = zero
+        # One registry per trainer, always: every metrics record — step,
+        # epoch, eval — flows through MetricsRegistry.emit, so there is one
+        # canonical record shape. A logger with log_metrics becomes a sink
+        # (its .metrics.jsonl sidecar keeps working, now fed the same
+        # records as every other sink).
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        if logger is not None and hasattr(logger, "log_metrics") and not any(
+            isinstance(s, LoggerSink) for s in self.metrics.sinks
+        ):
+            self.metrics.add_sink(LoggerSink(logger))
+        self.metrics_every = metrics_every
+        self.flops_per_step = flops_per_step
+        self.comm_bytes_per_step = comm_bytes_per_step
+        # Host-side step counter: int(state.step) would force a device sync.
+        self._global_step = 0
         self._step_kwargs = dict(
             aux_weight=aux_weight, grad_accum=grad_accum, loss_chunk=loss_chunk,
             seg_loss=seg_loss, ema_decay=ema_decay,
@@ -549,6 +573,7 @@ class Trainer:
 
     def run_epoch(self, loader: Any, epoch: int) -> dict[str, float]:
         """One training epoch; returns mean loss + timing stats."""
+        from deeplearning_mpi_tpu.telemetry.trace import annotate
         from deeplearning_mpi_tpu.utils.profiling import StepTimer
 
         t0 = time.perf_counter()
@@ -563,9 +588,14 @@ class Trainer:
                 elif n_batches == self.PROFILE_STEPS[1]:
                     self.profiler.stop()
                     self._profiled = True
-            self.state, metrics = self.train_step(self.state, batch)
+            with annotate("trainer/train_step"):
+                self.state, metrics = self.train_step(self.state, batch)
             if timer is not None:
                 timer.tick(metrics["loss"])
+            if self.metrics_every and self._global_step % self.metrics_every == 0:
+                # Buffers the DEVICE scalars; no fetch until flush_steps.
+                self.metrics.record_step(self._global_step, metrics)
+            self._global_step += 1
             if self.heartbeat is not None:
                 self.heartbeat.progress = {"epoch": epoch, "step_in_epoch": n_batches}
             # Accumulate on device, excluding non-finite batches from the mean
@@ -605,6 +635,30 @@ class Trainer:
             stats["moe_dropped_frac"] = float(drop_sum) / n_batches
         if timer is not None:
             stats.update(timer.summary(items_per_step=images // max(n_batches, 1)))
+        # Derived telemetry: MFU against device peak, static per-step
+        # collective bytes, live HBM high-water marks (None on CPU — the
+        # keys are then simply absent, never faked).
+        step_seconds = duration / n_batches
+        if self.flops_per_step:
+            from deeplearning_mpi_tpu.telemetry.flops import mfu
+
+            stats["mfu"] = mfu(
+                self.flops_per_step, step_seconds,
+                n_devices=int(self.mesh.devices.size),
+            )
+        if self.comm_bytes_per_step is not None:
+            stats["comm_bytes_per_step"] = float(self.comm_bytes_per_step)
+        from deeplearning_mpi_tpu.telemetry.memory import hbm_usage
+
+        hbm = hbm_usage()
+        if hbm:
+            stats.update(hbm)
+        # Drain the buffered per-step device scalars: ONE device_get for the
+        # whole epoch, after the loop — async dispatch never stalled on them.
+        extra = {"epoch": epoch}
+        if self.comm_bytes_per_step is not None:
+            extra["comm_bytes"] = float(self.comm_bytes_per_step)
+        self.metrics.flush_steps(extra=extra)
         if n_finite < n_batches:
             self._log(
                 f"Epoch {epoch}: skipped {n_batches - int(n_finite)} non-finite "
@@ -619,10 +673,10 @@ class Trainer:
         return stats
 
     def _log_metrics(self, kind: str, record: dict[str, Any]) -> None:
-        """Structured-metrics sidecar (``RunLogger.log_metrics``), when the
-        attached logger supports it."""
-        if self.logger is not None and hasattr(self.logger, "log_metrics"):
-            self.logger.log_metrics({"kind": kind, **record})
+        """Emit one canonical metrics record through the registry — every
+        sink (RunLogger sidecar, ``--metrics_dir`` JSONL, TensorBoard, ...)
+        sees the same ``{"ts", "kind", ...}`` shape."""
+        self.metrics.emit(kind, record)
 
     def report_eval(self, stats: dict[str, float], *, note: str | None = None) -> None:
         """Record + log a standalone evaluation (the ``--eval_only`` path).

@@ -112,6 +112,14 @@ def add_training_flags(
     group.add_argument("--eval_every", type=int, default=10, help="epochs between evals/checkpoints (reference cadence: resnet/main.py:136)")
     group.add_argument("--dtype", default="float32", choices=("float32", "bfloat16"), help="compute dtype (params stay float32)")
     group.add_argument("--profile_dir", default=None, help="write a jax.profiler trace of a few hot steps here (TensorBoard/Perfetto)")
+    group.add_argument("--metrics_dir", "--metrics-dir", default=None,
+                       help="write telemetry records (per-step scalars, epoch "
+                       "stats, MFU/HBM/collective-bytes) as JSONL under this "
+                       "directory; render with tools/metrics_report.py")
+    group.add_argument("--metrics_every", "--metrics-every", type=int, default=1,
+                       help="record every Nth step's scalars to the metrics "
+                       "sinks (0 = per-step records off; epoch records always "
+                       "flow)")
     group.add_argument("--max_restarts", type=int, default=0, help="auto-resume from the latest checkpoint this many times on failure (0 = fail immediately; the reference's analog is manual restart with --resume)")
     group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
     group.add_argument("--num_workers", type=int, default=None,
@@ -313,8 +321,25 @@ def setup_runtime(args: argparse.Namespace):
     return topo, mesh
 
 
-def build_observability(args: argparse.Namespace, trainer) -> None:
-    """Attach profiler + heartbeat from the shared flags to a Trainer."""
+def build_observability(
+    args: argparse.Namespace,
+    trainer,
+    *,
+    flops_per_step: float | None = None,
+    comm_bytes_per_step: float | None = None,
+) -> None:
+    """Attach profiler + heartbeat + telemetry from the shared flags.
+
+    ``--metrics_dir`` adds a JSONL sink to the trainer's registry (every
+    record — per-step scalars, epoch stats, evals — lands in
+    ``metrics.jsonl`` there; ``tools/metrics_report.py`` renders it).
+    ``flops_per_step`` / ``comm_bytes_per_step`` are the CLI's analytic
+    estimates (``telemetry.flops`` / ``telemetry.comms``) feeding the
+    trainer's MFU and collective-byte epoch stats. When the caller passes no
+    comm estimate, the pure-DP gradient all-reduce is derived from the
+    trainer's own state + mesh — every data-parallel run gets collective
+    accounting for free.
+    """
     from deeplearning_mpi_tpu.train.resilience import Heartbeat
     from deeplearning_mpi_tpu.utils.profiling import Profiler
 
@@ -326,6 +351,28 @@ def build_observability(args: argparse.Namespace, trainer) -> None:
         trainer.heartbeat = Heartbeat(
             pathlib.Path(args.log_dir) / "heartbeat.json"
         ).start()
+    metrics_dir = getattr(args, "metrics_dir", None)
+    if metrics_dir:
+        import pathlib
+
+        from deeplearning_mpi_tpu.telemetry.registry import JsonlSink
+
+        trainer.metrics.add_sink(
+            JsonlSink(pathlib.Path(metrics_dir) / "metrics.jsonl")
+        )
+    trainer.metrics_every = getattr(args, "metrics_every", trainer.metrics_every)
+    if flops_per_step is not None:
+        trainer.flops_per_step = flops_per_step
+    if comm_bytes_per_step is None and trainer.comm_bytes_per_step is None:
+        from deeplearning_mpi_tpu.telemetry import comms
+
+        dp = trainer.mesh.shape.get("data", 1)
+        comm_bytes_per_step = comms.dp_grad_allreduce_bytes(
+            comms.param_count(trainer.state.params), dp,
+            zero=getattr(trainer, "zero", False),
+        )
+    if comm_bytes_per_step is not None:
+        trainer.comm_bytes_per_step = comm_bytes_per_step
 
 
 def execute_training(
@@ -364,6 +411,8 @@ def execute_training(
         finally:
             if trainer.heartbeat is not None:
                 trainer.heartbeat.stop()
+            if getattr(trainer, "metrics", None) is not None:
+                trainer.metrics.close()
 
     if args.max_restarts > 0 and state_factory is None:
         # Without a factory, a pre-checkpoint crash would retry on the
@@ -402,3 +451,5 @@ def execute_training(
             trainer.heartbeat.stop()
         if trainer.profiler is not None:
             trainer.profiler.stop()  # finalize a trace left open by a crash
+        if getattr(trainer, "metrics", None) is not None:
+            trainer.metrics.close()  # flush + close every telemetry sink

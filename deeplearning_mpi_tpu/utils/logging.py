@@ -62,10 +62,11 @@ class RunLogger:
         """
         if not self.enabled or self.path is None:
             return
-        line = {
-            "ts": datetime.datetime.now().isoformat(timespec="seconds"),
-            **record,
-        }
+        # Records from telemetry.MetricsRegistry already carry a canonical
+        # numeric "ts"; stamp only bare records so the two never disagree.
+        line = dict(record)
+        if "ts" not in line:
+            line["ts"] = datetime.datetime.now().isoformat(timespec="seconds")
         with self.path.with_suffix(".metrics.jsonl").open("a") as f:
             f.write(json.dumps(line, default=float) + "\n")
 

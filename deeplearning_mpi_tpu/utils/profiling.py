@@ -143,6 +143,7 @@ class StepTimer:
             "steps_timed": float(len(d)),
             "step_ms_p50": statistics.median(d) * 1e3,
             "step_ms_p90": d[int(0.9 * (len(d) - 1))] * 1e3,
+            "step_ms_p95": d[int(0.95 * (len(d) - 1))] * 1e3,
             "step_ms_max": d[-1] * 1e3,
         }
         if items_per_step:
@@ -182,7 +183,9 @@ def measure_collective_latency(
         # Reduce to one scalar so the timing fetch is tiny. Summing the WHOLE
         # result (not a slice) keeps the full-buffer collective live — a
         # sliced dependency could let XLA shrink the psum to 8 floats.
-        reduced = jax.shard_map(
+        from deeplearning_mpi_tpu.runtime.compat import shard_map
+
+        reduced = shard_map(
             lambda s: jax.lax.psum(s, axis),
             mesh=mesh,
             in_specs=P(axis), out_specs=P(),

@@ -256,3 +256,328 @@ class TestExecuteTraining:
         assert out == "done"
         assert calls["restore"] == 1
         assert trainer.state == "restored"
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_semantics(self):
+        from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("tokens")
+        c.inc()
+        c.inc(4.0)
+        assert c.value == 5.0
+        assert reg.counter("tokens") is c  # get-or-create
+        with pytest.raises(ValueError):
+            c.inc(-1.0)
+        reg.gauge("mfu").set(0.41)
+        assert reg.gauge("mfu").value == 0.41
+        h = reg.histogram("step_ms")
+        for v in (5.0, 1.0, 3.0, 2.0, 4.0):
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 5 and s["mean"] == 3.0
+        assert s["p50"] == 3.0 and s["max"] == 5.0
+        snap = reg.snapshot()
+        assert snap["tokens"] == 5.0 and snap["step_ms_p50"] == 3.0
+
+    def test_emit_canonical_record_shape(self):
+        from deeplearning_mpi_tpu.telemetry import InMemorySink, MetricsRegistry
+
+        sink = InMemorySink()
+        reg = MetricsRegistry([sink])
+        reg.emit("epoch", {"loss": jnp.asarray(1.5), "nan": float("nan"),
+                           "note": "x"})
+        (rec,) = sink.records
+        assert rec["kind"] == "epoch" and isinstance(rec["ts"], float)
+        assert rec["loss"] == 1.5 and isinstance(rec["loss"], float)
+        assert rec["nan"] is None  # non-finite -> null, JSON-safe
+        assert rec["note"] == "x"
+
+    def test_record_step_buffers_without_fetch_then_one_flush(self):
+        from deeplearning_mpi_tpu.telemetry import InMemorySink, MetricsRegistry
+
+        sink = InMemorySink()
+        reg = MetricsRegistry([sink])
+        for step in range(3):
+            reg.record_step(step, {"loss": jnp.asarray(float(step))})
+        assert sink.records == []  # nothing emitted until the flush
+        out = reg.flush_steps(extra={"epoch": 7})
+        assert [r["step"] for r in out] == [0, 1, 2]
+        assert all(r["kind"] == "step" and r["epoch"] == 7 for r in sink.records)
+        assert sink.records[2]["loss"] == 2.0
+        assert reg.flush_steps() == []  # buffer drained
+
+    def test_broken_sink_never_raises_into_the_loop(self):
+        from deeplearning_mpi_tpu.telemetry import InMemorySink, MetricsRegistry
+
+        class Broken:
+            def write(self, record):
+                raise RuntimeError("sink died")
+
+            def close(self):
+                raise RuntimeError("close died")
+
+        good = InMemorySink()
+        reg = MetricsRegistry([Broken(), good])
+        reg.emit("step", {"loss": 1.0})  # must not raise
+        reg.close()  # must not raise
+        assert len(good.records) == 1
+
+
+class TestJsonlSink:
+    def test_round_trip(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry import JsonlSink, MetricsRegistry
+
+        path = tmp_path / "sub" / "metrics.jsonl"  # parent dirs auto-created
+        reg = MetricsRegistry([JsonlSink(path)])
+        reg.emit("epoch", {"epoch": 0, "loss": 2.5})
+        reg.record_step(0, {"loss": jnp.asarray(2.25)})
+        reg.close()  # close() drains the pending step buffer
+        records = [json.loads(line) for line in path.read_text().splitlines()]
+        assert [r["kind"] for r in records] == ["epoch", "step"]
+        assert records[0]["loss"] == 2.5 and records[1]["loss"] == 2.25
+
+    def test_report_tool_renders_required_columns(self, tmp_path):
+        """tools/metrics_report.py renders a registry-written JSONL with the
+        acceptance columns non-null."""
+        import pathlib
+        import sys
+
+        sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+        try:
+            import metrics_report
+        finally:
+            sys.path.pop(0)
+        from deeplearning_mpi_tpu.telemetry import JsonlSink, MetricsRegistry
+
+        path = tmp_path / "metrics.jsonl"
+        reg = MetricsRegistry([JsonlSink(path)])
+        reg.record_step(0, {"loss": 2.0, "finite": 1.0})
+        reg.flush_steps(extra={"epoch": 0, "comm_bytes": 1e6})
+        reg.emit("epoch", {"epoch": 0, "loss": 2.0, "images_per_s": 100.0,
+                           "step_ms_p50": 10.0, "step_ms_p95": 12.0,
+                           "mfu": 0.3, "comm_bytes_per_step": 1e6})
+        reg.close()
+        report = metrics_report.summarize(metrics_report.load_records(path))
+        for needle in ("images/s", "p50", "p95", "MFU", "collective bytes"):
+            assert needle in report
+
+
+class TestFlopsAndMfu:
+    def test_transformer_flops_match_hand_computation(self):
+        """Tiny dense config, fwd FLOPs recomputed by hand term by term."""
+        from deeplearning_mpi_tpu.models import TransformerConfig
+        from deeplearning_mpi_tpu.telemetry.flops import (
+            transformer_fwd_flops,
+            transformer_train_flops,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=256, num_layers=2, num_heads=4, head_dim=8,
+            d_model=32, d_ff=64,
+        )
+        batch, seq = 2, 16
+        d, h, dh, ff = 32, 4, 8, 64
+        per_token = (
+            2 * d * (h * dh) * 2      # q + out projections
+            + 2 * d * (h * dh) * 2    # k + v (no GQA: kv heads == heads)
+            + 4 * (seq / 2) * h * dh  # scores + values at S/2 visible
+            + 6 * d * ff              # SwiGLU gate/up/down
+        )
+        expected = batch * seq * (2 * per_token + 2 * d * 256)
+        assert transformer_fwd_flops(cfg, batch, seq) == pytest.approx(expected)
+        assert transformer_train_flops(cfg, batch, seq) == pytest.approx(
+            3 * expected
+        )
+
+    def test_mfu_arithmetic(self):
+        from deeplearning_mpi_tpu.telemetry.flops import mfu
+
+        # 1e9 FLOPs in 0.5 s on 1 device with 200e9 peak -> 1% exactly.
+        assert mfu(1e9, 0.5, n_devices=1, peak_flops_per_device=200e9) == (
+            pytest.approx(0.01)
+        )
+        assert mfu(0.0, 0.5, n_devices=1, peak_flops_per_device=1.0) is None
+        assert mfu(1e9, 0.0, n_devices=1, peak_flops_per_device=1.0) is None
+
+    def test_peak_flops_env_override(self, monkeypatch):
+        from deeplearning_mpi_tpu.telemetry import flops
+
+        monkeypatch.setenv("DMT_PEAK_FLOPS", "123e9")
+        assert flops.device_peak_flops() == 123e9
+
+
+class TestCommsAccounting:
+    def test_collective_byte_formulas(self):
+        from deeplearning_mpi_tpu.telemetry import comms
+
+        B = 1000.0
+        assert comms.allreduce_bytes(B, 4) == pytest.approx(2 * 3 / 4 * B)
+        assert comms.reduce_scatter_bytes(B, 4) == pytest.approx(3 / 4 * B)
+        assert comms.all_gather_bytes(B, 4) == pytest.approx(3 / 4 * B)
+        assert comms.all_to_all_bytes(B, 4) == pytest.approx(3 / 4 * B)
+        assert comms.ppermute_bytes(B, 4) == B
+        # Degenerate single-device axis: everything is free.
+        for fn in (comms.allreduce_bytes, comms.reduce_scatter_bytes,
+                   comms.all_gather_bytes, comms.all_to_all_bytes,
+                   comms.ppermute_bytes):
+            assert fn(B, 1) == 0.0
+
+    def test_dp_grad_allreduce_and_zero_equivalence(self):
+        from deeplearning_mpi_tpu.telemetry import comms
+
+        n_params, dp = 1_000_000, 8
+        plain = comms.dp_grad_allreduce_bytes(n_params, dp)
+        zero = comms.dp_grad_allreduce_bytes(n_params, dp, zero=True)
+        # ZeRO-1's RS+AG moves the same wire volume as the all-reduce.
+        assert plain == pytest.approx(zero)
+        assert plain == pytest.approx(2 * 7 / 8 * n_params * 4)
+
+    def test_param_count_never_fetches(self):
+        from deeplearning_mpi_tpu.telemetry import comms
+
+        params = {"w": jnp.zeros((4, 8)), "b": jnp.zeros((8,))}
+        assert comms.param_count(params) == 40
+
+
+class TestTraceAnnotations:
+    def test_annotations_do_not_change_train_step_outputs(self):
+        """Annotated regions are semantics-free: the same train step on the
+        same batch yields bit-identical loss and params with tracing
+        enabled vs disabled (CPU mesh; exercises trainer/train_step and the
+        model-internal scopes)."""
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+        from deeplearning_mpi_tpu.telemetry import trace
+        from deeplearning_mpi_tpu.train import create_train_state
+        from deeplearning_mpi_tpu.train.trainer import (
+            build_optimizer,
+            make_train_step,
+        )
+
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+        def run_one():
+            state = create_train_state(
+                model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+            )
+            batch = {
+                "tokens": jnp.asarray(
+                    np.random.default_rng(3).integers(0, 256, (4, 16)),
+                    jnp.int32,
+                )
+            }
+            new_state, metrics = make_train_step("lm", donate=False)(state, batch)
+            return float(metrics["loss"]), jax.tree.leaves(new_state.params)
+
+        old = trace.set_enabled(True)
+        try:
+            loss_on, params_on = run_one()
+            trace.set_enabled(False)
+            loss_off, params_off = run_one()
+        finally:
+            trace.set_enabled(old)
+        assert loss_on == loss_off
+        for a, b in zip(params_on, params_off):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_annotate_is_noop_when_disabled(self):
+        from deeplearning_mpi_tpu.telemetry import trace
+
+        old = trace.set_enabled(False)
+        try:
+            with trace.annotate("x"):
+                out = jnp.ones(()) + 1.0
+        finally:
+            trace.set_enabled(old)
+        assert float(out) == 2.0
+
+
+class TestTrainerTelemetry:
+    def test_trainer_emits_canonical_records_through_registry(self, mesh):
+        """Satellite (b): Trainer metric records flow through ONE registry —
+        the RunLogger sidecar and any other sink receive identical
+        canonical records, per-step scalars included."""
+        from deeplearning_mpi_tpu.telemetry import InMemorySink
+        from deeplearning_mpi_tpu.train import Trainer, create_train_state
+        from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+        )
+
+        class FakeLoader:
+            def epoch(self, epoch):
+                rng = np.random.default_rng(epoch)
+                for _ in range(3):
+                    yield {
+                        "tokens": jnp.asarray(
+                            rng.integers(0, 256, (8, 16)), jnp.int32
+                        )
+                    }
+
+        class FakeLogger:
+            def __init__(self):
+                self.records = []
+
+            def log(self, msg):
+                pass
+
+            def log_metrics(self, record):
+                self.records.append(dict(record))
+
+        logger = FakeLogger()
+        sink = InMemorySink()
+        trainer = Trainer(
+            state, "lm", mesh, logger=logger, flops_per_step=1e6,
+            comm_bytes_per_step=2048.0,
+        )
+        trainer.metrics.add_sink(sink)
+        stats = trainer.run_epoch(FakeLoader(), epoch=0)
+        trainer._log_metrics("epoch", stats)
+        kinds = [r["kind"] for r in sink.records]
+        assert kinds.count("step") == 3 and kinds[-1] == "epoch"
+        # LoggerSink fans the SAME records to the RunLogger-style consumer.
+        assert logger.records == sink.records
+        steps = [r for r in sink.records if r["kind"] == "step"]
+        assert [r["step"] for r in steps] == [0, 1, 2]
+        assert all(r["epoch"] == 0 and r["comm_bytes"] == 2048.0 for r in steps)
+        epoch_rec = sink.records[-1]
+        assert epoch_rec["mfu"] is not None and epoch_rec["mfu"] > 0
+        assert epoch_rec["comm_bytes_per_step"] == 2048.0
+        assert "ts" in epoch_rec
+
+    def test_metrics_every_thins_step_records(self, mesh):
+        from deeplearning_mpi_tpu.telemetry import InMemorySink
+        from deeplearning_mpi_tpu.train import Trainer, create_train_state
+        from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+        )
+
+        class FakeLoader:
+            def epoch(self, epoch):
+                rng = np.random.default_rng(epoch)
+                for _ in range(4):
+                    yield {
+                        "tokens": jnp.asarray(
+                            rng.integers(0, 256, (8, 16)), jnp.int32
+                        )
+                    }
+
+        sink = InMemorySink()
+        trainer = Trainer(state, "lm", mesh, metrics_every=2, time_steps=False)
+        trainer.metrics.add_sink(sink)
+        trainer.run_epoch(FakeLoader(), epoch=0)
+        steps = [r["step"] for r in sink.records if r["kind"] == "step"]
+        assert steps == [0, 2]

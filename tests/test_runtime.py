@@ -6,10 +6,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deeplearning_mpi_tpu.runtime import bootstrap, collectives
+from deeplearning_mpi_tpu.runtime.compat import shard_map
 from deeplearning_mpi_tpu.runtime.hello_world import run_hello_world
 from deeplearning_mpi_tpu.runtime.mesh import (
     AXIS_DATA,

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL (``--metrics_dir``'s ``metrics.jsonl``, or a
+``RunLogger`` ``.metrics.jsonl`` sidecar — same canonical schema) into a
+run summary table.
+
+    python tools/metrics_report.py logs/metrics/metrics.jsonl
+    python tools/metrics_report.py --selftest   # synthesize + render
+
+Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
+(``deeplearning_mpi_tpu/telemetry/registry.py``). Summarized per kind:
+
+- ``step``   — count, loss first→last, step-rate, per-step collective bytes;
+- ``epoch``  — loss trajectory, images/sec, step-latency p50/p95 (StepTimer
+  keys when present), MFU, HBM high-water marks;
+- ``eval`` kinds — last record's metric columns verbatim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+
+def load_records(path: Path) -> list[dict]:
+    records = []
+    with path.open() as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{lineno}: unparseable line skipped",
+                      file=sys.stderr)
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1e15 or 0 < abs(v) < 1e-3:
+            return f"{v:.3e}"
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _bytes(v) -> str:
+    if v is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.2f} {unit}" if unit != "B" else f"{v:.0f} B"
+        v /= 1024.0
+    return "-"
+
+
+def table(title: str, rows: list[tuple[str, str]]) -> str:
+    if not rows:
+        return ""
+    width = max(len(k) for k, _ in rows)
+    lines = [title, "-" * len(title)]
+    lines += [f"{k.ljust(width)}  {v}" for k, v in rows]
+    return "\n".join(lines) + "\n"
+
+
+def _percentile(values: list[float], q: float) -> float | None:
+    if not values:
+        return None
+    d = sorted(values)
+    return d[int(q * (len(d) - 1))]
+
+
+def summarize(records: list[dict]) -> str:
+    steps = [r for r in records if r.get("kind") == "step"]
+    epochs = [r for r in records if r.get("kind") == "epoch"]
+    evals = [r for r in records
+             if str(r.get("kind", "")).startswith(("eval", "final_eval"))]
+    out = []
+
+    if steps:
+        losses = [r["loss"] for r in steps
+                  if isinstance(r.get("loss"), (int, float))]
+        ts = [r["ts"] for r in steps if isinstance(r.get("ts"), (int, float))]
+        rows = [("steps recorded", _fmt(len(steps)))]
+        if losses:
+            rows += [("loss first", _fmt(losses[0])),
+                     ("loss last", _fmt(losses[-1]))]
+        if len(ts) > 1 and ts[-1] > ts[0]:
+            # Record timestamps are flush-batched, so this is a lower bound
+            # on true step rate — the epoch table's images/s is the real one.
+            rows.append(("steps/s (record ts, lower bound)",
+                         _fmt((len(ts) - 1) / (ts[-1] - ts[0]))))
+        comm = [r["comm_bytes"] for r in steps
+                if isinstance(r.get("comm_bytes"), (int, float))]
+        if comm:
+            rows.append(("collective bytes/step/device", _bytes(comm[-1])))
+        out.append(table("Steps", rows))
+
+    if epochs:
+        losses = [r["loss"] for r in epochs
+                  if isinstance(r.get("loss"), (int, float))]
+        rows = [("epochs recorded", _fmt(len(epochs)))]
+        if losses:
+            rows += [("loss first", _fmt(losses[0])),
+                     ("loss last", _fmt(losses[-1])),
+                     ("loss best", _fmt(min(losses)))]
+        ips = [r["images_per_s"] for r in epochs
+               if isinstance(r.get("images_per_s"), (int, float))]
+        if ips:
+            rows.append(("images/s (mean over epochs)",
+                         _fmt(sum(ips) / len(ips))))
+        # StepTimer's per-epoch latency percentiles, pooled p50-of-p50s etc.
+        for key, label in (("step_ms_p50", "step latency p50 (ms)"),
+                           ("step_ms_p95", "step latency p95 (ms)")):
+            vals = [r[key] for r in epochs
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                rows.append((label, _fmt(_percentile(vals, 0.5))))
+        mfus = [r["mfu"] for r in epochs
+                if isinstance(r.get("mfu"), (int, float))]
+        if mfus:
+            rows.append(("MFU (mean)", f"{sum(mfus) / len(mfus):.2%}"))
+        comm = [r["comm_bytes_per_step"] for r in epochs
+                if isinstance(r.get("comm_bytes_per_step"), (int, float))]
+        if comm:
+            rows.append(("collective bytes/step/device", _bytes(comm[-1])))
+        for key, label in (("hbm_bytes_in_use", "HBM in use (max device)"),
+                           ("hbm_peak_bytes", "HBM peak"),
+                           ("hbm_bytes_limit", "HBM limit")):
+            vals = [r[key] for r in epochs
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                rows.append((label, _bytes(max(vals))))
+        hbm_util = [r["hbm_utilization"] for r in epochs
+                    if isinstance(r.get("hbm_utilization"), (int, float))]
+        if hbm_util:
+            rows.append(("HBM utilization (max)", f"{max(hbm_util):.2%}"))
+        drop = [r["moe_dropped_frac"] for r in epochs
+                if isinstance(r.get("moe_dropped_frac"), (int, float))]
+        if drop:
+            rows.append(("MoE dropped frac (last)", _fmt(drop[-1])))
+        out.append(table("Epochs", rows))
+
+    if evals:
+        last = evals[-1]
+        rows = [(k, _fmt(v)) for k, v in sorted(last.items())
+                if k not in ("ts", "kind")]
+        out.append(table(f"Last eval ({last.get('kind')})", rows))
+
+    if not out:
+        return "no step/epoch/eval records found\n"
+    return "\n".join(out)
+
+
+def _selftest() -> int:
+    """Synthesize a run through the real registry, render it, and assert the
+    acceptance columns come out non-null."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from deeplearning_mpi_tpu.telemetry.flops import mfu
+    from deeplearning_mpi_tpu.telemetry.registry import JsonlSink, MetricsRegistry
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "metrics.jsonl"
+        reg = MetricsRegistry([JsonlSink(path)])
+        for step in range(8):
+            reg.record_step(step, {"loss": 2.0 - 0.1 * step, "finite": 1.0})
+        reg.flush_steps(extra={"epoch": 0, "comm_bytes": 1.5e6})
+        reg.emit("epoch", {
+            "epoch": 0, "loss": 1.65, "duration_s": 4.0, "images_per_s": 64.0,
+            "step_ms_p50": 480.0, "step_ms_p95": 520.0,
+            "mfu": mfu(1e9, 0.5, n_devices=1, peak_flops_per_device=200e9),
+            "comm_bytes_per_step": 1.5e6,
+        })
+        reg.emit("final_eval", {"epoch": 0, "eval_loss": 1.6, "eval_accuracy": 0.41})
+        reg.close()
+        report = summarize(load_records(path))
+        print(report)
+        for needle in ("images/s", "p50", "p95", "MFU", "collective bytes"):
+            if needle not in report:
+                print(f"selftest FAILED: '{needle}' missing from report",
+                      file=sys.stderr)
+                return 1
+    print("selftest OK")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("jsonl", nargs="?", type=Path,
+                        help="metrics JSONL (from --metrics_dir or a "
+                        "RunLogger .metrics.jsonl sidecar)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="synthesize a run through the registry and "
+                        "render it (no training required)")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if args.jsonl is None:
+        parser.error("pass a metrics JSONL path or --selftest")
+    if not args.jsonl.is_file():
+        print(f"error: {args.jsonl} not found", file=sys.stderr)
+        return 1
+    records = load_records(args.jsonl)
+    print(f"{args.jsonl}: {len(records)} records\n")
+    print(summarize(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
