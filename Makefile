@@ -4,10 +4,12 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke
+.PHONY: verify selftest check smoke serve-smoke
 
-# Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify").
-verify:
+# Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
+# serve-smoke prerequisite gates the tier-1 run on the serving engine's
+# end-to-end parity selftest without touching the ROADMAP command itself.
+verify: serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -15,6 +17,17 @@ selftest:
 	env JAX_PLATFORMS=cpu python tools/metrics_report.py --selftest
 
 check: verify selftest
+
+# Continuous-batching serving engine end-to-end: random-init model, Poisson
+# trace, every completion verified token-for-token against offline greedy
+# decode (docs/SERVING.md).
+serve-smoke:
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.serve_lm \
+		--selftest --num_layers 2 --num_heads 2 --head_dim 16 \
+		--d_model 64 --d_ff 128 --num_requests 8 --rate 100 \
+		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
+		--max_slots 3 --block_size 8 --num_blocks 32 \
+		--max_blocks_per_seq 6 --prefill_chunk 8
 
 # 30-second observability demo: tiny CPU-mesh LM run with telemetry on,
 # rendered by the report tool (docs/OBSERVABILITY.md walks through it).

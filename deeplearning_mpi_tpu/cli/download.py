@@ -76,15 +76,17 @@ def _verify_and_extract(
         except TypeError:  # filter= needs py>=3.10.12/3.11.4/3.12
             # Manual tar-slip guard for the no-filter fallback: the ingest
             # path can run UNVERIFIED (--md5 none), so members must be
-            # checked before a bare extractall — names for traversal, AND
-            # symlink/hardlink members (a link pointing outside data_dir
-            # redirects a later member's write; name checks alone don't see
-            # it). CIFAR tarballs contain only regular files + dirs.
+            # checked before a bare extractall — names for traversal, and an
+            # ALLOWLIST of member types. Deny-listing symlink/hardlink was
+            # not enough: a device node or FIFO member extracts too (a FIFO
+            # blocks the next read; a device node is worse run as root) —
+            # CIFAR tarballs contain only regular files + dirs, so only
+            # those pass.
             bad = [
                 m.name for m in tar.getmembers()
                 if m.name.startswith(("/", ".."))
                 or ".." in Path(m.name).parts
-                or m.issym() or m.islnk()
+                or not (m.isfile() or m.isdir())
             ]
             if bad:
                 print(f"refusing unsafe tar members: {bad[:3]}",

@@ -363,7 +363,26 @@ def main(argv: list[str] | None = None) -> int:
         def call():
             return fn(params, prompt, rng, prompt_lens)
 
-    if args.time and args.num_beams == 1 and prompt_lens is None:
+    timed_split = (
+        args.time and args.num_beams == 1 and prompt_lens is None
+        and args.max_new_tokens >= 2
+    )
+    if (
+        args.time and args.num_beams == 1 and prompt_lens is None
+        and not timed_split
+    ):
+        # The phase-split path decodes max_new - 1 model steps after the
+        # prefill sample: at 0 it would crash in decode_tokens (steps >= 1)
+        # and at 1 there IS no decode phase — a "decode tokens/s" over zero
+        # steps is noise, not a measurement.
+        print(
+            "--time needs --max_new_tokens >= 2 for the prefill/decode "
+            "split (the first token comes from prefill; the decode phase "
+            f"would run {max(args.max_new_tokens - 1, 0)} steps) — "
+            "running untimed",
+            file=sys.stderr,
+        )
+    if timed_split:
         # Honest split timing: phase-separate jits so prefill (one batched
         # MXU-bound forward over the prompt) and decode (the HBM-bound
         # per-token cache walk, generated tokens ONLY) each get their own

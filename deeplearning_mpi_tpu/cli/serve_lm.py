@@ -1,0 +1,398 @@
+"""Continuous-batching LM serving benchmark — trace replay + latency report.
+
+The offline ``dmt-generate`` answers "what does this checkpoint say"; this
+CLI answers "how does it SERVE": it replays a request trace (Poisson
+arrivals or a JSONL file) through the ``serving`` engine — paged KV cache,
+chunked prefill interleaved with decode, admission control — and reports
+the latency numbers serving is judged on: TTFT (arrival → first generated
+token), TPOT (decode-phase seconds per token), and aggregate generated
+tokens/s, plus the engine's live counters (queue depth, slot occupancy,
+shed requests, KV blocks in use) through the telemetry registry
+(``--metrics_file`` appends the canonical JSONL records
+``tools/metrics_report.py`` reads; see docs/OBSERVABILITY.md).
+
+Trace file format: one JSON object per line —
+``{"arrival": seconds-from-start, "prompt": "text", "max_new": N,
+"deadline": seconds-after-arrival (optional)}``; only ``prompt`` is
+required (``arrival`` defaults to 0 — submit immediately).
+
+``--selftest`` needs no checkpoint: it serves a tiny random-init model
+against a synthetic Poisson trace and verifies every completion against
+the offline greedy decode path token-for-token — the correctness contract
+of continuous batching is that co-batched strangers never change your
+output. ``make serve-smoke`` runs exactly this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from collections import deque
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dmt-serve-lm",
+        description="Replay a request trace through the continuous-batching "
+        "serving engine; report TTFT/TPOT/tokens/s.",
+    )
+    from deeplearning_mpi_tpu.utils import config
+
+    model = config.add_lm_model_flags(parser)
+    model.title = (
+        "model (MUST match the training run — the checkpoint stores arrays, "
+        "not architecture)"
+    )
+    model.add_argument("--dtype", default="float32",
+                       choices=("float32", "bfloat16"))
+    parser.add_argument("--model_dir", default="saved_models")
+    parser.add_argument("--model_filename", default="lm")
+    parser.add_argument("--epoch", type=int, default=None)
+    parser.add_argument("--ema", type=config.ema_decay, default=0.0,
+                        help="nonzero = serve the EMA-averaged weights "
+                        "(match the training run's --ema)")
+    eng = parser.add_argument_group("engine")
+    eng.add_argument("--max_slots", type=int, default=4,
+                     help="concurrent decode slots (the jitted step's batch)")
+    eng.add_argument("--block_size", type=int, default=16,
+                     help="token positions per KV block")
+    eng.add_argument("--num_blocks", type=int, default=64,
+                     help="KV pool blocks per layer (one is scratch)")
+    eng.add_argument("--max_blocks_per_seq", type=int, default=8,
+                     help="block-table width; admission ceiling is "
+                     "max_blocks_per_seq * block_size positions")
+    eng.add_argument("--prefill_chunk", type=int, default=16,
+                     help="prompt positions prefilled per slot per step "
+                     "(chunked prefill interleaves with decode)")
+    eng.add_argument("--max_queue", type=int, default=64,
+                     help="bounded request queue; overflow is shed")
+    eng.add_argument("--use_kernel", action="store_true",
+                     help="dispatch decode attention to the Pallas "
+                     "flash_decode kernel (per-row fill levels)")
+    trace = parser.add_argument_group("trace")
+    trace.add_argument("--trace", default=None,
+                       help="JSONL request trace (see module docstring); "
+                       "default: synthetic Poisson trace")
+    trace.add_argument("--rate", type=float, default=20.0,
+                       help="Poisson arrival rate, requests/s")
+    trace.add_argument("--num_requests", type=int, default=16)
+    trace.add_argument("--prompt_len_min", type=int, default=4)
+    trace.add_argument("--prompt_len_max", type=int, default=24)
+    trace.add_argument("--max_new_tokens", type=int, default=16,
+                       help="generation budget per request (trace entries "
+                       "may override)")
+    trace.add_argument("--deadline", type=float, default=0.0,
+                       help="seconds after arrival a QUEUED request is shed "
+                       "(0 = no deadline; trace entries may override)")
+    trace.add_argument("--eos_id", type=int, default=-1,
+                       help="byte value that finishes a sequence (-1 = off)")
+    trace.add_argument("--random_seed", type=int, default=0)
+    parser.add_argument("--metrics_file", default=None,
+                        help="append canonical telemetry JSONL records here "
+                        "(readable by tools/metrics_report.py)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="random-init tiny-ish model, synthetic trace, "
+                        "verify every completion against offline greedy "
+                        "decode; exit 0 iff all match (no checkpoint needed)")
+    run = parser.add_argument_group("runtime")
+    run.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    return parser
+
+
+def _load_trace(path: str, default_max_new: int, default_deadline: float):
+    import numpy as np
+
+    entries = []
+    try:
+        lines = open(path, encoding="utf-8").read().splitlines()
+    except OSError as e:
+        raise SystemExit(f"cannot read --trace: {e}")
+    for n, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+            text = obj["prompt"]
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            raise SystemExit(f"{path}:{n}: bad trace entry ({e})")
+        prompt = np.frombuffer(
+            text.encode("utf-8") or b"\x00", np.uint8
+        ).astype(np.int32)
+        entries.append({
+            "arrival": float(obj.get("arrival", 0.0)),
+            "prompt": prompt,
+            "max_new": int(obj.get("max_new", default_max_new)),
+            "deadline": float(obj.get("deadline", default_deadline)),
+        })
+    if not entries:
+        raise SystemExit(f"{path}: empty trace")
+    return sorted(entries, key=lambda e: e["arrival"])
+
+
+def _poisson_trace(args):
+    import numpy as np
+
+    rng = np.random.default_rng(args.random_seed)
+    t = 0.0
+    entries = []
+    for _ in range(args.num_requests):
+        t += float(rng.exponential(1.0 / args.rate))
+        n = int(rng.integers(args.prompt_len_min, args.prompt_len_max + 1))
+        entries.append({
+            "arrival": t,
+            "prompt": rng.integers(1, 256, size=n).astype(np.int32),
+            "max_new": args.max_new_tokens,
+            "deadline": args.deadline,
+        })
+    return entries
+
+
+def replay(engine, entries, *, poll_s: float = 0.0005):
+    """Submit each entry at its arrival offset (wall clock) and step the
+    engine until everything drains. Returns the Request records in
+    submission order."""
+    pending = deque(entries)
+    reqs = []
+    t0 = time.monotonic()
+    while pending or not engine.scheduler.idle():
+        now = time.monotonic() - t0
+        while pending and pending[0]["arrival"] <= now:
+            e = pending.popleft()
+            deadline = (
+                t0 + e["arrival"] + e["deadline"] if e["deadline"] > 0
+                else None
+            )
+            reqs.append(
+                engine.submit(e["prompt"], e["max_new"], deadline=deadline)
+            )
+        if not engine.scheduler.idle():
+            engine.step()
+        elif pending:
+            time.sleep(min(poll_s, max(pending[0]["arrival"] - now, 0.0)))
+    return reqs, time.monotonic() - t0
+
+
+def _report(reqs, wall_s, registry, out=sys.stderr):
+    from deeplearning_mpi_tpu.serving import RequestState
+
+    done = [r for r in reqs if r.state is RequestState.FINISHED]
+    shed = [r for r in reqs if r.state is RequestState.SHED]
+    tokens = sum(len(r.generated) for r in done)
+    print(
+        f"requests: {len(reqs)} submitted, {len(done)} completed, "
+        f"{len(shed)} shed"
+        + (
+            " (" + ", ".join(
+                f"{sum(1 for r in shed if r.shed_reason == why)} {why}"
+                for why in sorted({r.shed_reason for r in shed})
+            ) + ")"
+            if shed else ""
+        ),
+        file=out,
+    )
+    snap = registry.snapshot()
+    ttft = [k for k in ("serve_ttft_s_p50", "serve_ttft_s_p95") if k in snap]
+    if done:
+        print(
+            f"completed tokens: {tokens} in {wall_s:.3f}s wall = "
+            f"{tokens / wall_s:.1f} tokens/s",
+            file=out,
+        )
+    if ttft:
+        print(
+            "TTFT p50/p95: "
+            + "/".join(f"{snap[k] * 1e3:.1f}" for k in ttft) + " ms"
+            + (
+                f" | TPOT p50: {snap['serve_tpot_s_p50'] * 1e3:.2f} ms"
+                if "serve_tpot_s_p50" in snap else ""
+            ),
+            file=out,
+        )
+    print(
+        f"engine: {snap.get('serve_decode_steps', 0):.0f} decode steps, "
+        f"{snap.get('serve_prefill_chunks', 0):.0f} prefill chunks",
+        file=out,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    eos_id = args.eos_id if args.eos_id >= 0 else None
+    if eos_id is not None and eos_id > 255:
+        print(f"--eos_id {eos_id} is outside the byte vocab (0-255)",
+              file=sys.stderr)
+        return 1
+    if args.moe_experts > 0:
+        # Same fail-fast rule as dmt-generate's composition checks: the
+        # engine would raise anyway, but before minutes of init/restore.
+        print(
+            "serving is dense-MLP only: MoE capacity routing makes a "
+            "token's output depend on co-batched strangers, breaking the "
+            "engine's request-independence contract",
+            file=sys.stderr,
+        )
+        return 1
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.serving import (
+        EngineConfig,
+        RequestState,
+        ServingEngine,
+    )
+    from deeplearning_mpi_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    cfg = TransformerConfig(
+        vocab_size=256,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads or None,
+        head_dim=args.head_dim,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        attention_window=args.attention_window,
+    )
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dtype)
+
+    if args.selftest:
+        params = model.init(
+            jax.random.key(args.random_seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+    else:
+        from pathlib import Path
+
+        import optax
+
+        from deeplearning_mpi_tpu.train import (
+            Checkpointer,
+            create_train_state,
+        )
+        from deeplearning_mpi_tpu.utils import config as uconfig
+
+        ckpt_dir = Path(args.model_dir) / args.model_filename
+        if not ckpt_dir.is_dir():
+            print(f"no checkpoint found under {ckpt_dir} "
+                  "(--selftest serves a random-init model)", file=sys.stderr)
+            return 1
+        err = uconfig.arch_mismatch_error(cfg, ckpt_dir)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        template = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+            optax.identity(), ema=args.ema > 0,
+        )
+        ckpt = Checkpointer(ckpt_dir)
+        try:
+            state = ckpt.restore_params_only(template, epoch=args.epoch)
+        except Exception as e:  # noqa: BLE001 — orbax raises its own types;
+            # one clean line beats a multi-frame traceback for a CLI.
+            print(f"failed to restore from {ckpt.directory}: {e}",
+                  file=sys.stderr)
+            return 1
+        finally:
+            ckpt.close()
+        params = state.params if state.ema_params is None else state.ema_params
+
+    registry = MetricsRegistry()
+    if args.metrics_file:
+        registry.add_sink(JsonlSink(args.metrics_file))
+    engine = ServingEngine(
+        cfg, params,
+        EngineConfig(
+            max_slots=args.max_slots,
+            block_size=args.block_size,
+            num_blocks=args.num_blocks,
+            max_blocks_per_seq=args.max_blocks_per_seq,
+            prefill_chunk=args.prefill_chunk,
+            max_queue=args.max_queue,
+            use_kernel=args.use_kernel,
+        ),
+        dtype=dtype, eos_id=eos_id, registry=registry,
+    )
+
+    if args.trace:
+        entries = _load_trace(args.trace, args.max_new_tokens, args.deadline)
+    else:
+        entries = _poisson_trace(args)
+    too_long = [
+        i for i, e in enumerate(entries)
+        if len(e["prompt"]) + e["max_new"] > engine.engine.max_seq_len
+    ]
+    if too_long:
+        print(
+            f"warning: {len(too_long)} request(s) exceed the engine's "
+            f"{engine.engine.max_seq_len}-position ceiling "
+            "(max_blocks_per_seq * block_size) and will be shed at submit",
+            file=sys.stderr,
+        )
+
+    reqs, wall_s = replay(engine, entries)
+    _report(reqs, wall_s, registry)
+    registry.emit("serve_summary", registry.snapshot())
+    registry.close()
+
+    if not args.selftest:
+        for r in reqs:
+            if r.state is RequestState.FINISHED:
+                text = np.asarray(r.generated, np.uint8).tobytes().decode(
+                    "utf-8", errors="replace"
+                )
+                print(f"[{r.rid}] {text!r}")
+        return 0
+
+    # Selftest parity: every completed request must match the offline
+    # greedy decode of the same prompt token-for-token — a completion that
+    # depends on which strangers shared the batch is the one bug class a
+    # continuous-batching engine must never have.
+    from deeplearning_mpi_tpu.models.generate import generate
+
+    done = [r for r in reqs if r.state is RequestState.FINISHED]
+    if len(done) != len(reqs):
+        bad = [(r.rid, r.state.value, r.shed_reason) for r in reqs
+               if r.state is not RequestState.FINISHED]
+        print(f"selftest: not all requests completed: {bad}", file=sys.stderr)
+        return 1
+    mismatched = 0
+    for r in done:
+        out = generate(
+            model, params, jnp.asarray(r.prompt)[None],
+            max_new_tokens=r.max_new_tokens, rng=jax.random.key(0),
+            temperature=0.0, eos_id=eos_id,
+        )
+        expect = np.asarray(out)[0, r.prompt_len :].tolist()
+        if eos_id is not None and eos_id in expect:
+            # offline pads with EOS to the static window; the engine stops.
+            expect = expect[: expect.index(eos_id) + 1]
+        if r.generated != expect:
+            mismatched += 1
+            print(
+                f"selftest: rid {r.rid} diverged from offline greedy:\n"
+                f"  engine : {r.generated}\n  offline: {expect}",
+                file=sys.stderr,
+            )
+    if mismatched:
+        print(f"selftest FAILED: {mismatched}/{len(done)} request(s) "
+              "diverged", file=sys.stderr)
+        return 1
+    print(
+        f"selftest OK: {len(done)} requests bit-identical to offline "
+        f"greedy decode ({engine.pool.total_allocated} block allocations, "
+        f"{engine.pool.total_freed} frees)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,11 +106,25 @@ def prefill(
 
     The cache is created here (empty) and written once — the "prefill on an
     empty cache only" contract of ``Attention.decode == 'prefill'`` holds by
-    construction. MoE models prefill with TRAINING routing semantics
-    (capacity limits can drop prompt tokens exactly as training would),
-    where the stepwise path never dropped — train/serve consistency over
-    the old accident.
+    construction.
+
+    MoE models take a stepwise path instead: the fast path's one batched
+    forward routes the WHOLE prompt through the experts at once, so capacity
+    contention between prompt positions can drop tokens the per-position
+    decode walk never drops — the fast path would then be a semantic change,
+    not the pure execution-schedule change every other caller (fast-path
+    generate, shared_prefix, beam seeding, the CLI's timed split) assumes
+    when they treat prefill and the stepwise scan as interchangeable. So for
+    ``moe_experts > 0`` the cache is filled by a ``lax.scan`` of single-token
+    decode steps — per-position routing, identical numerics to the stepwise
+    walk, O(P) sequential steps (the price of routing consistency; the MXU-
+    batched chunk stays the dense-model fast path).
     """
+    if model.config.moe_experts > 0:
+        return _prefill_stepwise(
+            model, params, prompt, total_len=total_len,
+            last_logits_only=last_logits_only,
+        )
     if attention_fn is None:
         attention_fn = _prefill_attention_fn()
     last_via_prehead = last_logits_only and model.config.tied_embeddings
@@ -139,6 +153,44 @@ def prefill(
     else:
         logits = out
     return mutated["cache"], logits
+
+
+def _prefill_stepwise(
+    model: TransformerLM,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    total_len: int,
+    last_logits_only: bool = True,
+) -> tuple[Any, jax.Array]:
+    """Cache-fill by scanning single-token decode steps — the MoE prefill.
+
+    Same contract as :func:`prefill` (fresh cache, positions ``0..P-1``
+    written, last-position — or full — logits returned), but each prompt
+    position is routed through the experts exactly as the decode walk
+    routes it, so prefill-then-decode and the uniform stepwise scan emit
+    identical tokens (the parity ``tests/test_generate.py`` pins for MoE).
+    """
+    decode_model = dataclasses.replace(model, decode=True, attention_fn=None)
+    batch, prompt_len = prompt.shape
+    cache = decode_model.init(
+        jax.random.key(0), jnp.zeros((batch, total_len), jnp.int32)
+    )["cache"]
+
+    def body(cache, i):
+        tok = lax.dynamic_index_in_dim(prompt, i, axis=1, keepdims=True)
+        logits, mutated = decode_model.apply(
+            {"params": params, "cache": cache},
+            tok,
+            positions=jnp.full((batch, 1), i, jnp.int32),
+            mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, 0]
+
+    cache, logits = lax.scan(body, cache, jnp.arange(prompt_len))
+    if last_logits_only:
+        return cache, logits[-1]  # [B, V]
+    return cache, jnp.moveaxis(logits, 0, 1)  # [B, P, V]
 
 
 def first_token(

@@ -259,3 +259,92 @@ def decode_attention(
     )
     out = acc / jnp.maximum(l, 1e-37)[..., None]
     return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)
+
+
+def batched_decode_attention(
+    q: jax.Array,
+    k_buf: jax.Array,
+    v_buf: jax.Array,
+    index: jax.Array,
+    *,
+    window: int | None = None,
+    use_kernel: bool | None = None,
+    block: int = 1024,
+) -> jax.Array:
+    """One decode step where every row sits at its OWN fill level.
+
+    :func:`decode_attention` serves the single-program CLI path: one scalar
+    ``index`` because the whole batch decodes in lockstep. A continuous-
+    batching engine breaks that assumption by design — each slot holds a
+    different sequence, so ``index`` here is ``[B]`` int32 (row ``b`` attends
+    cache positions ``0..index[b]``; negative = inactive row, output zeros).
+    Shapes otherwise match: ``q`` ``[B, 1, H, D]``, grouped buffers
+    ``[B, L, Hkv, D]``, grouped heads consumed natively.
+
+    Two schedules, chosen STATICALLY like decode_attention's:
+
+    - default: ONE masked grouped einsum over the whole buffer — the
+      dense-roofline schedule (PERF_ANALYSIS §9) with the scalar prefix
+      mask swapped for a per-row one. The serving engine's buffers are the
+      gathered pages of ``serving.kv_pool`` (``max_blocks_per_seq * block``
+      rows), sized by the engine's admission limit, so the read-everything
+      trade is the measured-fastest one at those lengths.
+    - ``use_kernel=True``: the fused Pallas kernel
+      (:func:`~deeplearning_mpi_tpu.ops.pallas.flash_decode.flash_decode`),
+      which takes the ``[B]`` index vector natively — per-row clamped DMAs
+      keep HBM traffic O(own index) per row on long buffers. Falls back to
+      the einsum when the buffer does not tile.
+
+    Not differentiable; decode is inference-only.
+    """
+    batch, q_len, heads, head_dim = q.shape
+    if q_len != 1:
+        raise ValueError(f"batched_decode_attention takes one query token, got {q_len}")
+    length, kv_heads = k_buf.shape[1], k_buf.shape[2]
+    if heads % kv_heads:
+        raise ValueError(
+            f"query heads ({heads}) must be a multiple of KV heads ({kv_heads})"
+        )
+    index = jnp.asarray(index, jnp.int32)
+    if index.shape != (batch,):
+        raise ValueError(
+            f"index must be [{batch}] (one fill level per row), got {index.shape}"
+        )
+    if use_kernel:
+        from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
+            decode_block_fits,
+            flash_decode,
+        )
+
+        fitted = decode_block_fits(min(block, 1024), length)
+        if fitted is not None:
+            out = flash_decode(
+                q, k_buf, v_buf, jnp.maximum(index, 0), block=fitted,
+                window=window,
+            )
+            return jnp.where(index[:, None, None, None] >= 0, out, 0.0)
+    group = heads // kv_heads
+    scale = head_dim**-0.5
+    qg = q[:, 0].reshape(batch, kv_heads, group, head_dim)
+    s = jnp.einsum(
+        "bhgd,bkhd->bhgk", qg, k_buf, preferred_element_type=jnp.float32
+    ) * scale  # [B, Hkv, G, L]
+    pos = jnp.arange(length, dtype=jnp.int32)
+    valid = pos[None, :] <= index[:, None]  # [B, L] — per-row prefix
+    if window is not None:
+        valid &= pos[None, :] > (index[:, None] - window)
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    # An inactive row (index < 0) has NO valid key: zero its output rather
+    # than letting softmax renormalize the all-masked row into a uniform
+    # average of garbage V rows (same rule as dense_attention's empty-row
+    # guard).
+    w = jnp.where(
+        jnp.any(valid, axis=-1)[:, None, None, None],
+        jax.nn.softmax(s, axis=-1),
+        0.0,
+    )
+    out = jnp.einsum(
+        "bhgk,bkhd->bhgd", w.astype(v_buf.dtype), v_buf,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)

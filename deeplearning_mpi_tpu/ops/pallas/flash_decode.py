@@ -18,6 +18,13 @@ rides a scalar-prefetch argument:
 - masking inside the boundary blocks uses the prefetched `index` scalar
   (both the filled-prefix end and the window's trailing edge).
 
+The prefetched index is PER ROW (`[B]`; a scalar broadcasts): every batch
+row clamps, gates, and masks against its own fill level. That is the shape
+continuous batching needs — a serving engine's decode slots all sit at
+different sequence lengths, and one fixed-shape kernel call covers them
+(`serving/engine.py` gathers each slot's pages and hands the per-slot
+lengths straight in).
+
 Layout: the cache is BSHD (`[B, L, Hkv, D]`) and the kernel blocks over L
 only, keeping each row's full `Hkv x D` contiguous — the same access pattern
 the dense einsum path achieves roofline with. Grouped-query heads are
@@ -83,7 +90,7 @@ def _decode_kernel(
         m[...] = jnp.full_like(m, NEG_INF)
         l[...] = jnp.zeros_like(l)
 
-    index = idx_ref[0]
+    index = idx_ref[pl.program_id(0)]  # this row's fill level
     n_valid = (index + block) // block  # blocks with >= 1 filled row
     run = j < n_valid
     if window is not None:
@@ -165,6 +172,10 @@ def flash_decode(
     only); returns ``[B, 1, H, D]``. Caller guarantees ``L % block == 0``
     (see :func:`decode_block_fits`).
 
+    ``index`` may be a scalar (every row at the same fill — the single-
+    sequence CLI path) or ``[B]`` (per-row fills — continuous-batching
+    slots); HBM traffic stays O(own index) per row either way.
+
     ``k_scale``/``v_scale`` (``[B, L, Hkv]`` f32, from :func:`quantize_kv`)
     switch the buffers to int8: the kernel reads half the cache bytes per
     step — the batched-decode term §10's roofline says batching can't
@@ -184,15 +195,23 @@ def flash_decode(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n_blocks = length // block
+    index = jnp.asarray(index, jnp.int32)
+    if index.ndim == 0:
+        index = jnp.broadcast_to(index[None], (batch,))
+    elif index.shape != (batch,):
+        raise ValueError(
+            f"index must be a scalar or [{batch}] (one fill level per row), "
+            f"got shape {index.shape}"
+        )
 
     def q_map(b, j, idx_ref):
         del idx_ref, j
         return (b, 0, 0, 0)
 
     def kv_map(b, j, idx_ref):
-        # Index maps receive the prefetched scalar AFTER the grid indices,
-        # as a (1,)-shaped ref.
-        idx = idx_ref[0]
+        # Index maps receive the prefetched scalars AFTER the grid indices,
+        # as a ([B],)-shaped ref: row b clamps against its own fill level.
+        idx = idx_ref[b]
         n_valid = (idx + block) // block
         # Clamp both ends: steps past the prefix revisit the last filled
         # block, pre-window steps the window's first block — Mosaic skips
@@ -250,7 +269,7 @@ def flash_decode(
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             interpret=interpret,
-        )(jnp.asarray(index, jnp.int32).reshape(1), *operands)
+        )(index, *operands)
 
 
 #: Smallest block the kernel accepts: below this the grid degenerates into

@@ -1,0 +1,30 @@
+"""Continuous-batching LM serving: paged KV cache, scheduler, jitted engine.
+
+Entry points: :class:`~deeplearning_mpi_tpu.serving.engine.ServingEngine`
+(submit/step/run_until_idle), configured by
+:class:`~deeplearning_mpi_tpu.serving.engine.EngineConfig`; the CLI driver
+is ``deeplearning_mpi_tpu.cli.serve_lm``. Design doc: ``docs/SERVING.md``.
+"""
+
+from deeplearning_mpi_tpu.serving.engine import EngineConfig, ServingEngine
+from deeplearning_mpi_tpu.serving.kv_pool import (
+    SCRATCH_BLOCK,
+    PagedKVPool,
+    init_kv_buffers,
+)
+from deeplearning_mpi_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = [
+    "EngineConfig",
+    "PagedKVPool",
+    "Request",
+    "RequestState",
+    "SCRATCH_BLOCK",
+    "Scheduler",
+    "ServingEngine",
+    "init_kv_buffers",
+]

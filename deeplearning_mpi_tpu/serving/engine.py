@@ -1,0 +1,496 @@
+"""Serving engine: one fixed-shape jitted step, host-swapped sequences.
+
+The offline path (``models/generate``) compiles one program per batch whose
+cache is sized ``prompt + max_new`` and whose rows march in lockstep. A
+serving engine inverts every one of those assumptions: requests arrive and
+finish independently, so the engine compiles TWO programs once — a batched
+decode step over ``max_slots`` rows and a per-slot prefill chunk — and a
+host-side loop swaps finished sequences out of slots between steps. Every
+jitted shape is static (slot count, gathered KV length, chunk width), so
+admission, completion, and eviction never trigger recompilation; the only
+thing that changes step to step is the *contents* of the slot-indexed
+arrays (block tables, fill levels, last tokens, active mask).
+
+Layer map (see ``docs/SERVING.md`` for the full walkthrough):
+
+- :mod:`~deeplearning_mpi_tpu.serving.kv_pool` owns block accounting and
+  the ``[num_layers, num_blocks, block_size, Hkv, D]`` device pools;
+- :mod:`~deeplearning_mpi_tpu.serving.scheduler` owns policy (admission,
+  deadlines, oldest-first eviction under KV pressure);
+- this module owns compute: the decode step scatters each slot's new K/V
+  through its block table (inactive slots write to the scratch block),
+  gathers each slot's pages back into a ``[S, L, Hkv, D]`` view, and runs
+  :func:`~deeplearning_mpi_tpu.ops.attention.batched_decode_attention` —
+  the per-row-fill-level twin of the CLI's decode schedule, kernel-
+  dispatchable to ``ops.pallas.flash_decode`` (which takes the ``[B]``
+  index vector natively). Prefill is chunked: each PREFILL slot advances
+  one ``prefill_chunk``-wide causal forward per engine step
+  (``dense_attention`` with ``q_offset`` over the gathered pages), so a
+  long prompt cannot stall decode for every other slot — the continuous-
+  batching half of chunked prefill.
+
+The forward mirrors ``models.transformer.TransformerLM`` numerics exactly
+(dtype-cast matmuls on f32 params, f32 norm/softmax accumulation, tied or
+untied head) but runs over the raw param tree rather than a flax apply:
+the flax ``Attention`` cache carries ONE scalar ``cache_index`` for the
+whole batch — the lockstep assumption this engine exists to break — so the
+cached-attention module cannot express per-slot fill levels. Parity with
+the offline path is pinned by ``tests/test_serving.py`` (greedy outputs
+identical per request).
+
+Greedy-only, dense models only: MoE routing makes a token's output depend
+on which OTHER tokens share its batch (capacity contention), which would
+break the engine's request-independence contract — co-batched strangers
+must never change your completion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_mpi_tpu.models.transformer import (
+    TransformerConfig,
+    apply_rope,
+)
+from deeplearning_mpi_tpu.ops.attention import (
+    batched_decode_attention,
+    dense_attention,
+    repeat_kv,
+)
+from deeplearning_mpi_tpu.serving.kv_pool import (
+    SCRATCH_BLOCK,
+    PagedKVPool,
+    init_kv_buffers,
+)
+from deeplearning_mpi_tpu.serving.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = ["EngineConfig", "ServingEngine"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Static shape/policy knobs — all of them baked into the two compiled
+    programs, none of them changeable without a (deliberate) recompile."""
+
+    #: decode rows per jitted step; also the number of concurrent sequences
+    max_slots: int = 4
+    #: token positions per KV block
+    block_size: int = 16
+    #: pool blocks per layer, scratch block included
+    num_blocks: int = 64
+    #: block-table width = admission ceiling: a sequence may span at most
+    #: ``max_blocks_per_seq * block_size`` positions (prompt + generation)
+    max_blocks_per_seq: int = 8
+    #: prompt positions prefilled per slot per engine step
+    prefill_chunk: int = 16
+    #: bounded request queue (admission control)
+    max_queue: int = 64
+    #: dispatch batched decode attention to the Pallas flash_decode kernel
+    #: (which consumes the per-row index vector natively); False = the
+    #: dense einsum schedule
+    use_kernel: bool = False
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+
+class ServingEngine:
+    """Continuous-batching engine over a ``TransformerLM`` param tree.
+
+    ``clock`` is injectable (tests drive a fake one); ``registry`` is an
+    optional ``telemetry.MetricsRegistry`` the engine keeps live serving
+    instruments in (queue depth, slot occupancy, KV blocks in use, shed
+    count, TTFT/TPOT histograms).
+    """
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        params: Any,
+        engine: EngineConfig | None = None,
+        *,
+        dtype: Any = jnp.bfloat16,
+        eos_id: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        registry: Any = None,
+    ) -> None:
+        engine = engine or EngineConfig()
+        if config.moe_experts > 0:
+            raise NotImplementedError(
+                "serving engine is dense-MLP only: MoE capacity routing "
+                "makes a token's output depend on co-batched strangers, "
+                "which breaks the engine's request-independence contract"
+            )
+        if "kernel" not in params["layer_0"]["attn"]["q_proj"]:
+            raise NotImplementedError(
+                "serving engine takes the raw f32 param tree (quantized "
+                "trees from ops.quant are not supported)"
+            )
+        if engine.num_blocks - 1 < engine.max_blocks_per_seq:
+            raise ValueError(
+                f"pool capacity ({engine.num_blocks - 1} blocks) below "
+                f"max_blocks_per_seq ({engine.max_blocks_per_seq}): a "
+                "maximum-length request could never be admitted"
+            )
+        self.config = config
+        self.engine = engine
+        self.params = params
+        self.dtype = dtype
+        self.eos_id = eos_id
+        self._clock = clock
+        self.pool = PagedKVPool(engine.num_blocks, engine.block_size)
+        self.scheduler = Scheduler(
+            self.pool,
+            max_slots=engine.max_slots,
+            max_seq_len=engine.max_seq_len,
+            max_queue=engine.max_queue,
+        )
+        self._k, self._v = init_kv_buffers(
+            config.num_layers, engine.num_blocks, engine.block_size,
+            config.num_kv_heads or config.num_heads, config.head_dim, dtype,
+        )
+        self._next_rid = 0
+        self.steps = 0
+        self._metrics = registry
+        if registry is not None:
+            for name in (
+                "serve_requests_submitted", "serve_requests_admitted",
+                "serve_requests_completed", "serve_requests_shed",
+                "serve_tokens_generated", "serve_prefill_chunks",
+                "serve_decode_steps",
+            ):
+                registry.counter(name)
+            for name in (
+                "serve_queue_depth", "serve_slots_active",
+                "serve_kv_blocks_in_use",
+            ):
+                registry.gauge(name)
+            registry.histogram("serve_ttft_s")
+            registry.histogram("serve_tpot_s")
+        self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(self._prefill_chunk, donate_argnums=(1, 2))
+
+    # -- public API ---------------------------------------------------------
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline: Optional[float] = None,
+    ) -> Request:
+        """Enqueue one request (or shed it at the door — check
+        ``req.state``). ``prompt`` is a 1-D int sequence."""
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        req = Request(
+            rid=self._next_rid,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            arrival=self._clock(),
+            deadline=deadline,
+        )
+        self._next_rid += 1
+        self._inc("serve_requests_submitted")
+        if not self.scheduler.submit(req):
+            self._inc("serve_requests_shed")
+        return req
+
+    def step(self) -> list[Request]:
+        """One engine iteration: shed expired → admit → one prefill chunk
+        per PREFILL slot → grow/evict for KV pressure → one batched decode
+        step → retire finished sequences. Returns the requests that
+        FINISHED this step (their freed blocks are already back in the
+        pool, ready for the next admission)."""
+        now = self._clock()
+        finished: list[Request] = []
+        for _ in self.scheduler.shed_expired(now):
+            self._inc("serve_requests_shed")
+        admitted = self.scheduler.admit(now)
+        self._inc("serve_requests_admitted", len(admitted))
+
+        for req in list(self.scheduler.running()):
+            if req.state is RequestState.PREFILL:
+                self._prefill_one(req, finished)
+
+        # Feeding a token at position length-1 writes its K/V there, so a
+        # slot needs blocks_for(length) blocks BEFORE the step; growth is
+        # where OOM pressure surfaces and the scheduler may evict.
+        for req in list(self.scheduler.running()):
+            if req.state is not RequestState.DECODE:
+                continue
+            while len(req.blocks) < self.pool.blocks_for(req.length):
+                if not self.scheduler.grow(req):
+                    self._inc("serve_requests_shed")
+                    break
+        # grow() may have evicted requests from the snapshot above.
+        decoding = [
+            r for r in self.scheduler.running()
+            if r.state is RequestState.DECODE
+        ]
+        if decoding:
+            e = self.engine
+            tables = np.zeros((e.max_slots, e.max_blocks_per_seq), np.int32)
+            lengths = np.zeros((e.max_slots,), np.int32)
+            tokens = np.zeros((e.max_slots,), np.int32)
+            active = np.zeros((e.max_slots,), bool)
+            for req in decoding:
+                s = req.slot
+                tables[s, : len(req.blocks)] = req.blocks
+                lengths[s] = req.length
+                tokens[s] = req.generated[-1]
+                active[s] = True
+            self._k, self._v, next_tok = self._decode_fn(
+                self.params, self._k, self._v,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(tokens), jnp.asarray(active),
+            )
+            self._inc("serve_decode_steps")
+            next_np = np.asarray(jax.device_get(next_tok))
+            now = self._clock()
+            for req in decoding:
+                tok = int(next_np[req.slot])
+                req.generated.append(tok)
+                self._inc("serve_tokens_generated")
+                if self._done(req, tok):
+                    self._finish(req, now, finished)
+        self.steps += 1
+        self._set_gauges()
+        return finished
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
+        """Step until queue and slots drain; returns everything finished."""
+        finished: list[Request] = []
+        steps = 0
+        while not self.scheduler.idle():
+            finished.extend(self.step())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps"
+                )
+        return finished
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_one(self, req: Request, finished: list[Request]) -> None:
+        e = self.engine
+        start = req.prefilled
+        n_valid = min(e.prefill_chunk, req.prompt_len - start)
+        chunk = np.zeros((e.prefill_chunk,), np.int32)
+        chunk[:n_valid] = req.prompt[start : start + n_valid]
+        table = np.zeros((e.max_blocks_per_seq,), np.int32)
+        table[: len(req.blocks)] = req.blocks
+        self._k, self._v, last_logits = self._prefill_fn(
+            self.params, self._k, self._v,
+            jnp.asarray(table), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(n_valid),
+        )
+        self._inc("serve_prefill_chunks")
+        req.prefilled += n_valid
+        if req.prefilled < req.prompt_len:
+            return
+        # Prompt fully ingested: the first generated token comes straight
+        # from the prefill's last-position logits (same seed-step split as
+        # models.generate.first_token).
+        tok = int(jax.device_get(jnp.argmax(last_logits)))
+        req.state = RequestState.DECODE
+        req.generated.append(tok)
+        req.t_first_token = self._clock()
+        self._inc("serve_tokens_generated")
+        if self._metrics is not None and req.ttft is not None:
+            self._metrics.histogram("serve_ttft_s").observe(req.ttft)
+        if self._done(req, tok):
+            self._finish(req, req.t_first_token, finished)
+
+    # -- retirement ---------------------------------------------------------
+    def _done(self, req: Request, tok: int) -> bool:
+        if self.eos_id is not None and tok == self.eos_id:
+            return True
+        return len(req.generated) >= req.max_new_tokens
+
+    def _finish(self, req: Request, now: float, finished: list[Request]) -> None:
+        self.scheduler.finish(req, now)
+        finished.append(req)
+        self._inc("serve_requests_completed")
+        if self._metrics is not None and req.tpot is not None:
+            self._metrics.histogram("serve_tpot_s").observe(req.tpot)
+
+    # -- telemetry ----------------------------------------------------------
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        if self._metrics is not None and amount:
+            self._metrics.counter(name).inc(amount)
+
+    def _set_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.gauge("serve_queue_depth").set(
+            self.scheduler.queue_depth()
+        )
+        self._metrics.gauge("serve_slots_active").set(
+            self.scheduler.slots_active()
+        )
+        self._metrics.gauge("serve_kv_blocks_in_use").set(self.pool.in_use)
+
+    # -- forward building blocks (mirror TransformerLM numerics) ------------
+    def _lin(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
+        # flax nn.Dense(use_bias=False, dtype=d): both operands cast to the
+        # compute dtype, f32 params untouched in the tree.
+        return x.astype(self.dtype) @ kernel.astype(self.dtype)
+
+    def _rmsnorm(self, x: jax.Array, scale: jax.Array) -> jax.Array:
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6
+        )
+        return (normed * scale).astype(x.dtype)
+
+    def _logits(self, x: jax.Array, params: Any) -> jax.Array:
+        emb = params["embed"]["embedding"]
+        if self.config.tied_embeddings:
+            return (
+                x.astype(self.dtype) @ emb.astype(self.dtype).T
+            ).astype(jnp.float32)
+        return self._lin(x, params["lm_head"]["kernel"]).astype(jnp.float32)
+
+    def _attn_proj(
+        self, lp: Any, h: jax.Array, pos: jax.Array
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        cfg = self.config
+        rows, seq = h.shape[0], h.shape[1]
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        q = self._lin(h, lp["attn"]["q_proj"]["kernel"]).reshape(
+            rows, seq, cfg.num_heads, cfg.head_dim
+        )
+        k = self._lin(h, lp["attn"]["k_proj"]["kernel"]).reshape(
+            rows, seq, kv_heads, cfg.head_dim
+        )
+        v = self._lin(h, lp["attn"]["v_proj"]["kernel"]).reshape(
+            rows, seq, kv_heads, cfg.head_dim
+        )
+        return apply_rope(q, pos), apply_rope(k, pos), v
+
+    def _mlp(self, lp: Any, x: jax.Array) -> jax.Array:
+        h = self._rmsnorm(x, lp["mlp_norm"]["scale"])
+        hidden = jax.nn.silu(
+            self._lin(h, lp["mlp"]["gate_proj"]["kernel"])
+        ) * self._lin(h, lp["mlp"]["up_proj"]["kernel"])
+        return x + self._lin(hidden, lp["mlp"]["down_proj"]["kernel"])
+
+    # -- jitted decode step --------------------------------------------------
+    def _decode_step(
+        self,
+        params: Any,
+        k_pool: jax.Array,
+        v_pool: jax.Array,
+        tables: jax.Array,   # [S, MB] int32 block ids (0-padded)
+        lengths: jax.Array,  # [S] int32 known tokens (prompt + generated)
+        tokens: jax.Array,   # [S] int32 token fed this step (position len-1)
+        active: jax.Array,   # [S] bool
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        cfg, e = self.config, self.engine
+        S, MB, BS = e.max_slots, e.max_blocks_per_seq, e.block_size
+        L = MB * BS
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        emb = params["embed"]["embedding"]
+        x = emb.astype(self.dtype)[tokens][:, None, :]  # [S, 1, d]
+        pos = jnp.maximum(lengths - 1, 0)[:, None]  # [S, 1] absolute
+        p = pos[:, 0]
+        # Inactive slots route their (garbage) writes to the scratch block.
+        bid = jnp.where(
+            active,
+            tables[jnp.arange(S), jnp.minimum(p // BS, MB - 1)],
+            SCRATCH_BLOCK,
+        )
+        off = p % BS
+        # Row b attends its own filled prefix 0..lengths[b]-1; negative
+        # marks the row inactive (zero output).
+        idx = jnp.where(active, lengths - 1, -1)
+        window = cfg.attention_window or None
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            h = self._rmsnorm(x, lp["attn_norm"]["scale"])
+            q, k, v = self._attn_proj(lp, h, pos)
+            k_pool = k_pool.at[i, bid, off].set(k[:, 0])
+            v_pool = v_pool.at[i, bid, off].set(v[:, 0])
+            # Gather each slot's pages back into position order: the block
+            # table IS the logical->physical map, so indexing the pool with
+            # it yields a contiguous [S, L] view of every sequence.
+            k_seq = k_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim)
+            v_seq = v_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim)
+            ctx = batched_decode_attention(
+                q, k_seq, v_seq, idx, window=window,
+                use_kernel=e.use_kernel,
+            )
+            x = x + self._lin(
+                ctx.reshape(S, 1, cfg.num_heads * cfg.head_dim),
+                lp["attn"]["out_proj"]["kernel"],
+            )
+            x = self._mlp(lp, x)
+        x = self._rmsnorm(x, params["final_norm"]["scale"])
+        logits = self._logits(x[:, 0], params)  # [S, V] f32
+        return k_pool, v_pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # -- jitted prefill chunk ------------------------------------------------
+    def _prefill_chunk(
+        self,
+        params: Any,
+        k_pool: jax.Array,
+        v_pool: jax.Array,
+        table: jax.Array,   # [MB] int32 this slot's block table (0-padded)
+        tokens: jax.Array,  # [C] int32 prompt chunk (0-padded past n_valid)
+        start: jax.Array,   # scalar int32: absolute position of tokens[0]
+        n_valid: jax.Array,  # scalar int32: real rows in the chunk
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        cfg, e = self.config, self.engine
+        MB, BS, C = e.max_blocks_per_seq, e.block_size, e.prefill_chunk
+        L = MB * BS
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        rep = cfg.num_heads // kv_heads
+        emb = params["embed"]["embedding"]
+        x = emb.astype(self.dtype)[tokens][None]  # [1, C, d]
+        offs = jnp.arange(C, dtype=jnp.int32)
+        pos = (start + offs)[None]  # [1, C] absolute
+        p = jnp.minimum(start + offs, L - 1)
+        bid = jnp.where(offs < n_valid, table[p // BS], SCRATCH_BLOCK)
+        off = p % BS
+        window = cfg.attention_window or None
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            h = self._rmsnorm(x, lp["attn_norm"]["scale"])
+            q, k, v = self._attn_proj(lp, h, pos)
+            k_pool = k_pool.at[i, bid, off].set(k[0])
+            v_pool = v_pool.at[i, bid, off].set(v[0])
+            k_seq = k_pool[i][table].reshape(1, L, kv_heads, cfg.head_dim)
+            v_seq = v_pool[i][table].reshape(1, L, kv_heads, cfg.head_dim)
+            # The chunk's queries see every earlier chunk's pages PLUS this
+            # chunk's own rows (just scattered above); causal masking in
+            # absolute coordinates via q_offset. Stale rows from a previous
+            # owner of a recycled block sit at positions strictly after the
+            # last valid query and are causally masked.
+            ctx = dense_attention(
+                q, repeat_kv(k_seq, rep), repeat_kv(v_seq, rep),
+                causal=True, window=window, q_offset=start,
+            )
+            x = x + self._lin(
+                ctx.reshape(1, C, cfg.num_heads * cfg.head_dim),
+                lp["attn"]["out_proj"]["kernel"],
+            )
+            x = self._mlp(lp, x)
+        x = self._rmsnorm(x, params["final_norm"]["scale"])
+        # Only the last VALID row's logits matter (and only on the final
+        # chunk — the host ignores them otherwise). Padded rows compute
+        # garbage that is never read and whose K/V went to scratch.
+        x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        return k_pool, v_pool, self._logits(x_last[0, 0], params)

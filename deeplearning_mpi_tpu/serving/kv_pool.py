@@ -1,0 +1,150 @@
+"""Paged KV-cache pool: fixed-size blocks, free-list allocation, block tables.
+
+The offline decode path (``models/generate``) gives every sequence a
+contiguous ``[B, prompt + max_new, Hkv, D]`` cache buffer — the right shape
+when one jitted program owns the whole batch from prompt to EOS. A serving
+engine can't afford that: sequences arrive and finish at different times,
+their lengths differ by orders of magnitude, and a contiguous per-sequence
+buffer sized for the worst case strands most of its HBM as internal
+fragmentation. The paged design (vLLM's PagedAttention, PAPERS: Gemma-on-TPU
+serving) fixes the unit of allocation instead: ONE preallocated device pool
+of ``num_blocks`` fixed-size blocks per layer, a host-side free list, and a
+per-sequence *block table* mapping logical positions to pool blocks. A
+sequence holds exactly ``ceil(len / block_size)`` blocks at any moment, and
+a finished sequence's blocks return to the free list for the next admission
+— the fragmentation bound is one partial block per live sequence.
+
+Split of responsibilities:
+
+- **This module is host-side accounting only** — pure Python, no device
+  work, deterministic, and therefore exhaustively testable
+  (``tests/test_serving.py`` drives alloc/free storms and checks the
+  invariants below).
+- The device buffers (``[num_layers, num_blocks, block_size, Hkv, D]`` for
+  K and V) are created by :func:`init_kv_buffers` and owned by the engine,
+  which scatters/gathers through the block tables inside its jitted step
+  (``serving/engine.py``).
+
+Block 0 is a reserved **scratch block**, never allocated: the engine's
+fixed-shape step always writes *somewhere*, and inactive slots / padded
+prefill rows route their writes to block 0 so they can't corrupt a live
+sequence's pages.
+
+Invariants (checked by :meth:`PagedKVPool.check`):
+
+- free + in-use = ``num_blocks - 1`` (scratch excluded), always;
+- no block is simultaneously free and allocated, or allocated twice;
+- allocation is all-or-nothing: a request that can't get every block it
+  asked for gets none (no partial reservations to leak under load).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+__all__ = ["PagedKVPool", "SCRATCH_BLOCK", "init_kv_buffers"]
+
+#: Block id reserved for writes that must land nowhere (inactive slots,
+#: prefill padding rows). Never on the free list.
+SCRATCH_BLOCK = 0
+
+
+class PagedKVPool:
+    """Free-list allocator over ``num_blocks`` KV blocks of ``block_size``
+    token positions each. Host-side accounting only; see the module
+    docstring for the device-buffer half."""
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 scratch + 1 usable), got {num_blocks}"
+            )
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # Descending so pop() hands out the lowest id first — deterministic
+        # allocation order, which the tests (and debugging) rely on.
+        self._free: list[int] = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+        self._used: set[int] = set()
+        # Monotonic counters for telemetry / the reuse-proving tests.
+        self.total_allocated = 0
+        self.total_freed = 0
+
+    # -- capacity queries ---------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._used)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` positions."""
+        return -(-num_tokens // self.block_size)
+
+    # -- alloc / free -------------------------------------------------------
+    def alloc(self, n: int) -> list[int] | None:
+        """Take ``n`` blocks off the free list, or ``None`` if fewer than
+        ``n`` are free (all-or-nothing — no partial reservation)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._used.update(blocks)
+        self.total_allocated += n
+        return blocks
+
+    def free(self, blocks: Iterable[int]) -> None:
+        """Return blocks to the free list. Freeing a block that is not
+        allocated (double-free, scratch, out of range) is a caller bug and
+        raises — silent tolerance here would mask exactly the accounting
+        errors this class exists to prevent."""
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(f"freeing block {b} that is not allocated")
+            self._used.remove(b)
+            self._free.append(b)
+            self.total_freed += 1
+
+    # -- invariants ---------------------------------------------------------
+    def check(self) -> None:
+        """Raise AssertionError if any pool invariant is violated."""
+        free = set(self._free)
+        assert len(free) == len(self._free), "duplicate ids on the free list"
+        assert not (free & self._used), "block both free and allocated"
+        assert SCRATCH_BLOCK not in free and SCRATCH_BLOCK not in self._used, (
+            "scratch block entered circulation"
+        )
+        assert len(free) + len(self._used) == self.capacity, (
+            f"leak: {len(free)} free + {len(self._used)} used "
+            f"!= {self.capacity}"
+        )
+
+
+def init_kv_buffers(
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    kv_heads: int,
+    head_dim: int,
+    dtype: Any,
+) -> tuple[Any, Any]:
+    """Zero-initialized device pools: ``(k, v)``, each
+    ``[num_layers, num_blocks, block_size, kv_heads, head_dim]``.
+
+    One array per K/V (not per layer) so the jitted engine step threads two
+    buffers instead of ``2 * num_layers`` — the layer axis is indexed
+    statically inside the step's Python layer loop.
+    """
+    import jax.numpy as jnp
+
+    shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)

@@ -1,0 +1,247 @@
+"""Continuous-batching scheduler: admission, deadlines, eviction.
+
+The batching model the offline CLI uses — collect a batch, run it to
+completion, collect the next — leaves decode slots idle from the moment
+their sequence finishes until the whole batch drains (the straggler tax
+grows with batch size and output-length variance). Continuous batching
+(Orca-style iteration-level scheduling; the Podracer paper's same
+decoupling for RL actors) refills each slot the moment it frees: the
+engine's jitted step has a FIXED shape (``max_slots`` rows), and this
+scheduler decides, between steps, which request occupies which row.
+
+Policies (deliberately simple, deterministic, and host-side — every one of
+them is exercised by ``tests/test_serving.py`` under a fake clock):
+
+- **Bounded queue**: ``submit`` on a full queue sheds the request
+  immediately (backpressure at the door beats unbounded memory growth —
+  the load-shedding half of admission control).
+- **Length admission**: a request whose ``prompt + max_new_tokens`` cannot
+  fit a slot's block budget (``max_seq_len``) is rejected at submit; it
+  could never complete, so admitting it would only waste KV blocks.
+- **Deadlines**: an optional per-request deadline (absolute, same clock as
+  the engine's); queued requests past it are shed at the next step —
+  serving a reply the client stopped waiting for is pure waste.
+- **FCFS admission**: queued requests enter free slots in arrival order,
+  each taking its prompt's KV blocks up front (all-or-nothing, so a
+  half-admitted request can't deadlock the pool).
+- **Oldest-first eviction on OOM pressure**: when a decoding sequence
+  needs one more KV block and the pool is empty, the OLDEST running
+  request is shed and its blocks reclaimed. Oldest-first is the
+  deterministic, starvation-free choice here: the engine frees the
+  largest allocation (oldest ≈ longest), and a fresh request can't be
+  starved forever by an earlier long-runner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from deeplearning_mpi_tpu.serving.kv_pool import PagedKVPool
+
+__all__ = ["Request", "RequestState", "Scheduler"]
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+    #: Shed by admission control (queue full / too long / deadline) or
+    #: evicted under OOM pressure; ``generated`` holds any partial output.
+    SHED = "shed"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its full lifecycle record."""
+
+    rid: int
+    prompt: np.ndarray  # 1-D int32 token ids
+    max_new_tokens: int
+    arrival: float = 0.0
+    deadline: Optional[float] = None  # absolute time; None = no deadline
+
+    state: RequestState = RequestState.QUEUED
+    #: why a SHED request was shed: "queue_full" | "too_long" | "deadline"
+    #: | "evicted"
+    shed_reason: Optional[str] = None
+    slot: Optional[int] = None
+    blocks: list[int] = dataclasses.field(default_factory=list)
+    #: tokens generated so far (the first comes from the prefill logits)
+    generated: list[int] = dataclasses.field(default_factory=list)
+    #: prompt positions prefilled so far (chunk cursor)
+    prefilled: int = 0
+
+    t_admitted: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_finished: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def length(self) -> int:
+        """Known tokens: prompt + generated."""
+        return self.prompt_len + len(self.generated)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token (arrival -> first generated token)."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.arrival
+
+    @property
+    def tpot(self) -> Optional[float]:
+        """Time per output token over the decode phase (first token
+        excluded — it belongs to prefill/TTFT)."""
+        if self.t_finished is None or self.t_first_token is None:
+            return None
+        steps = max(len(self.generated) - 1, 1)
+        return (self.t_finished - self.t_first_token) / steps
+
+
+class Scheduler:
+    """Slot + queue bookkeeping between engine steps (host-side, no device
+    work). The engine calls, in step order: :meth:`shed_expired`,
+    :meth:`admit`, :meth:`grow` (per decoding slot), :meth:`finish`."""
+
+    def __init__(
+        self,
+        pool: PagedKVPool,
+        *,
+        max_slots: int,
+        max_seq_len: int,
+        max_queue: int = 64,
+    ) -> None:
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.pool = pool
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.max_queue = max_queue
+        self.queue: deque[Request] = deque()
+        self.slots: list[Optional[Request]] = [None] * max_slots
+        self.shed_count = 0
+        self.evicted_count = 0
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, req: Request) -> bool:
+        """Admit to the queue, or shed immediately (returns False)."""
+        total = req.prompt_len + req.max_new_tokens
+        if total > self.max_seq_len:
+            self._shed(req, "too_long")
+            return False
+        if len(self.queue) >= self.max_queue:
+            self._shed(req, "queue_full")
+            return False
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return True
+
+    # -- per-step phases ----------------------------------------------------
+    def shed_expired(self, now: float) -> list[Request]:
+        """Drop queued requests whose deadline has passed."""
+        kept: deque[Request] = deque()
+        shed = []
+        for req in self.queue:
+            if req.deadline is not None and now > req.deadline:
+                self._shed(req, "deadline")
+                shed.append(req)
+            else:
+                kept.append(req)
+        self.queue = kept
+        return shed
+
+    def admit(self, now: float) -> list[Request]:
+        """Move queued requests into free slots, oldest first, each taking
+        its prompt's KV blocks up front. Stops at the first request the
+        pool can't serve (FCFS — skipping ahead would starve long
+        prompts)."""
+        admitted = []
+        while self.queue and None in self.slots:
+            req = self.queue[0]
+            blocks = self.pool.alloc(self.pool.blocks_for(req.prompt_len))
+            if blocks is None:
+                break  # KV pressure: stays queued, retried next step
+            self.queue.popleft()
+            slot = self.slots.index(None)
+            req.slot = slot
+            req.blocks = blocks
+            req.state = RequestState.PREFILL
+            req.prefilled = 0
+            req.t_admitted = now
+            self.slots[slot] = req
+            admitted.append(req)
+        return admitted
+
+    def grow(self, req: Request) -> bool:
+        """Give ``req`` one more KV block, evicting under OOM pressure.
+
+        Returns False iff ``req`` itself was shed (it was the oldest, or
+        eviction could not free a block) — the caller must drop it from
+        the step.
+        """
+        while True:
+            blocks = self.pool.alloc(1)
+            if blocks is not None:
+                req.blocks.extend(blocks)
+                return True
+            victim = self._oldest_running()
+            if victim is None or victim is req:
+                # Nothing older to evict: shed the requester. (victim is
+                # req covers the pathological one-slot pool-exhausted
+                # case — self-eviction, not an infinite loop.)
+                self.evict(req)
+                return False
+            self.evict(victim)
+
+    def evict(self, req: Request) -> None:
+        """Shed a RUNNING request and reclaim its blocks."""
+        self._release(req)
+        self._shed(req, "evicted")
+        self.evicted_count += 1
+
+    def finish(self, req: Request, now: float) -> None:
+        req.t_finished = now
+        req.state = RequestState.FINISHED
+        self._release(req)
+
+    # -- queries ------------------------------------------------------------
+    def running(self) -> list[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def slots_active(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def idle(self) -> bool:
+        return not self.queue and not any(self.slots)
+
+    # -- internals ----------------------------------------------------------
+    def _oldest_running(self) -> Optional[Request]:
+        running = self.running()
+        return min(running, key=lambda r: r.arrival) if running else None
+
+    def _release(self, req: Request) -> None:
+        if req.blocks:
+            self.pool.free(req.blocks)
+            # Keep the ids for post-mortem (which blocks did this request
+            # hold?) — the reuse-proving test reads them — but hand
+            # ownership back: a stale list must not be freeable twice.
+            req.blocks = list(req.blocks)
+        if req.slot is not None:
+            self.slots[req.slot] = None
+
+    def _shed(self, req: Request, reason: str) -> None:
+        req.state = RequestState.SHED
+        req.shed_reason = reason
+        self.shed_count += 1

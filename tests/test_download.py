@@ -127,6 +127,85 @@ class TestFromFile:
             ])
 
 
+class TestNoFilterFallback:
+    """The pre-filter-API extractall fallback must allowlist member types:
+    only regular files and directories extract (a FIFO blocks the next
+    directory read; a device node is worse; links redirect later writes)."""
+
+    @pytest.fixture
+    def no_filter_api(self, monkeypatch):
+        """Force the TypeError fallback path regardless of the running
+        python's tarfile version."""
+        import tarfile
+
+        orig = tarfile.TarFile.extractall
+
+        def fake(self, *args, **kwargs):
+            if "filter" in kwargs:
+                raise TypeError(
+                    "extractall() got an unexpected keyword argument 'filter'"
+                )
+            return orig(self, *args, **kwargs)
+
+        monkeypatch.setattr(tarfile.TarFile, "extractall", fake)
+
+    @staticmethod
+    def _tarball_with(tmp_path, special):
+        import io
+        import tarfile
+
+        tarball = tmp_path / "evil.tar.gz"
+        with tarfile.open(tarball, "w:gz") as tar:
+            ti = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+            ti.size = 1
+            tar.addfile(ti, io.BytesIO(b"x"))
+            tar.addfile(special)
+        return tarball
+
+    def _special(self, kind):
+        import tarfile
+
+        ti = tarfile.TarInfo(f"cifar-10-batches-py/{kind}")
+        if kind == "fifo":
+            ti.type = tarfile.FIFOTYPE
+        elif kind == "chardev":
+            ti.type = tarfile.CHRTYPE
+            ti.devmajor, ti.devminor = 1, 3  # /dev/null's numbers
+        elif kind == "blockdev":
+            ti.type = tarfile.BLKTYPE
+            ti.devmajor, ti.devminor = 8, 0
+        elif kind == "symlink":
+            ti.type = tarfile.SYMTYPE
+            ti.linkname = "/etc/passwd"
+        elif kind == "hardlink":
+            ti.type = tarfile.LNKTYPE
+            ti.linkname = "../outside"
+        return ti
+
+    @pytest.mark.parametrize(
+        "kind", ["fifo", "chardev", "blockdev", "symlink", "hardlink"]
+    )
+    def test_fallback_rejects_non_regular_members(
+        self, tmp_path, capsys, no_filter_api, kind
+    ):
+        tarball = self._tarball_with(tmp_path, self._special(kind))
+        data_dir = tmp_path / "data"
+        rc = download.ingest_cifar10(tarball, data_dir, md5=None)
+        assert rc == 1
+        assert "unsafe tar members" in capsys.readouterr().err
+        # Refusal is all-or-nothing: nothing extracted, special member least
+        # of all.
+        assert not (data_dir / "cifar-10-batches-py" / kind).exists()
+
+    def test_fallback_extracts_regular_layout(self, tmp_path, no_filter_api):
+        """The allowlist must not over-reject: a normal files+dirs tarball
+        still ingests through the fallback."""
+        tarball = _mini_cifar_tarball(tmp_path)
+        rc = download.ingest_cifar10(tarball, tmp_path / "data", md5=None)
+        assert rc == 0
+        assert download.check_cifar10(tmp_path / "data")
+
+
 def _write_pair(root, stem, img_hw=(8, 8), mask_hw=None):
     img = np.zeros((*img_hw, 3), np.uint8)
     mask = np.zeros(mask_hw or img_hw, np.uint8)

@@ -68,6 +68,107 @@ class TestFlashDecodeKernel:
         )
 
 
+class TestPerRowIndex:
+    """[B]-shaped fill levels: the continuous-batching contract — every
+    row clamps, gates, and masks against its OWN index."""
+
+    def _ragged(self, idx, Hkv=2, L=64):
+        B = len(idx)
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(B, 1, 4, 16)).astype(np.float32))
+        mask = (np.arange(L)[None, :] <= np.asarray(idx)[:, None])[
+            :, :, None, None
+        ]
+        k = jnp.asarray(
+            (rng.normal(size=(B, L, Hkv, 16)) * mask).astype(np.float32)
+        )
+        v = jnp.asarray(
+            (rng.normal(size=(B, L, Hkv, 16)) * mask).astype(np.float32)
+        )
+        return q, k, v
+
+    @pytest.mark.parametrize("window", [None, 24])
+    def test_kernel_matches_per_row_walk(self, window):
+        """The kernel on an index VECTOR must equal running the scalar walk
+        row by row — rows at different fills share one fixed-shape call."""
+        idx = [0, 15, 37, 63]
+        q, k, v = self._ragged(idx)
+        ref = jnp.concatenate(
+            [
+                decode_attention(
+                    q[b : b + 1], k[b : b + 1], v[b : b + 1],
+                    jnp.int32(i), block=16, dense_max=0, use_kernel=False,
+                    window=window,
+                )
+                for b, i in enumerate(idx)
+            ],
+            axis=0,
+        )
+        out = flash_decode(
+            q, k, v, jnp.asarray(idx, jnp.int32), block=16, interpret=True,
+            window=window,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_batched_dense_matches_per_row_walk(self):
+        from deeplearning_mpi_tpu.ops.attention import (
+            batched_decode_attention,
+        )
+
+        idx = [5, 37, 63]
+        q, k, v = self._ragged(idx)
+        ref = jnp.concatenate(
+            [
+                decode_attention(
+                    q[b : b + 1], k[b : b + 1], v[b : b + 1],
+                    jnp.int32(i), block=16, dense_max=0, use_kernel=False,
+                )
+                for b, i in enumerate(idx)
+            ],
+            axis=0,
+        )
+        out = batched_decode_attention(q, k, v, jnp.asarray(idx, jnp.int32))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        kern = batched_decode_attention(
+            q, k, v, jnp.asarray(idx, jnp.int32), use_kernel=True, block=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(kern), np.asarray(ref), atol=2e-5
+        )
+
+    def test_inactive_row_outputs_zero(self):
+        """index < 0 marks an empty serving slot: its output must be zeros
+        (not a softmax-renormalized average of garbage V rows), and live
+        rows must be unaffected by its presence."""
+        from deeplearning_mpi_tpu.ops.attention import (
+            batched_decode_attention,
+        )
+
+        q, k, v = self._ragged([5, 37, 63])
+        full = batched_decode_attention(
+            q, k, v, jnp.asarray([5, 37, 63], jnp.int32)
+        )
+        mixed = batched_decode_attention(
+            q, k, v, jnp.asarray([5, -1, 63], jnp.int32)
+        )
+        assert np.all(np.asarray(mixed)[1] == 0.0)
+        np.testing.assert_array_equal(np.asarray(mixed)[0], np.asarray(full)[0])
+        np.testing.assert_array_equal(np.asarray(mixed)[2], np.asarray(full)[2])
+
+    def test_wrong_index_shape_rejected(self):
+        from deeplearning_mpi_tpu.ops.attention import (
+            batched_decode_attention,
+        )
+
+        q, k, v = self._ragged([5, 37])
+        with pytest.raises(ValueError, match="one fill level per row"):
+            batched_decode_attention(q, k, v, jnp.zeros((3,), jnp.int32))
+        with pytest.raises(ValueError, match="one fill level per row"):
+            flash_decode(
+                q, k, v, jnp.zeros((3,), jnp.int32), block=16, interpret=True
+            )
+
+
 class TestInt8KV:
     """int8 KV-cache variant: half the cache bytes, VMEM dequantization."""
 

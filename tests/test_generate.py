@@ -53,6 +53,17 @@ def _moe_dropfree_cfg():
     )
 
 
+def _moe_droppy_cfg():
+    # Deliberately TIGHT capacity: the batched full-prompt forward suffers
+    # expert contention across prompt positions (drops), which the
+    # per-position decode walk never sees. Prefill must route per position
+    # (stepwise) for these configs or the "execution-schedule change only"
+    # invariant of the fast path / shared_prefix / timed CLI breaks.
+    return dataclasses.replace(
+        TransformerConfig.tiny_moe(), moe_capacity_factor=0.5
+    )
+
+
 class TestCachedDecode:
     @pytest.mark.slow
     @pytest.mark.parametrize("make_cfg",
@@ -109,8 +120,10 @@ class TestCachedDecode:
 class TestPrefill:
     """Batched cache-fill forward vs the stepwise decode ground truth."""
 
-    @pytest.mark.parametrize("make_cfg", [_dense_cfg, _gqa_cfg, _windowed_cfg],
-                             ids=["dense", "gqa", "windowed"])
+    @pytest.mark.parametrize("make_cfg",
+                             [_dense_cfg, _gqa_cfg, _windowed_cfg,
+                              _moe_dropfree_cfg],
+                             ids=["dense", "gqa", "windowed", "moe"])
     def test_prefill_matches_stepwise_cache_and_logits(self, make_cfg):
         """One prefill forward must leave the cache in the same state as
         feeding the prompt token by token, and its logits must equal the
@@ -158,6 +171,78 @@ class TestPrefill:
             ),
             cache_pre, cache_step,
         )
+
+    def test_moe_prefill_routes_per_position(self):
+        """Under TIGHT expert capacity, prefill's cache and logits must
+        equal the token-by-token decode walk exactly — NOT the batched
+        training forward, whose whole-prompt routing drops tokens under
+        contention the walk never sees. (Before this route-per-position
+        fix, MoE prefill ran training routing, silently changing fast-path
+        generate, shared_prefix, beam seeding, and the CLI's timed split
+        vs the stepwise scan — ADVICE's schedule-invariance break.)"""
+        import dataclasses as dc
+
+        from deeplearning_mpi_tpu.models.generate import prefill
+
+        seq, total = 12, 16
+        model = TransformerLM(config=_moe_droppy_cfg(), dtype=jnp.float32)
+        tokens_init = jnp.zeros((2, total), jnp.int32)
+        params = model.init(jax.random.key(0), tokens_init)["params"]
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 256, (2, seq)), jnp.int32)
+
+        cache_pre, logits_pre = prefill(
+            model, params, tokens, total_len=total, last_logits_only=False
+        )
+        decode_model = dc.replace(model, decode=True)
+        cache_step = decode_model.init(jax.random.key(0), tokens_init)["cache"]
+        step_logits = []
+        for i in range(seq):
+            logits_i, mutated = decode_model.apply(
+                {"params": params, "cache": cache_step},
+                tokens[:, i : i + 1],
+                positions=jnp.full((2, 1), i, jnp.int32),
+                mutable=["cache"],
+            )
+            cache_step = mutated["cache"]
+            step_logits.append(np.asarray(logits_i[:, 0]))
+        np.testing.assert_allclose(
+            np.asarray(logits_pre), np.stack(step_logits, axis=1), atol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            cache_pre, cache_step,
+        )
+        # Precondition making this test meaningful: the batched training
+        # forward genuinely drops here (routing contention), so agreeing
+        # with the WALK is a real choice, not a vacuous one.
+        full_logits = model.apply({"params": params}, tokens)
+        assert not np.allclose(
+            np.asarray(full_logits), np.asarray(logits_pre), atol=1e-3
+        ), "droppy config produced no drops; tighten moe_capacity_factor"
+
+    def test_moe_fast_path_equals_uniform_scan(self):
+        """Greedy fast-path generate must stay byte-identical to the forced
+        uniform scan for a droppy MoE model — the invariant the stepwise
+        MoE prefill restores."""
+        model = TransformerLM(config=_moe_droppy_cfg(), dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(0), jnp.zeros((2, 16), jnp.int32)
+        )["params"]
+        rng = np.random.default_rng(7)
+        prompt = jnp.asarray(rng.integers(0, 256, (2, 5)), jnp.int32)
+        fast = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+        )
+        scan = generate(
+            model, params, prompt, max_new_tokens=6,
+            rng=jax.random.key(0), temperature=0.0,
+            prompt_lens=jnp.asarray([5, 5], jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(scan))
 
     def test_fast_path_equals_uniform_scan(self):
         """Greedy generate via prefill+decode must emit byte-identical
@@ -548,6 +633,29 @@ class TestRaggedBatch:
                 shared_prefix=prefix,
             )
             np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+    def test_moe_shared_prefix_matches_full_scan(self):
+        """shared_prefix prefills via the stepwise MoE path, so a droppy
+        MoE model must still produce scan-identical greedy output — the
+        ragged-batch face of the same schedule-invariance contract."""
+        model = TransformerLM(config=_moe_droppy_cfg(), dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        padded = jnp.asarray(
+            [[5, 9, 11, 2, 7], [8, 1, 0, 0, 0]], jnp.int32
+        )
+        plens = jnp.asarray([5, 2], jnp.int32)
+        base = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+        )
+        out = generate(
+            model, params, padded, max_new_tokens=4,
+            rng=jax.random.key(0), temperature=0.0, prompt_lens=plens,
+            shared_prefix=2,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
 
     def test_shared_prefix_composes_with_eos(self):
         """EOS done-seed at the prefix boundary: a row whose whole prompt

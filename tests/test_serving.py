@@ -1,0 +1,473 @@
+"""Serving engine tests: pool invariants, scheduler policy, e2e parity.
+
+Three layers, tested at three granularities:
+
+- :class:`~deeplearning_mpi_tpu.serving.kv_pool.PagedKVPool` is pure
+  host-side accounting, so it gets exhaustive treatment (alloc/free storms
+  with ``check()`` after every operation).
+- :class:`~deeplearning_mpi_tpu.serving.scheduler.Scheduler` policies
+  (bounded queue, length admission, deadlines, FCFS, oldest-first
+  eviction) run against a fake clock and a synthetic trace — every shed
+  reason is produced deterministically.
+- :class:`~deeplearning_mpi_tpu.serving.engine.ServingEngine` is pinned to
+  the offline path: 8 staggered requests with ragged prompt lengths
+  through the continuous-batching engine must produce BIT-IDENTICAL greedy
+  outputs to per-request offline ``models.generate.generate`` — with
+  mid-run slot reuse (a finished sequence's KV blocks reclaimed and handed
+  to a later admission) exercised and asserted, because recycled-block
+  correctness is exactly what the scratch-block and causal-masking design
+  claims.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.models.generate import generate
+from deeplearning_mpi_tpu.serving import (
+    SCRATCH_BLOCK,
+    EngineConfig,
+    PagedKVPool,
+    Request,
+    RequestState,
+    Scheduler,
+    ServingEngine,
+)
+from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+
+class FakeClock:
+    """Deterministic injectable clock (the engine/scheduler take any
+    zero-arg callable returning seconds)."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+def _req(rid, prompt_len, max_new=4, arrival=0.0, deadline=None):
+    return Request(
+        rid=rid,
+        prompt=np.arange(1, prompt_len + 1, dtype=np.int32),
+        max_new_tokens=max_new,
+        arrival=arrival,
+        deadline=deadline,
+    )
+
+
+class TestPagedKVPool:
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            PagedKVPool(1, 4)  # scratch only, nothing allocatable
+        with pytest.raises(ValueError):
+            PagedKVPool(8, 0)
+
+    def test_capacity_excludes_scratch(self):
+        pool = PagedKVPool(8, 4)
+        assert pool.capacity == 7
+        assert pool.available == 7
+        assert pool.in_use == 0
+
+    def test_blocks_for(self):
+        pool = PagedKVPool(8, 4)
+        assert [pool.blocks_for(n) for n in (1, 4, 5, 8, 9)] == [1, 1, 2, 2, 3]
+
+    def test_alloc_is_deterministic_lowest_first_and_skips_scratch(self):
+        pool = PagedKVPool(8, 4)
+        assert pool.alloc(3) == [1, 2, 3]
+        assert SCRATCH_BLOCK not in pool.alloc(4)
+        pool.check()
+
+    def test_alloc_all_or_nothing(self):
+        pool = PagedKVPool(5, 4)  # capacity 4
+        got = pool.alloc(3)
+        assert got is not None
+        before = pool.available
+        assert pool.alloc(2) is None  # only 1 free: no partial reservation
+        assert pool.available == before
+        pool.check()
+
+    def test_free_returns_blocks_for_reuse(self):
+        pool = PagedKVPool(5, 4)
+        a = pool.alloc(4)
+        assert pool.alloc(1) is None
+        pool.free(a[:2])
+        assert pool.available == 2
+        b = pool.alloc(2)
+        assert set(b) == set(a[:2])  # freed blocks recirculate
+        pool.check()
+
+    def test_double_free_and_bogus_free_raise(self):
+        pool = PagedKVPool(5, 4)
+        a = pool.alloc(2)
+        pool.free(a)
+        with pytest.raises(ValueError):
+            pool.free(a)  # double free
+        with pytest.raises(ValueError):
+            pool.free([SCRATCH_BLOCK])  # scratch never allocatable
+        with pytest.raises(ValueError):
+            pool.free([99])  # out of range
+
+    def test_alloc_free_storm_preserves_invariants(self):
+        """Randomized churn — the invariant check runs after EVERY op, and
+        the final drain must restore full capacity with matching lifetime
+        counters (no leaked or duplicated blocks)."""
+        rng = np.random.default_rng(0)
+        pool = PagedKVPool(17, 4)
+        held = []
+        for _ in range(500):
+            if held and rng.random() < 0.45:
+                blocks = held.pop(rng.integers(len(held)))
+                pool.free(blocks)
+            else:
+                got = pool.alloc(int(rng.integers(1, 5)))
+                if got is not None:
+                    held.append(got)
+            pool.check()
+            assert pool.available + pool.in_use == pool.capacity
+        for blocks in held:
+            pool.free(blocks)
+        pool.check()
+        assert pool.available == pool.capacity
+        assert pool.total_allocated == pool.total_freed > 0
+
+
+class TestScheduler:
+    def _sched(self, *, num_blocks=9, block_size=4, max_slots=2,
+               max_seq_len=32, max_queue=64):
+        pool = PagedKVPool(num_blocks, block_size)
+        return Scheduler(pool, max_slots=max_slots, max_seq_len=max_seq_len,
+                         max_queue=max_queue), pool
+
+    def test_submit_sheds_over_length_requests(self):
+        sched, _ = self._sched(max_seq_len=16)
+        req = _req(0, prompt_len=14, max_new=4)  # 18 > 16: can never finish
+        assert not sched.submit(req)
+        assert req.state is RequestState.SHED
+        assert req.shed_reason == "too_long"
+        assert sched.queue_depth() == 0
+
+    def test_submit_sheds_on_full_queue(self):
+        sched, _ = self._sched(max_queue=2)
+        assert sched.submit(_req(0, 4))
+        assert sched.submit(_req(1, 4))
+        late = _req(2, 4)
+        assert not sched.submit(late)
+        assert late.shed_reason == "queue_full"
+        assert sched.shed_count == 1
+
+    def test_shed_expired_drops_only_past_deadline(self):
+        sched, _ = self._sched()
+        expired = _req(0, 4, arrival=0.0, deadline=5.0)
+        alive = _req(1, 4, arrival=0.0, deadline=50.0)
+        eternal = _req(2, 4, arrival=0.0, deadline=None)
+        for r in (expired, alive, eternal):
+            assert sched.submit(r)
+        shed = sched.shed_expired(now=10.0)
+        assert shed == [expired]
+        assert expired.shed_reason == "deadline"
+        assert sched.queue_depth() == 2
+        assert alive.state is RequestState.QUEUED
+
+    def test_admit_fcfs_allocates_prompt_blocks(self):
+        sched, pool = self._sched(max_slots=2)
+        a, b, c = _req(0, 5, arrival=0.0), _req(1, 3, arrival=1.0), \
+            _req(2, 3, arrival=2.0)
+        for r in (a, b, c):
+            assert sched.submit(r)
+        admitted = sched.admit(now=3.0)
+        assert admitted == [a, b]  # arrival order, c waits for a slot
+        assert a.slot == 0 and b.slot == 1
+        assert len(a.blocks) == pool.blocks_for(5) == 2
+        assert len(b.blocks) == 1
+        assert a.state is RequestState.PREFILL and a.t_admitted == 3.0
+        assert sched.queue_depth() == 1
+        pool.check()
+
+    def test_admit_head_of_line_blocks_on_kv_pressure(self):
+        """FCFS means a big head request under KV pressure holds the line —
+        a later small request is NOT admitted around it (skipping ahead
+        would starve long prompts forever)."""
+        sched, pool = self._sched(num_blocks=4, block_size=4, max_slots=2,
+                                  max_seq_len=64)
+        big = _req(0, 15, max_new=1, arrival=0.0)    # needs 4 > capacity 3
+        small = _req(1, 3, max_new=1, arrival=1.0)   # would fit
+        assert sched.submit(big) and sched.submit(small)
+        assert sched.admit(now=2.0) == []
+        assert sched.queue_depth() == 2
+        assert pool.in_use == 0
+
+    def test_grow_extends_by_one_block(self):
+        sched, pool = self._sched()
+        req = _req(0, 4)
+        sched.submit(req)
+        sched.admit(now=0.0)
+        held = len(req.blocks)
+        assert sched.grow(req)
+        assert len(req.blocks) == held + 1
+        pool.check()
+
+    def test_grow_evicts_oldest_under_oom(self):
+        sched, pool = self._sched(num_blocks=5, block_size=4)  # capacity 4
+        old = _req(0, 8, arrival=0.0)    # 2 blocks
+        young = _req(1, 8, arrival=1.0)  # 2 blocks — pool now full
+        for r in (old, young):
+            sched.submit(r)
+        sched.admit(now=2.0)
+        assert pool.available == 0
+        assert sched.grow(young)  # evicts `old`, not the requester
+        assert old.state is RequestState.SHED
+        assert old.shed_reason == "evicted"
+        assert sched.slots[old.slot if old.slot is not None else 0] is not old
+        assert len(young.blocks) == 3
+        assert sched.evicted_count == 1
+        pool.check()
+
+    def test_grow_self_evicts_when_requester_is_oldest(self):
+        sched, pool = self._sched(num_blocks=5, block_size=4, max_slots=1)
+        req = _req(0, 16, arrival=0.0)  # 4 blocks: the whole pool
+        sched.submit(req)
+        sched.admit(now=0.0)
+        assert pool.available == 0
+        assert not sched.grow(req)  # nothing older to evict: self-shed
+        assert req.state is RequestState.SHED
+        assert req.shed_reason == "evicted"
+        assert sched.idle()
+        pool.check()
+
+    def test_finish_releases_slot_and_blocks(self):
+        sched, pool = self._sched()
+        req = _req(0, 6)
+        sched.submit(req)
+        sched.admit(now=0.0)
+        held = list(req.blocks)
+        sched.finish(req, now=5.0)
+        assert req.state is RequestState.FINISHED
+        assert req.t_finished == 5.0
+        assert req.blocks == held  # post-mortem record survives release
+        assert pool.in_use == 0
+        assert sched.idle()
+        pool.check()
+
+
+# -- engine fixtures ---------------------------------------------------------
+
+PROMPT_LENS = (5, 13, 3, 17, 1, 9, 2, 11)  # ragged on purpose
+MAX_NEW = 5
+ENGINE_CFG = EngineConfig(
+    max_slots=3, block_size=4, num_blocks=32, max_blocks_per_seq=8,
+    prefill_chunk=4,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny()
+    model = TransformerLM(config=cfg, dtype=jnp.float32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    return cfg, model, params
+
+
+def _offline_greedy(model, params, prompt, max_new):
+    out = generate(
+        model, params, jnp.asarray(prompt)[None], max_new_tokens=max_new,
+        rng=jax.random.key(1), temperature=0.0,
+    )
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+@pytest.fixture(scope="module")
+def parity_run(tiny_lm):
+    """One staggered continuous-batching run shared by the e2e tests:
+    8 ragged requests over 3 slots, arrivals spread across the run so
+    later requests are admitted into slots (and KV blocks) that earlier
+    finished requests just vacated."""
+    cfg, model, params = tiny_lm
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, 255, size=n).astype(np.int32) for n in PROMPT_LENS
+    ]
+    offline = [_offline_greedy(model, params, p, MAX_NEW) for p in prompts]
+
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = ServingEngine(
+        cfg, params, ENGINE_CFG, dtype=jnp.float32, clock=clock,
+        registry=registry,
+    )
+    # Arrival schedule: 3 up front (fill every slot), the rest staggered so
+    # they land mid-run as slots free.
+    arrive_at_step = {0: [0, 1, 2], 2: [3, 4], 4: [5], 6: [6, 7]}
+    reqs = {}
+    step = 0
+    while step in arrive_at_step or not engine.scheduler.idle():
+        for i in arrive_at_step.get(step, []):
+            reqs[i] = engine.submit(prompts[i], MAX_NEW)
+        engine.step()
+        clock.advance(1.0)
+        step += 1
+        assert step < 500, "engine did not drain"
+    snapshot = registry.snapshot()  # before any other test mutates counters
+    return {
+        "engine": engine, "reqs": [reqs[i] for i in range(len(prompts))],
+        "offline": offline, "snapshot": snapshot,
+    }
+
+
+class TestEngineParity:
+    def test_all_requests_bit_identical_to_offline_greedy(self, parity_run):
+        """The acceptance bar: every continuously-batched request produces
+        exactly the tokens the offline per-request greedy decode produces —
+        co-batched strangers, chunked prefill, paged KV, and slot churn
+        must all be invisible to the output."""
+        for req, expect in zip(parity_run["reqs"], parity_run["offline"]):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect, (
+                f"rid={req.rid}: engine {req.generated} != offline {expect}"
+            )
+
+    def test_mid_run_slot_reuse_exercised(self, parity_run):
+        """At least one later request must have been admitted after an
+        earlier one finished AND hold recycled KV blocks — the run
+        genuinely exercised reclaim+reassign, not just disjoint
+        allocations."""
+        reqs = parity_run["reqs"]
+        reused = [
+            (f.rid, g.rid)
+            for f in reqs for g in reqs
+            if f.t_finished is not None and g.t_admitted is not None
+            and g.t_admitted >= f.t_finished
+            and set(f.blocks) & set(g.blocks)
+        ]
+        assert reused, "no finished request's blocks were ever reassigned"
+
+    def test_pool_drained_and_consistent(self, parity_run):
+        pool = parity_run["engine"].pool
+        pool.check()
+        assert pool.in_use == 0
+        assert pool.total_allocated == pool.total_freed > 0
+
+    def test_serving_telemetry(self, parity_run):
+        snap = parity_run["snapshot"]
+        n = len(parity_run["reqs"])
+        total_tokens = sum(len(r.generated) for r in parity_run["reqs"])
+        assert snap["serve_requests_submitted"] == n
+        assert snap["serve_requests_admitted"] == n
+        assert snap["serve_requests_completed"] == n
+        assert snap["serve_requests_shed"] == 0
+        assert snap["serve_tokens_generated"] == total_tokens
+        assert snap["serve_decode_steps"] > 0
+        assert snap["serve_prefill_chunks"] >= n
+        assert snap["serve_ttft_s_count"] == n
+        assert snap["serve_tpot_s_count"] == n
+        assert snap["serve_ttft_s_p50"] >= 0
+        # Drained engine: the last step's gauges must read empty.
+        assert snap["serve_queue_depth"] == 0
+        assert snap["serve_slots_active"] == 0
+        assert snap["serve_kv_blocks_in_use"] == 0
+
+    def test_eos_stops_early(self, tiny_lm):
+        """EOS retirement: pick the request's own second offline token as
+        the EOS id — the engine must stop there, not at max_new_tokens."""
+        cfg, model, params = tiny_lm
+        prompt = np.arange(1, 8, dtype=np.int32)
+        offline = _offline_greedy(model, params, prompt, MAX_NEW)
+        eos = offline[1]
+        expect = offline[: offline.index(eos) + 1]
+        engine = ServingEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32, eos_id=eos,
+        )
+        req = engine.submit(prompt, MAX_NEW)
+        engine.run_until_idle()
+        assert req.state is RequestState.FINISHED
+        assert req.generated == expect
+        assert len(req.generated) < MAX_NEW
+
+    def test_eviction_under_kv_pressure_preserves_survivors(self, tiny_lm):
+        """A pool too small for every sequence's final length forces an
+        eviction mid-run; the oldest request is shed with its partial
+        output, and — the real claim — the survivors' outputs are STILL
+        bit-identical to offline greedy: reclaiming a live sequence's
+        blocks must not corrupt anyone else."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(1, 255, size=6).astype(np.int32) for _ in range(3)
+        ]
+        max_new = 8  # final length 14 -> 4 blocks/seq; 3*4 > capacity 9
+        offline = [
+            _offline_greedy(model, params, p, max_new) for p in prompts
+        ]
+        clock = FakeClock()
+        engine = ServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=3, block_size=4, num_blocks=10,
+                         max_blocks_per_seq=8, prefill_chunk=4),
+            dtype=jnp.float32, clock=clock,
+        )
+        reqs = []
+        for p in prompts:  # distinct arrivals: eviction order deterministic
+            reqs.append(engine.submit(p, max_new))
+            clock.advance(1.0)
+        engine.run_until_idle()
+
+        evicted = [r for r in reqs if r.state is RequestState.SHED]
+        survivors = [r for r in reqs if r.state is RequestState.FINISHED]
+        assert [r.rid for r in evicted] == [reqs[0].rid]  # oldest-first
+        assert evicted[0].shed_reason == "evicted"
+        assert 0 < len(evicted[0].generated) < max_new  # partial output kept
+        assert len(survivors) == 2
+        for req, expect in zip(reqs[1:], offline[1:]):
+            assert req.generated == expect
+        engine.pool.check()
+        assert engine.pool.in_use == 0
+
+    def test_deadline_shed_before_admission(self, tiny_lm):
+        cfg, _, params = tiny_lm
+        clock = FakeClock()
+        engine = ServingEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32, clock=clock,
+        )
+        req = engine.submit(np.arange(1, 5, dtype=np.int32), 4, deadline=2.0)
+        clock.advance(10.0)  # client gave up before any step ran
+        engine.step()
+        assert req.state is RequestState.SHED
+        assert req.shed_reason == "deadline"
+        assert engine.scheduler.idle()
+
+
+class TestEngineValidation:
+    def test_rejects_moe_configs(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(TransformerConfig.tiny(), moe_experts=4)
+        with pytest.raises(NotImplementedError, match="dense-MLP only"):
+            ServingEngine(cfg, {}, EngineConfig())
+
+    def test_rejects_quantized_param_trees(self):
+        fake = {"layer_0": {"attn": {"q_proj": {"scale": None}}}}
+        with pytest.raises(NotImplementedError, match="raw f32"):
+            ServingEngine(TransformerConfig.tiny(), fake, EngineConfig())
+
+    def test_rejects_pool_smaller_than_one_sequence(self):
+        fake = {"layer_0": {"attn": {"q_proj": {"kernel": None}}}}
+        with pytest.raises(ValueError, match="pool capacity"):
+            ServingEngine(
+                TransformerConfig.tiny(), fake,
+                EngineConfig(num_blocks=4, max_blocks_per_seq=8),
+            )
+
+    def test_rejects_nonpositive_max_new(self, tiny_lm):
+        cfg, _, params = tiny_lm
+        engine = ServingEngine(cfg, params, ENGINE_CFG, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            engine.submit(np.arange(1, 4, dtype=np.int32), 0)
