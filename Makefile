@@ -4,12 +4,13 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke
+.PHONY: verify selftest check smoke serve-smoke chaos-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
-# serve-smoke prerequisite gates the tier-1 run on the serving engine's
-# end-to-end parity selftest without touching the ROADMAP command itself.
-verify: serve-smoke
+# serve-smoke and chaos-smoke prerequisites gate the tier-1 run on the
+# serving engine's end-to-end parity selftest and the fault-injection
+# recovery drill without touching the ROADMAP command itself.
+verify: serve-smoke chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -40,3 +41,22 @@ smoke:
 		--metrics_dir /tmp/dmt_smoke/metrics --log_dir /tmp/dmt_smoke/logs \
 		--model_dir /tmp/dmt_smoke/models
 	python tools/metrics_report.py /tmp/dmt_smoke/metrics/metrics.jsonl
+
+# Fault-injection recovery drill (<60s, docs/RESILIENCE.md): a tiny LM run
+# where the epoch-1 checkpoint is corrupted on disk and the process "dies"
+# mid-epoch-2; auto-resume must roll back past the corruption to the
+# verified epoch-0 checkpoint, re-train, and finish all 3 epochs. The
+# follow-up assert reads the run's own metrics.jsonl and requires the
+# reconciliation invariant: fault_injected_total == recovery_total +
+# rollback_total.
+chaos-smoke:
+	rm -rf /tmp/dmt_chaos
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.train_lm \
+		--n_virtual_devices 8 --num_epochs 3 --batch_size 8 \
+		--train_sequences 40 --seq_len 32 --num_layers 1 --d_model 32 \
+		--d_ff 64 --num_heads 2 --head_dim 16 --eval_every 1 \
+		--max_restarts 2 --restart_delay_s 0.1 \
+		--chaos "corrupt_ckpt@epoch:1,kill@step:11" \
+		--metrics_dir /tmp/dmt_chaos/metrics \
+		--model_dir /tmp/dmt_chaos/models --log_dir /tmp/dmt_chaos/logs
+	env JAX_PLATFORMS=cpu python -c 'import json; recs = [json.loads(l) for l in open("/tmp/dmt_chaos/metrics/metrics.jsonl")]; s = [r for r in recs if r["kind"] == "run_summary"][-1]; f, r, b = (s.get(k, 0) for k in ("fault_injected_total", "recovery_total", "rollback_total")); assert f >= 2 and f == r + b, (f, r, b); print("chaos-smoke OK: injected=%d recovered=%d rolled_back=%d" % (f, r, b))'

@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--metrics_file", default=None,
                         help="append canonical telemetry JSONL records here "
                         "(readable by tools/metrics_report.py)")
+    parser.add_argument("--chaos", default=None,
+                        help="deterministic fault-injection spec, e.g. "
+                        "'serve_crash@step:12' — the engine crashes mid-step "
+                        "and recovers (requeue + KV reconcile); falls back "
+                        "to $DMT_CHAOS (docs/RESILIENCE.md)")
     parser.add_argument("--selftest", action="store_true",
                         help="random-init tiny-ish model, synthetic trace, "
                         "verify every completion against offline greedy "
@@ -153,6 +158,8 @@ def replay(engine, entries, *, poll_s: float = 0.0005):
     """Submit each entry at its arrival offset (wall clock) and step the
     engine until everything drains. Returns the Request records in
     submission order."""
+    from deeplearning_mpi_tpu.resilience import InjectedFault
+
     pending = deque(entries)
     reqs = []
     t0 = time.monotonic()
@@ -168,7 +175,11 @@ def replay(engine, entries, *, poll_s: float = 0.0005):
                 engine.submit(e["prompt"], e["max_new"], deadline=deadline)
             )
         if not engine.scheduler.idle():
-            engine.step()
+            try:
+                engine.step()
+            except InjectedFault as fault:
+                print(f"chaos: {fault} — recovering", file=sys.stderr)
+                engine.recover()
         elif pending:
             time.sleep(min(poll_s, max(pending[0]["arrival"] - now, 0.0)))
     return reqs, time.monotonic() - t0
@@ -307,6 +318,9 @@ def main(argv: list[str] | None = None) -> int:
     registry = MetricsRegistry()
     if args.metrics_file:
         registry.add_sink(JsonlSink(args.metrics_file))
+    from deeplearning_mpi_tpu.resilience import ChaosInjector
+
+    chaos = ChaosInjector.from_spec(args.chaos, registry=registry)
     engine = ServingEngine(
         cfg, params,
         EngineConfig(
@@ -318,7 +332,7 @@ def main(argv: list[str] | None = None) -> int:
             max_queue=args.max_queue,
             use_kernel=args.use_kernel,
         ),
-        dtype=dtype, eos_id=eos_id, registry=registry,
+        dtype=dtype, eos_id=eos_id, registry=registry, chaos=chaos,
     )
 
     if args.trace:
@@ -339,6 +353,8 @@ def main(argv: list[str] | None = None) -> int:
 
     reqs, wall_s = replay(engine, entries)
     _report(reqs, wall_s, registry)
+    if chaos is not None:
+        print(chaos.summary(), file=sys.stderr)
     registry.emit("serve_summary", registry.snapshot())
     registry.close()
 

@@ -196,8 +196,15 @@ def main(argv: list[str] | None = None) -> int:
 
     state = state_factory()
 
+    # Chaos harness (None unless --chaos/$DMT_CHAOS): one injector spans
+    # checkpointer, loader, and trainer so the fault/recovery accounting
+    # reconciles across layers (docs/RESILIENCE.md).
+    chaos = config.build_chaos(args)
+
     ckpt_dir = f"{args.model_dir}/{args.model_filename}"
-    checkpointer = Checkpointer(ckpt_dir)
+    checkpointer = Checkpointer(
+        ckpt_dir, max_to_keep=args.keep_checkpoints, chaos=chaos
+    )
     # restore_for_start can SystemExit (--eval_only with no checkpoint); it
     # must do so inside the try or the other hosts hang at their next
     # collective (bootstrap.shutdown never runs) and orbax threads leak.
@@ -222,9 +229,19 @@ def main(argv: list[str] | None = None) -> int:
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
             grad_accum=args.grad_accum, loss_chunk=args.loss_chunk,
-            zero=args.zero, ema_decay=args.ema,
+            zero=args.zero, ema_decay=args.ema, chaos=chaos,
         )
         trainer.place_state()
+        if chaos is not None:
+            from deeplearning_mpi_tpu.resilience import ResilientLoader
+
+            chaos.bind_registry(trainer.metrics)
+            # The stall watchdog only wraps the TRAIN loader under chaos —
+            # its serialized assembly is the price of injectable deadlines
+            # (watchdog.py docstring), not worth paying on clean runs.
+            train_loader = ResilientLoader(
+                train_loader, chaos=chaos, logger=logger
+            )
         # Analytic per-step cost estimates feed the telemetry registry's
         # MFU and collective-byte epoch stats (telemetry/flops.py,
         # telemetry/comms.py): gradient sync over data, plus whichever

@@ -164,7 +164,14 @@ def main(argv: list[str] | None = None) -> int:
 
     state = state_factory()
 
-    checkpointer = Checkpointer(f"{args.model_dir}/{args.model_filename}")
+    # Chaos harness (None unless --chaos/$DMT_CHAOS): one injector spans
+    # checkpointer, loader, and trainer (docs/RESILIENCE.md).
+    chaos = config.build_chaos(args)
+
+    checkpointer = Checkpointer(
+        f"{args.model_dir}/{args.model_filename}",
+        max_to_keep=args.keep_checkpoints, chaos=chaos,
+    )
     # restore_for_start can SystemExit (--eval_only with no checkpoint); it
     # must do so inside the try or the other hosts hang at their next
     # collective (bootstrap.shutdown never runs) and orbax threads leak.
@@ -174,9 +181,16 @@ def main(argv: list[str] | None = None) -> int:
             state, "segmentation", mesh,
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             grad_accum=args.grad_accum, zero=args.zero, seg_loss=args.loss,
-            ema_decay=args.ema,
+            ema_decay=args.ema, chaos=chaos,
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
+        if chaos is not None:
+            from deeplearning_mpi_tpu.resilience import ResilientLoader
+
+            chaos.bind_registry(trainer.metrics)
+            train_loader = ResilientLoader(
+                train_loader, chaos=chaos, logger=logger
+            )
         # Analytic train FLOPs → MFU. Non-square folder images collapse to
         # the voxel-preserving equivalent square/cube edge (conv FLOPs scale
         # with voxel count, so the estimate is exact up to boundary effects).

@@ -119,6 +119,9 @@ class ShardedLoader:
             }
         )
         self.process_batch = sum(stop - start for start, stop in self.local_row_ranges)
+        # Sharding cache keyed by rank — shared across epochs (and with the
+        # resilience watchdog wrapper, which reuses _to_device directly).
+        self._shardings: dict[int, jax.sharding.NamedSharding] = {}
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         """Global index order for this epoch, sized to whole batches."""
@@ -183,6 +186,18 @@ class ShardedLoader:
             stacked["__valid__"] = (flat_pos < len(self.dataset)).astype(np.float32)
         return stacked
 
+    def _to_device(self, stacked: dict[str, np.ndarray]) -> Batch:
+        """Assembled host batch → globally-sharded device arrays."""
+        return {
+            k: jax.make_array_from_process_local_data(
+                self._shardings.setdefault(
+                    v.ndim, batch_sharding(self.mesh, ndim=v.ndim)
+                ),
+                v,
+            )
+            for k, v in stacked.items()
+        }
+
     def epoch(self, epoch: int) -> Iterator[Batch]:
         """Yield this epoch's batches as globally-sharded device arrays.
 
@@ -198,17 +213,7 @@ class ShardedLoader:
                 f"dataset of {len(self.dataset)} examples yields no full batch of "
                 f"{self.global_batch_size}; lower the batch size or use drop_last=False"
             )
-        shardings: dict[int, jax.sharding.NamedSharding] = {}
-
-        def to_device(stacked: dict[str, np.ndarray]) -> Batch:
-            return {
-                k: jax.make_array_from_process_local_data(
-                    shardings.setdefault(v.ndim, batch_sharding(self.mesh, ndim=v.ndim)),
-                    v,
-                )
-                for k, v in stacked.items()
-            }
-
+        to_device = self._to_device
         starts = range(0, len(order), self.global_batch_size)
         if self.num_workers <= 0:
             for start in starts:
@@ -294,3 +299,11 @@ def prefetch(iterator: Iterator[Any], size: int = 2) -> Iterator[Any]:
             yield item
     finally:
         stop.set()
+        # Join, don't just signal: the producer may be inside the source's
+        # device_put when the consumer leaves (a crash mid-epoch), and the
+        # caller's next move can be restore + retrain — concurrent device
+        # work from a dead epoch's producer corrupts that. Both producer
+        # loops are stop-aware with 0.1s put timeouts, so this converges as
+        # soon as the in-flight item finishes; the timeout guards against a
+        # wedged source (the thread is a daemon either way).
+        thread.join(timeout=30.0)

@@ -1,0 +1,70 @@
+"""Resilience layer: deterministic fault injection + verified recovery.
+
+The reference stack has no failure handling at all — one NaN batch, one
+corrupted checkpoint, or one preempted host kills a multi-node run
+(``SURVEY.md`` §5.3). This package is the opposite stance, in two halves
+that test each other:
+
+- **Chaos harness** (:mod:`.faults`): a deterministic :class:`FaultPlan`
+  (``--chaos "nan_grad@step:7,kill@step:12,corrupt_ckpt@epoch:1"``) whose
+  :class:`ChaosInjector` fires each fault exactly once at its planned
+  trigger, through hooks wired into the trainer step, the data loader, the
+  checkpointer, and the serving engine step.
+- **Hardening** that must survive every planned fault: checkpoint
+  integrity manifests + rollback-to-verified (:mod:`.integrity`,
+  ``train/checkpoint.py``), SIGTERM graceful checkpointing
+  (:mod:`.preemption`), the loader stall watchdog with poison-batch
+  quarantine (:mod:`.watchdog`), and the supervised restart loop
+  (:mod:`.supervisor`, grown from the original ``train/resilience.py``).
+
+Every fault and every recovery flows through the PR-1 telemetry registry;
+the reconciliation invariant ``fault_injected_total == recovery_total +
+rollback_total`` is the chaos harness's own acceptance check
+(``docs/RESILIENCE.md``).
+"""
+
+from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
+    ChaosInjector,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedKill,
+)
+from deeplearning_mpi_tpu.resilience.integrity import (  # noqa: F401
+    CheckpointCorruption,
+    atomic_write_json,
+    corrupt_checkpoint,
+    dir_digests,
+    tree_digests,
+)
+from deeplearning_mpi_tpu.resilience.preemption import (  # noqa: F401
+    GracefulShutdown,
+    Preempted,
+)
+from deeplearning_mpi_tpu.resilience.supervisor import (  # noqa: F401
+    Heartbeat,
+    TrainingFailure,
+    preflight,
+    run_with_auto_resume,
+)
+from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F401
+
+__all__ = [
+    "ChaosInjector",
+    "CheckpointCorruption",
+    "FaultPlan",
+    "FaultSpec",
+    "GracefulShutdown",
+    "Heartbeat",
+    "InjectedFault",
+    "InjectedKill",
+    "Preempted",
+    "ResilientLoader",
+    "TrainingFailure",
+    "atomic_write_json",
+    "corrupt_checkpoint",
+    "dir_digests",
+    "preflight",
+    "run_with_auto_resume",
+    "tree_digests",
+]

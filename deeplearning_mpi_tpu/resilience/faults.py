@@ -1,0 +1,371 @@
+"""Deterministic fault injection: the chaos plan and its injector.
+
+A chaos spec is a comma-separated list of ``kind@unit:value`` entries::
+
+    nan_grad@step:7,loader_stall@batch:3,kill@step:12,corrupt_ckpt@epoch:1
+
+Each entry names a fault *kind*, the trigger *unit* it counts in (fixed per
+kind — a mismatch is a parse error, not a silent no-op), and the trigger
+value. The plan is fully deterministic: no randomness, every fault fires
+exactly once at its planned trigger, so a chaos run is reproducible and a
+recovered run can be compared bit-for-bit against an unfaulted one
+(``tests/test_resilience.py`` does exactly that).
+
+Fault kinds and where their hook lives:
+
+===============  ======  =====================================================
+kind             unit    injection site
+===============  ======  =====================================================
+``nan_grad``     step    trainer batch poisoning → NaN loss → the jitted
+                         step's NaN guard must skip the update
+``kill``         step    trainer loop raises :class:`InjectedKill` before the
+                         step — a hard crash the supervisor must survive
+``corrupt_ckpt``  epoch  checkpointer flips bytes in the just-saved step dir —
+                         restore must detect it and roll back
+``loader_stall``  batch  loader worker sleeps ``stall_s`` — the watchdog's
+                         timeout/retry path
+``loader_die``    batch  loader worker raises every attempt (a *poison*
+                         batch) — the watchdog must quarantine it
+``serve_crash``   step   serving engine raises mid-step — recovery must
+                         requeue in-flight sequences and reconcile the pool
+===============  ======  =====================================================
+
+Accounting contract (the reconciliation invariant): every fault increments
+``fault_injected_total`` exactly once when it first fires, and the layer
+that handles it records exactly one ``recovery_total`` (handled, work
+preserved or re-done) or ``rollback_total`` (handled by discarding state —
+today only ``corrupt_ckpt``) increment against that same spec. A balanced
+run has ``fault_injected_total == recovery_total + rollback_total``; an
+unrecovered fault shows up as the imbalance, by design. Recovery latency
+(fire → recorded recovery) feeds the ``recovery_latency_s`` histogram.
+
+The injector counts internally and mirrors into a telemetry registry when
+one is bound — :meth:`ChaosInjector.bind_registry` backfills, so binding
+after early faults (CLIs build the registry late) loses nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from typing import Any, Optional
+
+from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+__all__ = [
+    "ChaosInjector",
+    "ENV_SPEC",
+    "ENV_STALL",
+    "FAULT_INJECTED",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "InjectedKill",
+    "RECOVERY",
+    "RECOVERY_LATENCY",
+    "ROLLBACK",
+]
+
+#: trigger unit per fault kind — the grammar's validity table.
+FAULT_UNITS = {
+    "nan_grad": "step",
+    "kill": "step",
+    "corrupt_ckpt": "epoch",
+    "loader_stall": "batch",
+    "loader_die": "batch",
+    "serve_crash": "step",
+}
+
+#: kinds that keep firing on retries of the same trigger (a poison batch is
+#: poison every attempt); still COUNTED once — the fault is one event, the
+#: retries are the recovery machinery probing it.
+_PERSISTENT = frozenset({"loader_die"})
+
+FAULT_INJECTED = "fault_injected_total"
+RECOVERY = "recovery_total"
+ROLLBACK = "rollback_total"
+RECOVERY_LATENCY = "recovery_latency_s"
+
+#: env fallback for the spec — lets ``make chaos-smoke``-style wrappers
+#: inject faults into entrypoints without threading a flag.
+ENV_SPEC = "DMT_CHAOS"
+#: env override for the stall sleep (seconds).
+ENV_STALL = "DMT_CHAOS_STALL_S"
+
+_ENTRY = re.compile(r"(\w+)@(\w+):(\d+)")
+
+
+class InjectedFault(RuntimeError):
+    """An injected fault surfacing as an exception (loader_die, serve_crash)."""
+
+
+class InjectedKill(InjectedFault):
+    """The injected training crash — stands in for a SIGKILL'd host."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One planned fault and its lifecycle flags."""
+
+    kind: str
+    unit: str
+    at: int
+    fired: bool = False
+    recovered: bool = False
+    fired_at: Optional[float] = None  # monotonic; recovery-latency origin
+
+
+class FaultPlan:
+    """Parsed, validated chaos spec — an ordered list of :class:`FaultSpec`."""
+
+    def __init__(self, specs: list[FaultSpec]) -> None:
+        self.specs = specs
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        specs: list[FaultSpec] = []
+        for entry in (e.strip() for e in spec.split(",")):
+            if not entry:
+                continue
+            m = _ENTRY.fullmatch(entry)
+            if m is None:
+                raise ValueError(
+                    f"bad chaos entry '{entry}' — want kind@unit:N, e.g. "
+                    "kill@step:12"
+                )
+            kind, unit, at = m.group(1), m.group(2), int(m.group(3))
+            if kind not in FAULT_UNITS:
+                raise ValueError(
+                    f"unknown fault kind '{kind}' (known: "
+                    f"{', '.join(sorted(FAULT_UNITS))})"
+                )
+            if unit != FAULT_UNITS[kind]:
+                raise ValueError(
+                    f"fault '{kind}' triggers on '{FAULT_UNITS[kind]}', "
+                    f"not '{unit}'"
+                )
+            specs.append(FaultSpec(kind, unit, at))
+        if not specs:
+            raise ValueError(f"empty chaos spec: {spec!r}")
+        return cls(specs)
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __repr__(self) -> str:
+        return "FaultPlan(" + ",".join(
+            f"{s.kind}@{s.unit}:{s.at}" for s in self.specs
+        ) + ")"
+
+
+class ChaosInjector:
+    """Fires a :class:`FaultPlan` through site hooks and accounts for every
+    fault, recovery, and rollback.
+
+    One injector spans a whole run, including supervised restarts — the
+    fired/recovered flags are exactly what makes "kill once at step 12"
+    mean once, not once per attempt.
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        *,
+        registry: Any = None,
+        stall_s: float | None = None,
+    ) -> None:
+        self.plan = plan
+        if stall_s is None:
+            stall_s = float(os.environ.get(ENV_STALL, "2.0"))
+        self.stall_s = stall_s
+        self._registry: Any = None
+        self._counts: dict[str, float] = {}
+        self._latencies: list[float] = []
+        if registry is not None:
+            self.bind_registry(registry)
+
+    @classmethod
+    def from_spec(
+        cls,
+        spec: str | None,
+        *,
+        registry: Any = None,
+        stall_s: float | None = None,
+    ) -> Optional["ChaosInjector"]:
+        """Build from a CLI spec, falling back to ``$DMT_CHAOS``; ``None``
+        when neither is set (the hooks then cost one ``is None`` check)."""
+        spec = spec or os.environ.get(ENV_SPEC) or ""
+        if not spec.strip():
+            return None
+        return cls(FaultPlan.parse(spec), registry=registry, stall_s=stall_s)
+
+    # -- telemetry plumbing -------------------------------------------------
+    def bind_registry(self, registry: Any) -> None:
+        """Mirror counts into ``registry`` from now on, backfilling anything
+        counted before the bind (CLIs build the trainer's registry after the
+        checkpointer/loader already hold the injector)."""
+        self._registry = registry
+        for name in (FAULT_INJECTED, RECOVERY, ROLLBACK):
+            registry.counter(name)  # reconciliation reads all three, even at 0
+        for name, v in self._counts.items():
+            if v:
+                registry.counter(name).inc(v)
+        for lat in self._latencies:
+            registry.histogram(RECOVERY_LATENCY).observe(lat)
+
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        self._counts[name] = self._counts.get(name, 0.0) + amount
+        if self._registry is not None:
+            self._registry.counter(name).inc(amount)
+
+    def _observe_latency(self, latency_s: float) -> None:
+        self._latencies.append(latency_s)
+        if self._registry is not None:
+            self._registry.histogram(RECOVERY_LATENCY).observe(latency_s)
+
+    # -- firing -------------------------------------------------------------
+    def should_fire(self, kind: str, at: int) -> bool:
+        """True iff a planned ``kind`` fault triggers at ``at``. Counts the
+        fault on its FIRST firing only; persistent kinds keep returning True
+        on retries of the same trigger without recounting."""
+        hit = False
+        for spec in self.plan.specs:
+            if spec.kind != kind or spec.at != at:
+                continue
+            if not spec.fired:
+                spec.fired = True
+                spec.fired_at = time.monotonic()
+                self._inc(FAULT_INJECTED)
+                self._inc(labeled(FAULT_INJECTED, kind=kind))
+                hit = True
+            elif kind in _PERSISTENT and not spec.recovered:
+                hit = True
+        return hit
+
+    # -- site hooks ---------------------------------------------------------
+    def check_kill(self, *, step: int) -> None:
+        """Trainer hook, before the jitted step: a planned kill raises."""
+        if self.should_fire("kill", step):
+            raise InjectedKill(f"chaos: injected kill@step:{step}")
+
+    def check_serve_crash(self, *, step: int) -> None:
+        """Serving-engine hook, mid-step (after prefill mutated host state)."""
+        if self.should_fire("serve_crash", step):
+            raise InjectedFault(f"chaos: injected serve_crash@step:{step}")
+
+    def maybe_poison(self, batch: Any, task: str, *, step: int) -> Any:
+        """Trainer hook: return a NaN-poisoned copy of ``batch`` when a
+        ``nan_grad`` fault triggers at ``step``. The poison rides the loss
+        mask (LM) or the input image, so the jitted step's NaN guard — not
+        the injector — is what must keep the run alive."""
+        if not self.should_fire("nan_grad", step):
+            return batch
+        import jax.numpy as jnp
+
+        nan = jnp.float32(float("nan"))
+        poisoned = dict(batch)
+        if task == "lm":
+            # tokens * NaN keeps the tokens array's shape/sharding; the
+            # all-NaN mask drives lm_cross_entropy's masked mean to NaN.
+            poisoned["mask"] = poisoned["tokens"].astype(jnp.float32) * nan
+        else:
+            key = "image" if "image" in poisoned else next(iter(poisoned))
+            poisoned[key] = poisoned[key] * nan
+        return poisoned
+
+    def loader_fault(self, *, batch: int) -> None:
+        """Watchdog-worker hook: a stall sleeps ``stall_s``; a die raises
+        (every attempt — poison batches stay poison across retries)."""
+        if self.should_fire("loader_stall", batch):
+            time.sleep(self.stall_s)
+        if self.should_fire("loader_die", batch):
+            raise InjectedFault(
+                f"chaos: injected loader_die@batch:{batch} (poison batch)"
+            )
+
+    def should_corrupt(self, *, epoch: int) -> bool:
+        """Checkpointer hook, after a save lands."""
+        return self.should_fire("corrupt_ckpt", epoch)
+
+    # -- recovery accounting ------------------------------------------------
+    def record_recovery(
+        self, kind: str, *, at: int | None = None, latency_s: float | None = None
+    ) -> bool:
+        """Mark the oldest fired-but-unrecovered ``kind`` fault recovered.
+
+        Idempotent per spec and a no-op when nothing matches — recovery
+        sites call it unconditionally and only *injected* faults are
+        counted, which is what keeps the reconciliation invariant exact.
+        """
+        return self._resolve(kind, RECOVERY, at=at, latency_s=latency_s)
+
+    def record_rollback(self, kind: str = "corrupt_ckpt", *, at: int | None = None) -> bool:
+        """Like :meth:`record_recovery`, but the fault was handled by
+        DISCARDING state (a corrupted checkpoint skipped over)."""
+        return self._resolve(kind, ROLLBACK, at=at, latency_s=None)
+
+    def _resolve(
+        self, kind: str, counter: str, *, at: int | None, latency_s: float | None
+    ) -> bool:
+        for spec in self.plan.specs:
+            if spec.kind != kind or not spec.fired or spec.recovered:
+                continue
+            if at is not None and spec.at != at:
+                continue
+            spec.recovered = True
+            self._inc(counter)
+            self._inc(labeled(counter, kind=kind))
+            if latency_s is None and spec.fired_at is not None:
+                latency_s = time.monotonic() - spec.fired_at
+            if latency_s is not None:
+                self._observe_latency(latency_s)
+            return True
+        return False
+
+    def reconcile_nan_recoveries(self, skipped: int) -> int:
+        """Trainer epoch-end hook: each pending ``nan_grad`` fault counts as
+        recovered once the epoch's skip count confirms the NaN guard
+        actually rejected a step for it. Returns recoveries recorded."""
+        n = 0
+        for spec in self.plan.specs:
+            if skipped - n <= 0:
+                break
+            if spec.kind == "nan_grad" and spec.fired and not spec.recovered:
+                if self.record_recovery("nan_grad", at=spec.at):
+                    n += 1
+        return n
+
+    # -- reporting ----------------------------------------------------------
+    def counts(self) -> dict[str, float]:
+        return dict(self._counts)
+
+    def balanced(self) -> bool:
+        """The reconciliation invariant."""
+        c = self._counts
+        return c.get(FAULT_INJECTED, 0.0) == (
+            c.get(RECOVERY, 0.0) + c.get(ROLLBACK, 0.0)
+        )
+
+    def unrecovered(self) -> list[FaultSpec]:
+        return [s for s in self.plan.specs if s.fired and not s.recovered]
+
+    def summary(self) -> str:
+        c = self._counts
+        line = (
+            f"chaos: {c.get(FAULT_INJECTED, 0.0):.0f} fault(s) injected, "
+            f"{c.get(RECOVERY, 0.0):.0f} recovered, "
+            f"{c.get(ROLLBACK, 0.0):.0f} rolled back"
+        )
+        pending = self.unrecovered()
+        if pending:
+            line += " — UNRECOVERED: " + ", ".join(
+                f"{s.kind}@{s.unit}:{s.at}" for s in pending
+            )
+        unfired = [s for s in self.plan.specs if not s.fired]
+        if unfired:
+            line += " — never fired: " + ", ".join(
+                f"{s.kind}@{s.unit}:{s.at}" for s in unfired
+            )
+        return line

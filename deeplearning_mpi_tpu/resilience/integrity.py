@@ -1,0 +1,154 @@
+"""Checkpoint integrity: checksum manifests, atomic writes, corruption.
+
+The reference overwrites one ``.pth`` in place (``pytorch/resnet/main.py:
+136-139``) — a mid-save kill leaves a truncated file that ``torch.load``
+rejects with a pickle error and no path back. Orbax already writes each
+step atomically (tmp dir + rename), but "the rename landed" is not "the
+bytes are the ones we computed": bit-rot, a torn NFS write, or a buggy
+post-save mutation all produce a checkpoint that restores *cleanly* into
+wrong weights. The manifest closes that gap — :func:`dir_digests` hashes
+every file of the committed step at save time, restore re-hashes and
+compares BEFORE any byte reaches the array decoder, and a mismatch rolls
+back to the newest step whose digests verify
+(``Checkpointer.restore_verified``). Verifying files rather than decoded
+arrays is deliberate: tensorstore hitting corrupt compressed chunks
+mid-read is exactly the failure mode we must never enter (observed to
+poison the process), and raw-byte hashing needs no decode at all.
+:func:`tree_digests` (per-array, dtype+shape+bytes) remains the tool for
+comparing live states — e.g. asserting a recovered run's final params are
+bit-identical to an unfaulted run's.
+
+:func:`corrupt_checkpoint` is the attack half of the same contract: the
+chaos harness uses it to flip bytes inside a real saved step so the
+verify-and-roll-back path is exercised by an actual corruption, not a
+mock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+__all__ = [
+    "CheckpointCorruption",
+    "atomic_write_json",
+    "corrupt_checkpoint",
+    "dir_digests",
+    "manifest_path",
+    "read_manifest",
+    "tree_digests",
+    "write_manifest",
+]
+
+
+class CheckpointCorruption(RuntimeError):
+    """No checkpoint survived verification — every candidate failed
+    restore or digest comparison."""
+
+
+def atomic_write_json(path: str | Path, obj: Any) -> None:
+    """Write JSON so readers see the old file or the new one, never a
+    partial: tmp sibling, flush + fsync, then rename over the target."""
+    path = Path(path)
+    tmp = path.parent / f"tmp-{path.name}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def tree_digests(tree: Any) -> dict[str, str]:
+    """sha256 per array leaf, keyed by tree path.
+
+    The digest covers dtype + shape + raw bytes, so a silent dtype cast or
+    reshape fails verification the same way flipped bytes do. One
+    ``device_get`` over the whole tree (a single transfer, not per-leaf)
+    pulls addressable shards to host; on multi-host this hashes only the
+    local shards, which is why ``Checkpointer`` keeps manifests
+    single-process-only.
+    """
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    host = jax.device_get([leaf for _, leaf in leaves])
+    out: dict[str, str] = {}
+    for (path, _), value in zip(leaves, host):
+        arr = np.ascontiguousarray(np.asarray(value))
+        h = hashlib.sha256()
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+        out[jax.tree_util.keystr(path)] = h.hexdigest()
+    return out
+
+
+def dir_digests(step_dir: str | Path) -> dict[str, str]:
+    """sha256 per regular file under ``step_dir``, keyed by relative path.
+
+    The manifest of a committed checkpoint step: covers every byte Orbax
+    wrote (array chunks, metadata, commit markers), so any on-disk damage
+    — including to files the reader would only touch lazily — fails
+    verification without decoding anything.
+    """
+    step_dir = Path(step_dir)
+    out: dict[str, str] = {}
+    for f in sorted(p for p in step_dir.rglob("*") if p.is_file()):
+        h = hashlib.sha256()
+        with open(f, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+        out[str(f.relative_to(step_dir))] = h.hexdigest()
+    return out
+
+
+def manifest_path(directory: str | Path, epoch: int) -> Path:
+    """Manifests live BESIDE the step dirs, not inside them — Orbax owns
+    the step dir layout (and deletes whole dirs on retention), a foreign
+    file inside one is asking for a version-skew fight."""
+    return Path(directory) / f"manifest-{epoch}.json"
+
+
+def write_manifest(directory: str | Path, epoch: int, digests: dict[str, str]) -> None:
+    atomic_write_json(manifest_path(directory, epoch), {"epoch": epoch, "digests": digests})
+
+
+def read_manifest(directory: str | Path, epoch: int) -> dict[str, str] | None:
+    """``None`` for missing OR unreadable — both mean "no verification
+    available", and the restore policy treats that as accept-unverified so
+    pre-manifest checkpoints stay restorable."""
+    try:
+        payload = json.loads(manifest_path(directory, epoch).read_text())
+        return dict(payload["digests"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def corrupt_checkpoint(step_dir: str | Path, *, span: int = 1024) -> Path:
+    """Flip a span of bytes in the largest file under ``step_dir`` (the
+    array data, in practice) — chaos harness only.
+
+    XOR at an interior offset rather than truncation, because truncation is
+    the easy case (Orbax's own metadata checks catch it); flipped payload
+    bytes restore cleanly and only the digest comparison can tell.
+    """
+    step_dir = Path(step_dir)
+    files = [p for p in step_dir.rglob("*") if p.is_file()]
+    if not files:
+        raise FileNotFoundError(f"no files to corrupt under {step_dir}")
+    target = max(files, key=lambda p: p.stat().st_size)
+    size = target.stat().st_size
+    offset = size // 4
+    span = max(1, min(span, size - offset))
+    with open(target, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(span)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
+    return target

@@ -25,7 +25,35 @@ from typing import Any
 import jax
 from jax import lax
 
-__all__ = ["axis_size", "shard_map", "pcast", "tpu_compiler_params"]
+__all__ = [
+    "axis_size",
+    "buffer_donation_supported",
+    "pcast",
+    "shard_map",
+    "tpu_compiler_params",
+]
+
+
+def buffer_donation_supported() -> bool:
+    """Whether ``jit`` buffer donation is safe on this backend configuration.
+
+    False on XLA:CPU when the persistent compilation cache is enabled:
+    executing a cache-DESERIALIZED executable with donated inputs after an
+    in-process orbax/tensorstore checkpoint restore corrupts the native
+    heap — segfault or ``malloc()`` abort inside
+    ``ThunkExecutor::ProcessOutEdges`` (jaxlib 0.4.36; reproduced with a
+    30-line jit+orbax script; fresh-compiled executables and non-donating
+    deserialized ones are both immune). That sequence is exactly crash
+    auto-resume — train, crash, restore, retrain — under a warm compile
+    cache, the configuration the test suite runs. Donation is a memory
+    optimization, never semantics, so the guard costs only transient
+    buffers on the backend where model state is smallest; TPU/GPU and
+    cache-less CPU runs keep donating.
+    """
+    return not (
+        jax.default_backend() == "cpu"
+        and jax.config.jax_compilation_cache_dir
+    )
 
 _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 if _NEW_SHARD_MAP is None:

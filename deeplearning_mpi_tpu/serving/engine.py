@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning_mpi_tpu.runtime.compat import buffer_donation_supported
 from deeplearning_mpi_tpu.models.transformer import (
     TransformerConfig,
     apply_rope,
@@ -124,6 +125,7 @@ class ServingEngine:
         eos_id: Optional[int] = None,
         clock: Callable[[], float] = time.monotonic,
         registry: Any = None,
+        chaos: Any = None,
     ) -> None:
         engine = engine or EngineConfig()
         if config.moe_experts > 0:
@@ -149,12 +151,14 @@ class ServingEngine:
         self.dtype = dtype
         self.eos_id = eos_id
         self._clock = clock
+        self.chaos = chaos
         self.pool = PagedKVPool(engine.num_blocks, engine.block_size)
         self.scheduler = Scheduler(
             self.pool,
             max_slots=engine.max_slots,
             max_seq_len=engine.max_seq_len,
             max_queue=engine.max_queue,
+            registry=registry,
         )
         self._k, self._v = init_kv_buffers(
             config.num_layers, engine.num_blocks, engine.block_size,
@@ -168,7 +172,8 @@ class ServingEngine:
                 "serve_requests_submitted", "serve_requests_admitted",
                 "serve_requests_completed", "serve_requests_shed",
                 "serve_tokens_generated", "serve_prefill_chunks",
-                "serve_decode_steps",
+                "serve_decode_steps", "serve_requeued_total",
+                "serve_tokens_discarded_total",
             ):
                 registry.counter(name)
             for name in (
@@ -178,8 +183,14 @@ class ServingEngine:
                 registry.gauge(name)
             registry.histogram("serve_ttft_s")
             registry.histogram("serve_tpot_s")
-        self._decode_fn = jax.jit(self._decode_step, donate_argnums=(1, 2))
-        self._prefill_fn = jax.jit(self._prefill_chunk, donate_argnums=(1, 2))
+        # KV-cache donation, vetoed where unsafe (XLA:CPU + persistent
+        # compile cache — compat.buffer_donation_supported): the engine
+        # restores weights from disk and then runs these jitted steps, the
+        # exact restore-then-execute sequence that corrupts the heap with
+        # donated cache-deserialized executables.
+        kv_donate = (1, 2) if buffer_donation_supported() else ()
+        self._decode_fn = jax.jit(self._decode_step, donate_argnums=kv_donate)
+        self._prefill_fn = jax.jit(self._prefill_chunk, donate_argnums=kv_donate)
 
     # -- public API ---------------------------------------------------------
     def submit(
@@ -224,6 +235,13 @@ class ServingEngine:
         for req in list(self.scheduler.running()):
             if req.state is RequestState.PREFILL:
                 self._prefill_one(req, finished)
+
+        if self.chaos is not None:
+            # Mid-step, after prefill has already mutated host + device
+            # state — the nastiest crash point: admitted requests hold
+            # blocks, partial prefills sit in the KV pool, the step never
+            # completes. recover() must untangle exactly this.
+            self.chaos.check_serve_crash(step=self.steps)
 
         # Feeding a token at position length-1 writes its K/V there, so a
         # slot needs blocks_for(length) blocks BEFORE the step; growth is
@@ -271,17 +289,70 @@ class ServingEngine:
         return finished
 
     def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
-        """Step until queue and slots drain; returns everything finished."""
+        """Step until queue and slots drain; returns everything finished.
+
+        Injected crashes (:class:`~..resilience.faults.InjectedFault`) are
+        recovered in place and the loop continues — each planned fault
+        fires exactly once, so this cannot spin. Requests that FINISHED
+        during the crashed step stay finished on their own objects (the
+        step's return value was lost with the exception; callers assert on
+        request state, not on this list, for those).
+        """
+        from deeplearning_mpi_tpu.resilience.faults import InjectedFault
+
         finished: list[Request] = []
         steps = 0
         while not self.scheduler.idle():
-            finished.extend(self.step())
+            try:
+                finished.extend(self.step())
+            except InjectedFault as err:
+                print(f"serving: {err} — recovering")
+                self.recover()
             steps += 1
             if steps > max_steps:
                 raise RuntimeError(
                     f"engine did not drain within {max_steps} steps"
                 )
         return finished
+
+    def recover(self) -> dict[str, int]:
+        """Crash recovery: requeue every in-flight sequence and rebuild the
+        KV pool's free list against scheduler ground truth.
+
+        In-flight (PREFILL or DECODE) sequences restart from their prompt:
+        after a mid-step crash the engine cannot prove which KV writes
+        landed, and re-prefilling from scratch is the only state that is
+        both trustworthy and deterministic — it keeps recovered greedy
+        completions bit-identical to offline decode. Already-generated
+        tokens are discarded (counted in ``serve_tokens_discarded_total``).
+        Stale KV rows left by the crashed step are harmless once the pool
+        is reconciled: re-prefill overwrites its own pages, and recycled
+        blocks' leftover rows sit past every valid position, causally
+        masked (the same argument as normal block reuse).
+
+        Requeue order preserves FCFS: running requests (admitted earlier
+        than anything still queued) are pushed to the queue front,
+        newest-arrival first, so the front ends up oldest-first.
+        """
+        inflight = sorted(self.scheduler.running(), key=lambda r: (r.arrival, r.rid))
+        discarded = sum(len(r.generated) for r in inflight)
+        for req in reversed(inflight):
+            self.scheduler.requeue(req)
+        # No sequence owns verified blocks after requeue — free everything.
+        stats = self.pool.reconcile(())
+        self.pool.check()
+        self._inc("serve_requeued_total", len(inflight))
+        self._inc("serve_tokens_discarded_total", discarded)
+        if self.chaos is not None:
+            self.chaos.record_recovery("serve_crash")
+        self._set_gauges()
+        out = {"requeued": len(inflight), "tokens_discarded": discarded, **stats}
+        print(
+            f"serving: recovered — requeued {out['requeued']} in-flight "
+            f"request(s), reclaimed {stats['reclaimed']} KV block(s), "
+            f"discarded {discarded} token(s)"
+        )
+        return out
 
     # -- prefill ------------------------------------------------------------
     def _prefill_one(self, req: Request, finished: list[Request]) -> None:

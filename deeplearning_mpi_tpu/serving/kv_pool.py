@@ -114,6 +114,35 @@ class PagedKVPool:
             self._free.append(b)
             self.total_freed += 1
 
+    def reconcile(self, live_blocks: Iterable[int]) -> dict[str, int]:
+        """Rebuild the free list from the ground truth of which blocks are
+        still owned by live sequences (crash recovery).
+
+        After a mid-step crash the pool's incremental accounting can
+        disagree with scheduler state in both directions — blocks a
+        requeued sequence abandoned (leaked: used here, owned by nobody)
+        and blocks the crash interrupted mid-alloc (orphaned: owned by a
+        sequence, missing from ``_used``). Instead of patching case by
+        case, rebuild: ``live_blocks`` becomes the used set and everything
+        else becomes free. Returns ``{"reclaimed": leaked, "adopted":
+        orphaned}`` for the recovery log; :meth:`check` passes by
+        construction afterwards.
+        """
+        live = set(live_blocks)
+        if SCRATCH_BLOCK in live:
+            raise ValueError("scratch block claimed as live")
+        bad = [b for b in live if not (0 < b < self.num_blocks)]
+        if bad:
+            raise ValueError(f"live block ids out of range: {bad}")
+        reclaimed = self._used - live
+        adopted = live - self._used
+        self.total_freed += len(reclaimed)
+        self.total_allocated += len(adopted)
+        self._used = set(live)
+        all_ids = set(range(SCRATCH_BLOCK + 1, self.num_blocks))
+        self._free = sorted(all_ids - live, reverse=True)
+        return {"reclaimed": len(reclaimed), "adopted": len(adopted)}
+
     # -- invariants ---------------------------------------------------------
     def check(self) -> None:
         """Raise AssertionError if any pool invariant is violated."""

@@ -37,7 +37,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 from collections import deque
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -119,6 +119,7 @@ class Scheduler:
         max_slots: int,
         max_seq_len: int,
         max_queue: int = 64,
+        registry: Any = None,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -126,10 +127,14 @@ class Scheduler:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.max_queue = max_queue
+        self.registry = registry
         self.queue: deque[Request] = deque()
         self.slots: list[Optional[Request]] = [None] * max_slots
         self.shed_count = 0
         self.evicted_count = 0
+        if registry is not None:
+            # Pre-create so a shed-free run still reports an explicit 0.
+            registry.counter("serve_shed_total")
 
     # -- submission ---------------------------------------------------------
     def submit(self, req: Request) -> bool:
@@ -241,7 +246,31 @@ class Scheduler:
         if req.slot is not None:
             self.slots[req.slot] = None
 
+    def requeue(self, req: Request) -> None:
+        """Return a running request to the FRONT of the queue (crash
+        recovery): its slot is vacated and its progress reset so the next
+        admission prefills from scratch — partially-written KV pages can't
+        be trusted after a mid-step crash, and restarting from the prompt
+        is what keeps recovered completions bit-identical to offline greedy
+        decode. Block ownership is NOT released here; the engine reconciles
+        the whole pool in one pass afterwards (``PagedKVPool.reconcile``)."""
+        if req.slot is not None:
+            self.slots[req.slot] = None
+        req.slot = None
+        req.blocks = []
+        req.generated = []
+        req.prefilled = 0
+        req.state = RequestState.QUEUED
+        req.t_admitted = None
+        req.t_first_token = None
+        self.queue.appendleft(req)
+
     def _shed(self, req: Request, reason: str) -> None:
         req.state = RequestState.SHED
         req.shed_reason = reason
         self.shed_count += 1
+        if self.registry is not None:
+            from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+            self.registry.counter("serve_shed_total").inc()
+            self.registry.counter(labeled("serve_shed_total", reason=reason)).inc()

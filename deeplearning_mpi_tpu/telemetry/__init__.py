@@ -23,6 +23,7 @@ from deeplearning_mpi_tpu.telemetry.registry import (
     LoggerSink,
     MetricsRegistry,
     TensorBoardSink,
+    labeled,
 )
 from deeplearning_mpi_tpu.telemetry.trace import annotate, annotate_fn
 
@@ -34,4 +35,5 @@ __all__ = [
     "TensorBoardSink",
     "annotate",
     "annotate_fn",
+    "labeled",
 ]

@@ -47,6 +47,20 @@ def _jsonable(v: Any) -> Any:
     return f if math.isfinite(f) else None
 
 
+def labeled(name: str, **labels: str) -> str:
+    """Prometheus-style labeled instrument name: ``name{k="v",...}``.
+
+    The registry keys instruments by plain string, so labels are an encoding
+    convention, not a type: ``labeled("serve_shed_total", reason="deadline")``
+    → ``serve_shed_total{reason="deadline"}``. Keys are sorted so the same
+    label set always maps to the same instrument, whatever the call-site
+    spelling. The base (unlabeled) counter is maintained separately by
+    callers — `snapshot()` reports both.
+    """
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
 class Counter:
     """Monotonically increasing count (steps run, tokens seen, bytes moved)."""
 

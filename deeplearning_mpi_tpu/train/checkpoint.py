@@ -20,6 +20,13 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from deeplearning_mpi_tpu.resilience.integrity import (
+    CheckpointCorruption,
+    corrupt_checkpoint,
+    dir_digests,
+    read_manifest,
+    write_manifest,
+)
 from deeplearning_mpi_tpu.train.state import TrainState
 
 
@@ -29,10 +36,45 @@ class Checkpointer:
     The epoch is stored as the checkpoint step label, so resume can continue
     the epoch loop where it stopped — unlike the reference, which always
     restarts at epoch 0 with a fresh optimizer.
+
+    Two layers of durability (``docs/RESILIENCE.md``):
+
+    - **Atomicity + retention** — Orbax writes each step into a temporary
+      directory and renames it into place on commit, so a mid-save kill
+      leaves the previous step intact, never a half-written latest; the
+      manager's ``max_to_keep`` bounds history instead of growing without
+      limit (the reference overwrote one ``.pth`` in place — atomic never,
+      history never).
+    - **Integrity manifests** — every save also writes a sha256-per-file
+      manifest of the committed step beside the step dir (atomic write,
+      :mod:`..resilience.integrity`), and :meth:`restore_verified`
+      re-hashes the files BEFORE asking Orbax to read them, rolling back
+      to the newest step whose digests match. File-level verification is
+      load-bearing twice over: corrupt bytes never reach tensorstore's
+      chunk decoder (a mid-read decompression failure has been observed to
+      poison the process), and hashing the files requires the async write
+      to have landed, which closes a donated-buffer race (see
+      :meth:`save`). Manifests are single-process-only (``integrity``
+      auto-disables on multi-host, where hosts write disjoint shards);
+      steps without a manifest (pre-integrity history) restore unverified
+      rather than failing.
+
+    ``chaos`` accepts a :class:`~..resilience.faults.ChaosInjector`; a
+    planned ``corrupt_ckpt@epoch:N`` flips bytes inside the just-committed
+    step so the verify-and-roll-back path is tested against real damage.
     """
 
-    def __init__(self, directory: str | Path, *, max_to_keep: int = 3) -> None:
+    def __init__(
+        self,
+        directory: str | Path,
+        *,
+        max_to_keep: int = 3,
+        chaos: Any = None,
+        integrity: bool = True,
+    ) -> None:
         self.directory = Path(directory).absolute()
+        self.chaos = chaos
+        self.integrity = integrity and jax.process_count() == 1
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -44,14 +86,107 @@ class Checkpointer:
         # Static fields (apply_fn, tx) are not data; persist arrays only.
         # Async: Orbax serializes in the background while training continues;
         # ordering across saves is the manager's job, and close() (and any
-        # restore) barriers before process exit. Blocking here would idle the
-        # devices for the full sharded-write duration every cadence.
+        # restore) barriers before process exit.
         self.manager.save(
             epoch, args=ocp.args.StandardSave(_arrays_only(state))
         )
+        if self.integrity:
+            # Barrier, then hash the committed files. The wait is
+            # correctness, not just sequencing: the trainer DONATES the
+            # state into the next step (trainer.py donate_argnums), and on
+            # CPU a jax array is a zero-copy view of the XLA buffer — an
+            # async serializer still holding views when the next step
+            # reuses those buffers in place writes the *future* state's
+            # bytes into this epoch's files (observed under suite load as
+            # every digest mismatching on restore). Single-process only,
+            # so multi-host TPU keeps the fully-async cadence.
+            self.manager.wait_until_finished()
+            write_manifest(
+                self.directory, epoch,
+                dir_digests(self.directory / str(epoch)),
+            )
+            self._prune_manifests(keep_also=epoch)
+        if self.chaos is not None and self.chaos.should_corrupt(epoch=epoch):
+            # Chaos: damage the committed step. Must barrier first — flipping
+            # bytes under an in-flight async writer tests a race, not
+            # integrity checking. (The corruption lands AFTER the manifest
+            # was written, so restore sees a mismatch — the point.)
+            self.manager.wait_until_finished()
+            victim = corrupt_checkpoint(self.directory / str(epoch))
+            print(f"chaos: corrupted checkpoint epoch {epoch} ({victim.name})")
 
     def latest_epoch(self) -> int | None:
         return self.manager.latest_step()
+
+    def _prune_manifests(self, *, keep_also: int | None = None) -> None:
+        """Drop manifests for steps the manager has retired, so retention
+        bounds the manifest files the same way it bounds step dirs. The
+        just-saved epoch may not appear in ``all_steps()`` until its async
+        commit lands — keep it explicitly."""
+        keep = set(self.manager.all_steps())
+        if keep_also is not None:
+            keep.add(keep_also)
+        for mf in self.directory.glob("manifest-*.json"):
+            try:
+                epoch = int(mf.stem.split("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if epoch not in keep:
+                mf.unlink(missing_ok=True)
+
+    def restore_verified(
+        self, template: TrainState
+    ) -> tuple[TrainState, int]:
+        """Restore the newest checkpoint that passes digest verification,
+        walking backward past corrupted steps; returns ``(state, epoch)``.
+
+        Per candidate, newest first: the step's files are re-hashed against
+        its manifest FIRST — a mismatch never reaches Orbax's decoder (a
+        tensorstore read of corrupt compressed chunks is a process hazard,
+        not a clean exception) — and a restore that *raises* anyway (torn
+        metadata, missing arrays) is treated the same way. Both are
+        corruption — recorded as a rollback when a chaos injector planned
+        it — and the walk continues. A step with no manifest restores
+        unverified (legacy history). Exhausting every step raises
+        :class:`CheckpointCorruption`: starting over from init is the
+        caller's policy decision, not this method's.
+        """
+        self.manager.wait_until_finished()
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        for epoch in steps:
+            if self.integrity:
+                manifest = read_manifest(self.directory, epoch)
+                if manifest is not None:
+                    actual = dir_digests(self.directory / str(epoch))
+                    if actual != manifest:
+                        bad = sorted(
+                            set(manifest) ^ set(actual)
+                            | {k for k in manifest if actual.get(k) != manifest[k]}
+                        )
+                        self._note_corrupt(
+                            epoch,
+                            f"digest mismatch in {len(bad)} file(s), e.g. {bad[0]}",
+                        )
+                        continue
+            try:
+                restored = self.manager.restore(
+                    epoch, args=ocp.args.StandardRestore(_arrays_only(template))
+                )
+            except Exception as err:  # noqa: BLE001 — unreadable = corrupt
+                self._note_corrupt(epoch, f"restore failed: {err}")
+                continue
+            return template.replace(**restored), epoch
+        raise CheckpointCorruption(
+            f"no checkpoint under {self.directory} survived verification "
+            f"(tried epochs {steps})"
+        )
+
+    def _note_corrupt(self, epoch: int, why: str) -> None:
+        print(f"checkpoint epoch {epoch} CORRUPT — rolling back ({why})")
+        if self.chaos is not None:
+            self.chaos.record_rollback("corrupt_ckpt", at=epoch)
 
     def restore(self, template: TrainState, *, epoch: int | None = None) -> TrainState:
         """Restore into the shardings/dtypes of ``template`` (a freshly

@@ -1,183 +1,17 @@
-"""Failure detection and recovery — first-class where the reference has none.
+"""Back-compat shim — the resilience surface moved to a first-class package.
 
-The reference's failure story (``SURVEY.md`` §5.3) is: a ``try/finally
-destroy_process_group``, one catch-all ``except Exception: print`` that makes
-failed runs exit 0 (``pytorch/unet/train.py:272-273`` — an explicit
-bug-not-to-replicate), and manual restart with ``--resume`` reloading weights
-only. Here recovery is automatic and honest:
-
-- :func:`run_with_auto_resume` — supervised training: on a crash it restores
-  the latest full checkpoint (step + optimizer state, not just weights) and
-  continues from the epoch after it; after ``max_restarts`` failures it
-  re-raises, so orchestrators see a real non-zero exit (failing loudly is the
-  documented fix for the reference's swallow-and-exit-0).
-- :class:`Heartbeat` — a background thread touching a JSON heartbeat file
-  every few seconds with step/epoch progress; external watchdogs (or a
-  colocated shell loop) detect hangs — e.g. a wedged collective — by file
-  age, the standard liveness probe a TPU pod job needs because a deadlocked
-  XLA collective blocks forever rather than crashing.
-- :func:`preflight` — early, specific failures for the conditions the
-  reference checks ad hoc at startup (data/log/model dirs + CUDA:
-  ``pytorch/unet/train.py:295-308,349-352``), plus mesh divisibility.
+``Heartbeat``, ``preflight``, ``run_with_auto_resume``, and
+``TrainingFailure`` now live in :mod:`deeplearning_mpi_tpu.resilience`
+(``supervisor.py``), alongside the chaos harness, checkpoint integrity,
+preemption handling, and the loader watchdog that grew around them. Import
+from the package; this module only keeps old import paths working.
 """
 
-from __future__ import annotations
+from deeplearning_mpi_tpu.resilience.supervisor import (  # noqa: F401
+    Heartbeat,
+    TrainingFailure,
+    preflight,
+    run_with_auto_resume,
+)
 
-import json
-import os
-import threading
-import time
-from pathlib import Path
-from typing import Any, Callable
-
-import jax
-
-
-class TrainingFailure(RuntimeError):
-    """Raised when training exhausted its restart budget."""
-
-
-def run_with_auto_resume(
-    fit: Callable[[int], Any],
-    checkpointer: Any,
-    *,
-    max_restarts: int = 2,
-    logger: Any = None,
-    restart_delay_s: float = 5.0,
-) -> Any:
-    """Run ``fit(start_epoch)``, auto-restarting from checkpoints on failure.
-
-    ``fit`` must itself restore state from ``checkpointer`` for a given start
-    epoch (the CLIs' resume path already does exactly this). Keyboard
-    interrupts are never retried; after ``max_restarts`` retries the last
-    exception propagates wrapped in :class:`TrainingFailure`.
-    """
-    log = logger.log if logger is not None else print
-    attempt = 0
-    while True:
-        start_epoch = 0
-        if attempt > 0:
-            latest = checkpointer.latest_epoch()
-            start_epoch = latest + 1 if latest is not None else 0
-            log(
-                f"auto-resume: restart {attempt}/{max_restarts} from epoch "
-                f"{start_epoch} (checkpoint epoch {latest})"
-            )
-        try:
-            return fit(start_epoch)
-        except KeyboardInterrupt:
-            raise
-        except Exception as err:  # noqa: BLE001 — this IS the failure handler
-            attempt += 1
-            log(f"training failed (attempt {attempt}): {type(err).__name__}: {err}")
-            if attempt > max_restarts:
-                raise TrainingFailure(
-                    f"training failed after {max_restarts} restarts"
-                ) from err
-            time.sleep(restart_delay_s)
-
-
-class Heartbeat:
-    """Background liveness probe: a JSON file rewritten every ``interval_s``.
-
-    External watchdogs alarm when ``now - mtime`` exceeds a few intervals —
-    catching wedged collectives that neither crash nor progress. Update
-    :attr:`progress` (any JSON-serializable dict) from the training loop;
-    thread-safety is a simple attribute swap.
-    """
-
-    def __init__(self, path: str | Path, *, interval_s: float = 10.0) -> None:
-        self.path = Path(path)
-        self.interval_s = interval_s
-        self.progress: dict[str, Any] = {}
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-
-    def start(self) -> "Heartbeat":
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-        return self
-
-    def _beat(self) -> None:
-        payload = {
-            "time": time.time(),
-            "pid": os.getpid(),
-            "process_index": jax.process_index(),
-            **self.progress,
-        }
-        tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(payload))
-        os.replace(tmp, self.path)  # atomic: readers never see partial JSON
-
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            try:
-                self._beat()
-            except OSError:
-                pass  # disk hiccups must not kill the training process
-            self._stop.wait(self.interval_s)
-
-    def stop(self) -> None:
-        if self._thread is not None:
-            self._stop.set()
-            self._thread.join(timeout=self.interval_s + 1)
-            self._thread = None
-
-    def __enter__(self) -> "Heartbeat":
-        return self.start()
-
-    def __exit__(self, *exc: Any) -> None:
-        self.stop()
-
-
-def preflight(
-    *,
-    data_dir: str | None = None,
-    model_dir: str | None = None,
-    log_dir: str | None = None,
-    global_batch_size: int | None = None,
-    mesh: Any = None,
-    grad_accum: int = 1,
-) -> None:
-    """Fail fast with specific messages before any compilation starts.
-
-    Parity-plus over the reference's startup checks
-    (``pytorch/unet/train.py:295-308,349-352``): existence checks carry the
-    fix in the message, and batch/mesh divisibility — the reference's
-    runtime crash class — is validated up front.
-    """
-    problems: list[str] = []
-    if data_dir is not None and not Path(data_dir).is_dir():
-        problems.append(f"data directory '{data_dir}' does not exist")
-    for name, d in (("model", model_dir), ("log", log_dir)):
-        if d is not None:
-            try:
-                Path(d).mkdir(parents=True, exist_ok=True)
-            except OSError as err:
-                problems.append(f"cannot create {name} dir '{d}': {err}")
-    if global_batch_size is not None and mesh is not None:
-        import math
-
-        from deeplearning_mpi_tpu.runtime.mesh import data_axes
-
-        dp = math.prod(mesh.shape[a] for a in data_axes(mesh))
-        if global_batch_size % dp:
-            problems.append(
-                f"global batch {global_batch_size} not divisible by "
-                f"data-parallel degree {dp}"
-            )
-        if grad_accum > 1:
-            if global_batch_size % grad_accum:
-                problems.append(
-                    f"global batch {global_batch_size} not divisible by "
-                    f"grad_accum {grad_accum}"
-                )
-            elif (global_batch_size // grad_accum) % dp:
-                problems.append(
-                    f"per-chunk batch {global_batch_size // grad_accum} "
-                    f"(global {global_batch_size} / grad_accum {grad_accum}) "
-                    f"not divisible by data-parallel degree {dp}"
-                )
-    if problems:
-        raise SystemExit("preflight failed:\n  - " + "\n  - ".join(problems))
+__all__ = ["Heartbeat", "TrainingFailure", "preflight", "run_with_auto_resume"]

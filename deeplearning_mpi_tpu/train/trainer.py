@@ -35,6 +35,8 @@ import optax
 from jax.sharding import Mesh
 
 from deeplearning_mpi_tpu.data.loader import prefetch
+from deeplearning_mpi_tpu.resilience.preemption import Preempted
+from deeplearning_mpi_tpu.runtime.compat import buffer_donation_supported
 from deeplearning_mpi_tpu.models.moe import (
     AUX_COLLECTION,
     METRIC_COLLECTION,
@@ -177,6 +179,11 @@ def make_train_step(
     ``create_train_state(..., ema=True)``. A NaN-skipped step leaves the
     EMA untouched along with everything else.
     """
+    # Donation is vetoed wholesale where it is unsafe (XLA:CPU + persistent
+    # compile cache — see compat.buffer_donation_supported), not per caller:
+    # a donated deserialized executable corrupts the heap after a checkpoint
+    # restore, which is precisely the auto-resume path.
+    donate = donate and buffer_donation_supported()
     loss_fn = (
         _lm_loss_chunked(loss_chunk) if task == "lm" and loss_chunk > 0
         else _task_loss(task, seg_loss=seg_loss)
@@ -522,6 +529,8 @@ class Trainer:
         metrics_every: int = 1,  # record every Nth step's scalars (0 = off)
         flops_per_step: float | None = None,  # analytic train FLOPs -> MFU
         comm_bytes_per_step: float | None = None,  # static collective bytes
+        chaos: Any = None,  # resilience.ChaosInjector; injects planned faults
+        shutdown: Any = None,  # resilience.GracefulShutdown; batch-boundary stop
     ) -> None:
         from deeplearning_mpi_tpu.telemetry.registry import (
             LoggerSink,
@@ -551,6 +560,8 @@ class Trainer:
         self.metrics_every = metrics_every
         self.flops_per_step = flops_per_step
         self.comm_bytes_per_step = comm_bytes_per_step
+        self.chaos = chaos
+        self.shutdown = shutdown
         # Host-side step counter: int(state.step) would force a device sync.
         self._global_step = 0
         self._step_kwargs = dict(
@@ -581,41 +592,77 @@ class Trainer:
         n_batches = 0
         images = 0
         timer = StepTimer(sync_every=25) if self.time_steps else None
-        for batch in prefetch(loader.epoch(epoch)):
-            if self.profiler is not None and not self._profiled:
-                if n_batches == self.PROFILE_STEPS[0]:
-                    self.profiler.start()
-                elif n_batches == self.PROFILE_STEPS[1]:
-                    self.profiler.stop()
-                    self._profiled = True
-            with annotate("trainer/train_step"):
-                self.state, metrics = self.train_step(self.state, batch)
-            if timer is not None:
-                timer.tick(metrics["loss"])
-            if self.metrics_every and self._global_step % self.metrics_every == 0:
-                # Buffers the DEVICE scalars; no fetch until flush_steps.
-                self.metrics.record_step(self._global_step, metrics)
-            self._global_step += 1
-            if self.heartbeat is not None:
-                self.heartbeat.progress = {"epoch": epoch, "step_in_epoch": n_batches}
-            # Accumulate on device, excluding non-finite batches from the mean
-            # (the reference `continue`s before accumulating epoch loss,
-            # pytorch/unet/train.py:186-188) — one NaN batch must not poison
-            # the epoch stat while the guarded step correctly skipped it.
-            contrib = jnp.where(metrics["finite"] > 0, metrics["loss"], 0.0)  # NaN*0 is NaN
-            loss_sum = contrib if loss_sum is None else loss_sum + contrib
-            finite_sum = (
-                metrics["finite"] if finite_sum is None
-                else finite_sum + metrics["finite"]
-            )
-            if "moe_dropped_frac" in metrics:
-                d = metrics["moe_dropped_frac"]
-                drop_sum = d if drop_sum is None else drop_sum + d
-            n_batches += 1
-            images += batch[_INPUTS[self.task]].shape[0]
+        preempted = False
+        batches = prefetch(loader.epoch(epoch))
+        try:
+            for batch in batches:
+                # Preemption check at the batch boundary — never inside a jitted
+                # step (a dispatched XLA program can't be interrupted). The
+                # caller (fit) takes the graceful checkpoint.
+                if self.shutdown is not None and self.shutdown.requested():
+                    preempted = True
+                    break
+                if self.chaos is not None:
+                    # Kill BEFORE the step: kill@step:N means exactly N steps ran.
+                    self.chaos.check_kill(step=self._global_step)
+                    # NaN poisoning rides the batch; the jitted step's own
+                    # finite-guard — not the injector — must skip the update.
+                    batch = self.chaos.maybe_poison(batch, self.task, step=self._global_step)
+                if self.profiler is not None and not self._profiled:
+                    if n_batches == self.PROFILE_STEPS[0]:
+                        self.profiler.start()
+                    elif n_batches == self.PROFILE_STEPS[1]:
+                        self.profiler.stop()
+                        self._profiled = True
+                with annotate("trainer/train_step"):
+                    self.state, metrics = self.train_step(self.state, batch)
+                if timer is not None:
+                    timer.tick(metrics["loss"])
+                if self.metrics_every and self._global_step % self.metrics_every == 0:
+                    # Buffers the DEVICE scalars; no fetch until flush_steps.
+                    self.metrics.record_step(self._global_step, metrics)
+                self._global_step += 1
+                if self.heartbeat is not None:
+                    self.heartbeat.progress = {"epoch": epoch, "step_in_epoch": n_batches}
+                # Accumulate on device, excluding non-finite batches from the mean
+                # (the reference `continue`s before accumulating epoch loss,
+                # pytorch/unet/train.py:186-188) — one NaN batch must not poison
+                # the epoch stat while the guarded step correctly skipped it.
+                contrib = jnp.where(metrics["finite"] > 0, metrics["loss"], 0.0)  # NaN*0 is NaN
+                loss_sum = contrib if loss_sum is None else loss_sum + contrib
+                finite_sum = (
+                    metrics["finite"] if finite_sum is None
+                    else finite_sum + metrics["finite"]
+                )
+                if "moe_dropped_frac" in metrics:
+                    d = metrics["moe_dropped_frac"]
+                    drop_sum = d if drop_sum is None else drop_sum + d
+                n_batches += 1
+                images += batch[_INPUTS[self.task]].shape[0]
+        finally:
+            # Deterministic teardown, never GC-time: when anything escapes
+            # the loop (injected kill, preemption break, a crash), the
+            # prefetch producer must be STOPPED AND JOINED before the
+            # caller checkpoints or restores — a producer still inside
+            # device_put concurrently with restore/retrain corrupts the
+            # process. close() runs prefetch's stop-join finally.
+            batches.close()
         if not n_batches:
+            if preempted:
+                # Shutdown arrived before the first batch — nothing trained,
+                # nothing to average; fit still checkpoints and exits.
+                return {
+                    "epoch": epoch,
+                    "loss": float("nan"),
+                    "duration_s": time.perf_counter() - t0,
+                    "images_per_s": 0.0,
+                }
             raise ValueError("empty epoch — dataset smaller than one global batch")
         n_finite = float(finite_sum)  # one host sync per epoch
+        if self.chaos is not None:
+            # The guard's skip count is the evidence that injected NaN batches
+            # were actually rejected — that confirmation IS the recovery.
+            self.chaos.reconcile_nan_recoveries(n_batches - int(n_finite))
         # All-non-finite epoch: report NaN, not a perfect-looking 0.0 — no
         # optimizer step ran, and downstream best-checkpoint selection must
         # not read the epoch as converged.
@@ -702,12 +749,16 @@ class Trainer:
         """
         sums: dict[str, jax.Array] = {}
         weight: jax.Array | None = None
-        for batch in prefetch(loader.epoch(0)):
-            metrics = self.eval_step(self.state, batch)
-            w = metrics.pop("weight")  # real (non-padded) examples this batch
-            for k, v in metrics.items():
-                sums[k] = sums[k] + v * w if k in sums else v * w
-            weight = w if weight is None else weight + w
+        batches = prefetch(loader.epoch(0))
+        try:
+            for batch in batches:
+                metrics = self.eval_step(self.state, batch)
+                w = metrics.pop("weight")  # real (non-padded) examples this batch
+                for k, v in metrics.items():
+                    sums[k] = sums[k] + v * w if k in sums else v * w
+                weight = w if weight is None else weight + w
+        finally:
+            batches.close()  # join the producer even when a batch crashes
         if weight is None or not float(weight):
             raise ValueError("empty eval loader")
         means = {k: float(v) / float(weight) for k, v in sums.items()}
@@ -734,6 +785,19 @@ class Trainer:
         last_evaled = last_saved = -1
         for epoch in range(start_epoch, num_epochs):
             stats = self.run_epoch(train_loader, epoch)
+            if self.shutdown is not None and self.shutdown.requested():
+                # Graceful preemption: one final checkpoint at wherever we
+                # are, the epoch record still lands, then a CLEAN distinct
+                # exit — Preempted must not burn an auto-resume restart.
+                if self.checkpointer is not None:
+                    self.checkpointer.save(self.state, epoch=epoch)
+                self.history.append(stats)
+                self._log_metrics("epoch", stats)
+                self._log(
+                    f"shutdown requested: final checkpoint saved at epoch "
+                    f"{epoch}, exiting cleanly"
+                )
+                raise Preempted(epoch)
             if epoch % self.eval_every == 0:
                 if eval_loader is not None:
                     eval_metrics = self.evaluate(eval_loader)

@@ -121,6 +121,23 @@ def add_training_flags(
                        "sinks (0 = per-step records off; epoch records always "
                        "flow)")
     group.add_argument("--max_restarts", type=int, default=0, help="auto-resume from the latest checkpoint this many times on failure (0 = fail immediately; the reference's analog is manual restart with --resume)")
+    group.add_argument("--restart_delay_s", type=float, default=5.0,
+                       help="seconds to wait between auto-resume restarts "
+                       "(backoff before re-restoring)")
+    group.add_argument("--keep_checkpoints", type=int, default=3,
+                       help="retention: keep the last N checkpoints (orbax "
+                       "max_to_keep) — bounded history instead of unbounded "
+                       "growth; also how far back corrupted-checkpoint "
+                       "rollback can reach")
+    group.add_argument("--chaos", default=None,
+                       help="deterministic fault-injection plan, e.g. "
+                       "'nan_grad@step:7,loader_stall@batch:3,kill@step:12,"
+                       "corrupt_ckpt@epoch:1' (kinds: nan_grad/kill@step, "
+                       "loader_stall/loader_die@batch, corrupt_ckpt@epoch). "
+                       "Every fault fires exactly once; recovery is recorded "
+                       "in fault_injected_total / recovery_total / "
+                       "rollback_total. $DMT_CHAOS is the env fallback. See "
+                       "docs/RESILIENCE.md")
     group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
     group.add_argument("--num_workers", type=int, default=None,
                        help="loader fetch threads per host (default: half the "
@@ -190,16 +207,19 @@ def save_arch(cfg, ckpt_dir) -> None:
     resume, eval_only, generate).
     """
     import dataclasses
-    import json
     from pathlib import Path
 
     import jax
 
     if jax.process_index() != 0:
         return
+    from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
+
     path = Path(ckpt_dir)
     path.mkdir(parents=True, exist_ok=True)
-    (path / "arch.json").write_text(json.dumps(dataclasses.asdict(cfg)))
+    # Atomic: a kill during the write must not leave a truncated arch.json
+    # that poisons every later start with a JSON parse error.
+    atomic_write_json(path / "arch.json", dataclasses.asdict(cfg))
 
 
 def arch_mismatch_error(cfg, ckpt_dir) -> str | None:
@@ -268,24 +288,47 @@ def restore_for_start(args, checkpointer, state, logger):
     ``--eval_only`` is resume-or-die: evaluating a fresh random init would
     silently report garbage metrics, so a missing checkpoint is an error.
     ``--resume`` keeps the reference's lenient start-fresh behavior.
+
+    Both paths restore VERIFIED: the newest checkpoint whose integrity
+    manifest re-hashes clean, rolling back past corrupted steps
+    (``Checkpointer.restore_verified``; ``docs/RESILIENCE.md``).
     """
+    from deeplearning_mpi_tpu.resilience.integrity import CheckpointCorruption
+
     latest = checkpointer.latest_epoch()
     if getattr(args, "eval_only", False):
         if latest is None:
             raise SystemExit(
                 f"--eval_only: no checkpoint under {checkpointer.directory}"
             )
-        state = checkpointer.restore(state)
-        logger.log(f"eval-only: restored epoch {latest} (step {int(state.step)})")
-        return state, latest + 1
+        state, epoch = checkpointer.restore_verified(state)
+        logger.log(
+            f"eval-only: restored verified epoch {epoch} (step {int(state.step)})"
+        )
+        return state, epoch + 1
     if args.resume:
         if latest is None:
             logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
         else:
-            state = checkpointer.restore(state)
-            logger.log(f"resumed from epoch {latest} (step {int(state.step)})")
-            return state, latest + 1
+            try:
+                state, epoch = checkpointer.restore_verified(state)
+            except CheckpointCorruption as err:
+                # --resume is lenient about a MISSING checkpoint; stay
+                # consistent for an all-corrupt history: warn and start
+                # fresh rather than dying on a recoverable situation.
+                logger.log(f"--resume: {err}; starting fresh")
+                return state, 0
+            logger.log(f"resumed from verified epoch {epoch} (step {int(state.step)})")
+            return state, epoch + 1
     return state, 0
+
+
+def build_chaos(args: argparse.Namespace):
+    """Resolve ``--chaos`` (or ``$DMT_CHAOS``) into a ChaosInjector, or
+    ``None`` when no plan is set — the common case pays one None check."""
+    from deeplearning_mpi_tpu.resilience.faults import ChaosInjector
+
+    return ChaosInjector.from_spec(getattr(args, "chaos", None))
 
 
 def setup_runtime(args: argparse.Namespace):
@@ -393,8 +436,21 @@ def execute_training(
     initial TrainState for restarts that happen before the first checkpoint —
     required because the jitted step donates the state's buffers, so a crash
     mid-step leaves ``trainer.state`` deleted and unusable.
+
+    Resilience integration (``docs/RESILIENCE.md``): restart restores go
+    through ``restore_verified`` (corrupted checkpoints roll back; an
+    all-corrupt history restarts from init rather than dying), a SIGTERM
+    handler is installed so preemption exits via a graceful final
+    checkpoint (``Preempted`` — clean, never retried), and teardown emits
+    one ``run_summary`` record carrying every counter — including the
+    chaos reconciliation triple — before the sinks close.
     """
-    from deeplearning_mpi_tpu.train.resilience import run_with_auto_resume
+    from deeplearning_mpi_tpu.resilience import (
+        CheckpointCorruption,
+        GracefulShutdown,
+        Preempted,
+        run_with_auto_resume,
+    )
 
     if getattr(args, "eval_only", False):
         # The CLI upgraded --eval_only to a restore (resume-or-die): by here
@@ -419,6 +475,13 @@ def execute_training(
         # donated/deleted state and burn every restart on buffer errors.
         raise ValueError("--max_restarts requires a state_factory")
 
+    chaos = getattr(trainer, "chaos", None)
+    own_shutdown = trainer.shutdown is None
+    if own_shutdown:
+        # install() is a no-op off the main thread (degrades to manual
+        # request()); every training CLI gets SIGTERM grace for free.
+        trainer.shutdown = GracefulShutdown().install()
+
     attempts = 0
 
     def fit(restart_epoch: int):
@@ -426,14 +489,29 @@ def execute_training(
         attempts += 1
         if attempts > 1:
             # Crash restart: the previous state's buffers may be donated/
-            # deleted — ALWAYS rebuild, from the latest checkpoint when one
-            # exists, else from a fresh init.
+            # deleted — ALWAYS rebuild, from the newest checkpoint that
+            # passes integrity verification when one exists, else from a
+            # fresh init (an all-corrupt history restarts from scratch —
+            # losing progress beats dying with checkpoints on disk).
             if checkpointer.latest_epoch() is not None:
                 template = state_factory() if state_factory else trainer.state
-                trainer.state = checkpointer.restore(template)
+                try:
+                    trainer.state, epoch = checkpointer.restore_verified(template)
+                    # The VERIFIED epoch wins over the supervisor's
+                    # latest+1: a rollback past a corrupted newest step
+                    # must re-train the rolled-back epochs, not skip them.
+                    restart_epoch = epoch + 1
+                except CheckpointCorruption as err:
+                    trainer._log(f"restart: {err}; restarting from a fresh init")
+                    trainer.state = template  # already a fresh init
+                    restart_epoch = 0
             elif state_factory is not None:
                 trainer.state = state_factory()
             trainer.place_state()
+            if chaos is not None:
+                # Surviving the restart IS the kill's recovery (no-op when
+                # the crash wasn't an injected kill).
+                chaos.record_recovery("kill")
         return trainer.fit(
             train_loader, args.num_epochs,
             eval_loader=eval_loader, start_epoch=max(start_epoch, restart_epoch),
@@ -444,12 +522,26 @@ def execute_training(
             return run_with_auto_resume(
                 fit, checkpointer,
                 max_restarts=args.max_restarts, logger=trainer.logger,
+                restart_delay_s=getattr(args, "restart_delay_s", 5.0),
             )
         return fit(start_epoch)
+    except Preempted as p:
+        # Clean preemption: the final checkpoint is on disk; exit 0 so
+        # orchestrators reschedule instead of alerting on a crash.
+        trainer._log(f"exiting after preemption ({p})")
+        return trainer.history
     finally:
         if trainer.heartbeat is not None:
             trainer.heartbeat.stop()
         if trainer.profiler is not None:
             trainer.profiler.stop()  # finalize a trace left open by a crash
+        if own_shutdown and trainer.shutdown is not None:
+            trainer.shutdown.uninstall()
         if getattr(trainer, "metrics", None) is not None:
+            # One run_summary record with every counter/gauge/histogram —
+            # where the chaos triple (fault_injected_total == recovery_total
+            # + rollback_total) reconciles in the metrics report.
+            trainer.metrics.emit("run_summary", trainer.metrics.snapshot())
+            if chaos is not None:
+                trainer._log(chaos.summary())
             trainer.metrics.close()  # flush + close every telemetry sink

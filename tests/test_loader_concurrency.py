@@ -128,3 +128,86 @@ class TestErrorPropagation:
         n = len(produced)
         time.sleep(0.3)
         assert len(produced) == n  # producer stopped, not still draining
+
+
+class TestSentinelDelivery:
+    """The prefetch finally-block contract: the sentinel ALWAYS arrives (or
+    the consumer has left). A producer dying mid-epoch with the queue full
+    is the case a naive ``q.put(sentinel)`` would deadlock on and a naive
+    ``put_nowait`` would drop — either way the consumer's final ``q.get()``
+    hangs forever. The stop-aware retry loop must do neither."""
+
+    def test_producer_death_with_full_queue_no_hang_no_drop(self):
+        def source():
+            yield from range(3)
+            raise RuntimeError("worker died mid-epoch")
+
+        got, err = [], []
+
+        def consume():
+            gen = prefetch(source(), size=2)
+            try:
+                got.append(next(gen))  # starts the producer
+                # Producer fills the queue (1, 2) and dies; its finally
+                # block is now blocked trying to deliver the sentinel.
+                time.sleep(0.3)
+                for item in gen:
+                    got.append(item)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                err.append(e)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "consumer hung: sentinel was dropped"
+        assert got == [0, 1, 2]  # every pre-death item delivered first
+        assert isinstance(err[0], RuntimeError)
+        assert "worker died" in str(err[0])
+
+    def test_abandonment_unblocks_a_pending_sentinel_put(self):
+        def source():
+            yield from range(5)
+            raise RuntimeError("late failure")
+
+        baseline = threading.active_count()
+        gen = prefetch(source(), size=1)
+        assert next(gen) == 0
+        time.sleep(0.2)  # producer blocked on a full queue
+        gen.close()  # consumer leaves: stop flag must break the retry loop
+        deadline = time.monotonic() + 5.0
+        while threading.active_count() > baseline and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= baseline
+
+    def test_loader_worker_death_mid_epoch_through_prefetch(self, mesh):
+        """Composition check: a dataset worker dying inside ShardedLoader's
+        pipelined assembly must surface through prefetch() — the chain the
+        trainer actually runs — without hanging either layer."""
+
+        class Exploding:
+            def __len__(self):
+                return 48
+
+            def __getitem__(self, i):
+                if i >= 32:  # second batch of the epoch dies
+                    raise RuntimeError("boom at index %d" % i)
+                return {"image": np.zeros((4, 4, 3), np.uint8),
+                        "label": np.int32(0)}
+
+        loader = ShardedLoader(Exploding(), 16, mesh, shuffle=False,
+                               num_workers=2)
+        got, err = [], []
+
+        def consume():
+            try:
+                for b in prefetch(loader.epoch(0)):
+                    got.append(b)
+            except BaseException as e:  # noqa: BLE001 — asserted below
+                err.append(e)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=30.0)
+        assert not t.is_alive(), "consumer hung on a dead loader worker"
+        assert err and "boom" in str(err[0])
+        assert len(got) <= 2  # the healthy leading batches, nothing more

@@ -196,6 +196,7 @@ class TestExecuteTraining:
         class FakeTrainer:
             heartbeat = None
             profiler = None
+            shutdown = None
             logger = type("L", (), {"log": staticmethod(lambda m: None)})()
             state = "initial"
 
@@ -212,9 +213,9 @@ class TestExecuteTraining:
             def latest_epoch(self):
                 return latest
 
-            def restore(self, template):
+            def restore_verified(self, template):
                 calls["restore"] += 1
-                return "restored"
+                return "restored", latest
 
         def state_factory():
             calls["factory"] += 1
@@ -228,10 +229,10 @@ class TestExecuteTraining:
 
         trainer, ckpt, args, factory, calls = self._make(fail_times=1, latest=None)
         # Patch out the restart delay to keep the test fast.
-        import deeplearning_mpi_tpu.train.resilience as res
+        import deeplearning_mpi_tpu.resilience.supervisor as sup
         from unittest import mock
 
-        with mock.patch.object(res.time, "sleep"):
+        with mock.patch.object(sup.time, "sleep"):
             out = execute_training(
                 trainer, ckpt, args, None, None, 0, state_factory=factory
             )
@@ -243,13 +244,13 @@ class TestExecuteTraining:
         assert calls["placed"] == 1
 
     def test_postcheckpoint_crash_restores_latest(self):
-        import deeplearning_mpi_tpu.train.resilience as res
+        import deeplearning_mpi_tpu.resilience.supervisor as sup
         from unittest import mock
 
         from deeplearning_mpi_tpu.utils.config import execute_training
 
         trainer, ckpt, args, factory, calls = self._make(fail_times=1, latest=3)
-        with mock.patch.object(res.time, "sleep"):
+        with mock.patch.object(sup.time, "sleep"):
             out = execute_training(
                 trainer, ckpt, args, None, None, 0, state_factory=factory
             )

@@ -1,0 +1,679 @@
+"""Resilience-layer tests: chaos grammar, integrity, recovery, and the
+headline end-to-end claims.
+
+Structured bottom-up, like the subsystem (``docs/RESILIENCE.md``):
+
+- :class:`FaultPlan` / :class:`ChaosInjector` — the deterministic grammar
+  and the fire-once / reconciliation accounting contract.
+- integrity primitives — atomic JSON, per-array and per-file digests,
+  byte corruption.
+- :class:`Checkpointer` hardening — manifest verification, rollback past
+  corrupted steps, retention of manifests.
+- supervisor pieces — :class:`Heartbeat`, :func:`preflight`,
+  :func:`run_with_auto_resume`, :class:`GracefulShutdown`/:class:`Preempted`.
+- :class:`ResilientLoader` — stall watchdog and poison-batch quarantine.
+- the two headline e2e claims: a kill+corrupt chaos TRAINING run recovers
+  onto the exact unfaulted trajectory (bit-identical final params), and a
+  crash-recovered SERVING run stays bit-identical to offline greedy decode
+  — with ``fault_injected_total == recovery_total + rollback_total``
+  reconciling in both.
+"""
+
+import json
+import os
+import signal
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_mpi_tpu.data import ShardedLoader, SyntheticTokens
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.resilience import (
+    ChaosInjector,
+    CheckpointCorruption,
+    FaultPlan,
+    GracefulShutdown,
+    Heartbeat,
+    InjectedFault,
+    InjectedKill,
+    Preempted,
+    ResilientLoader,
+    TrainingFailure,
+    atomic_write_json,
+    corrupt_checkpoint,
+    preflight,
+    run_with_auto_resume,
+    tree_digests,
+)
+from deeplearning_mpi_tpu.resilience.faults import (
+    FAULT_INJECTED,
+    RECOVERY,
+    ROLLBACK,
+)
+from deeplearning_mpi_tpu.resilience.integrity import (
+    manifest_path,
+    read_manifest,
+)
+from deeplearning_mpi_tpu.telemetry import MetricsRegistry, labeled
+from deeplearning_mpi_tpu.train import Checkpointer, Trainer, create_train_state
+from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+
+# -- shared tiny-LM plumbing --------------------------------------------------
+
+def _lm_factory(mesh=None, seed=0):
+    model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+    tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+    def factory():
+        return create_train_state(
+            model, jax.random.key(seed), jnp.zeros((1, 16), jnp.int32), tx,
+            mesh=mesh,
+        )
+
+    return factory
+
+
+# -- FaultPlan grammar --------------------------------------------------------
+
+class TestFaultPlan:
+    def test_parse_full_spec(self):
+        plan = FaultPlan.parse(
+            "nan_grad@step:7, loader_stall@batch:3,kill@step:12,"
+            "corrupt_ckpt@epoch:1"
+        )
+        assert len(plan) == 4
+        assert [(s.kind, s.unit, s.at) for s in plan.specs] == [
+            ("nan_grad", "step", 7),
+            ("loader_stall", "batch", 3),
+            ("kill", "step", 12),
+            ("corrupt_ckpt", "epoch", 1),
+        ]
+        assert not any(s.fired or s.recovered for s in plan.specs)
+
+    def test_bad_entry_rejected(self):
+        with pytest.raises(ValueError, match="bad chaos entry"):
+            FaultPlan.parse("kill@step")
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan.parse("meteor@step:1")
+
+    def test_wrong_unit_rejected(self):
+        # The unit is part of the grammar, not decoration — kill counts in
+        # steps, and a silent unit mismatch would make the fault never fire.
+        with pytest.raises(ValueError, match="triggers on 'step'"):
+            FaultPlan.parse("kill@epoch:1")
+
+    def test_empty_spec_rejected(self):
+        with pytest.raises(ValueError, match="empty chaos spec"):
+            FaultPlan.parse(" , ")
+
+
+class TestChaosInjector:
+    def test_fires_exactly_once_at_planned_trigger(self):
+        chaos = ChaosInjector(FaultPlan.parse("kill@step:5"))
+        assert not chaos.should_fire("kill", 4)
+        assert chaos.should_fire("kill", 5)
+        assert not chaos.should_fire("kill", 5)  # once means once
+        assert chaos.counts()[FAULT_INJECTED] == 1
+
+    def test_check_kill_raises_injected_kill(self):
+        chaos = ChaosInjector(FaultPlan.parse("kill@step:2"))
+        chaos.check_kill(step=1)
+        with pytest.raises(InjectedKill):
+            chaos.check_kill(step=2)
+        chaos.check_kill(step=2)  # fired: the restarted run passes through
+
+    def test_persistent_kind_refires_until_recovered(self):
+        # A poison batch is poison on every retry, but it is ONE fault.
+        chaos = ChaosInjector(FaultPlan.parse("loader_die@batch:3"))
+        for _ in range(3):
+            with pytest.raises(InjectedFault):
+                chaos.loader_fault(batch=3)
+        assert chaos.counts()[FAULT_INJECTED] == 1
+        assert chaos.record_recovery("loader_die", at=3)
+        chaos.loader_fault(batch=3)  # recovered: no longer raises
+
+    def test_recovery_is_idempotent_and_needs_a_fired_fault(self):
+        chaos = ChaosInjector(FaultPlan.parse("kill@step:5"))
+        assert not chaos.record_recovery("kill")  # nothing fired yet
+        chaos.should_fire("kill", 5)
+        assert chaos.record_recovery("kill")
+        assert not chaos.record_recovery("kill")  # already recovered
+        assert chaos.balanced()
+        assert not chaos.unrecovered()
+
+    def test_rollback_counts_against_the_same_invariant(self):
+        chaos = ChaosInjector(FaultPlan.parse("corrupt_ckpt@epoch:1,kill@step:2"))
+        assert chaos.should_corrupt(epoch=1)
+        chaos.should_fire("kill", 2)
+        assert not chaos.balanced()  # 2 injected, 0 handled
+        assert chaos.record_rollback("corrupt_ckpt", at=1)
+        assert chaos.record_recovery("kill")
+        assert chaos.balanced()
+        c = chaos.counts()
+        assert (c[FAULT_INJECTED], c[RECOVERY], c[ROLLBACK]) == (2, 1, 1)
+        assert c[labeled(ROLLBACK, kind="corrupt_ckpt")] == 1
+
+    def test_maybe_poison_lm_nans_the_mask_only(self):
+        chaos = ChaosInjector(FaultPlan.parse("nan_grad@step:1"))
+        batch = {"tokens": jnp.ones((2, 4), jnp.int32)}
+        assert chaos.maybe_poison(batch, "lm", step=0) is batch  # no copy off-plan
+        poisoned = chaos.maybe_poison(batch, "lm", step=1)
+        assert np.isnan(np.asarray(poisoned["mask"])).all()
+        np.testing.assert_array_equal(
+            np.asarray(poisoned["tokens"]), np.asarray(batch["tokens"])
+        )
+
+    def test_reconcile_nan_recoveries_is_bounded_by_skip_count(self):
+        chaos = ChaosInjector(FaultPlan.parse("nan_grad@step:1,nan_grad@step:2"))
+        chaos.should_fire("nan_grad", 1)
+        chaos.should_fire("nan_grad", 2)
+        assert chaos.reconcile_nan_recoveries(0) == 0  # guard skipped nothing
+        assert chaos.reconcile_nan_recoveries(1) == 1  # one confirmed skip
+        assert chaos.reconcile_nan_recoveries(5) == 1  # only one pending
+        assert chaos.balanced()
+
+    def test_bind_registry_backfills_pre_bind_counts(self):
+        chaos = ChaosInjector(FaultPlan.parse("kill@step:5"), stall_s=0.0)
+        chaos.should_fire("kill", 5)
+        chaos.record_recovery("kill", latency_s=0.25)
+        registry = MetricsRegistry()
+        chaos.bind_registry(registry)
+        snap = registry.snapshot()
+        assert snap[FAULT_INJECTED] == 1
+        assert snap[RECOVERY] == 1
+        assert snap[ROLLBACK] == 0  # pre-created: explicit zero, not absent
+        assert snap[labeled(FAULT_INJECTED, kind="kill")] == 1
+        assert any(k.startswith("recovery_latency_s") for k in snap)
+
+    def test_from_spec_none_without_plan(self, monkeypatch):
+        monkeypatch.delenv("DMT_CHAOS", raising=False)
+        assert ChaosInjector.from_spec(None) is None
+        assert ChaosInjector.from_spec("  ") is None
+
+    def test_from_spec_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("DMT_CHAOS", "kill@step:9")
+        chaos = ChaosInjector.from_spec(None)
+        assert chaos is not None
+        assert chaos.plan.specs[0].at == 9
+        monkeypatch.setenv("DMT_CHAOS_STALL_S", "0.125")
+        assert ChaosInjector.from_spec("loader_stall@batch:1").stall_s == 0.125
+
+
+# -- integrity primitives -----------------------------------------------------
+
+class TestIntegrityPrimitives:
+    def test_atomic_write_json_round_trips_and_leaves_no_temp(self, tmp_path):
+        path = tmp_path / "m.json"
+        atomic_write_json(path, {"a": 1})
+        atomic_write_json(path, {"a": 2})  # overwrite is also atomic
+        assert json.loads(path.read_text()) == {"a": 2}
+        assert list(tmp_path.iterdir()) == [path]
+
+    def test_tree_digests_deterministic_and_value_sensitive(self):
+        tree = {"w": jnp.arange(8, dtype=jnp.float32), "b": {"c": jnp.ones(3)}}
+        d1 = tree_digests(tree)
+        d2 = tree_digests(jax.tree.map(lambda x: x, tree))
+        assert d1 == d2
+        assert set(d1) == {"['w']", "['b']['c']"}  # keyed by tree path
+        mutated = {"w": tree["w"].at[0].set(7.0), "b": tree["b"]}
+        d3 = tree_digests(mutated)
+        assert d3["['w']"] != d1["['w']"]
+        assert d3["['b']['c']"] == d1["['b']['c']"]
+
+    def test_tree_digests_cover_dtype_and_shape(self):
+        # Same bytes, different view: a silent dtype/shape drift must not
+        # hash equal (f32 ones and a reshaped copy share a byte pattern).
+        a = {"x": jnp.ones(4, jnp.float32)}
+        b = {"x": jnp.ones((2, 2), jnp.float32)}
+        assert tree_digests(a)["['x']"] != tree_digests(b)["['x']"]
+
+    def test_corrupt_checkpoint_flips_bytes_in_largest_file(self, tmp_path):
+        small = tmp_path / "meta.json"
+        small.write_bytes(b"{}")
+        big = tmp_path / "arrays.bin"
+        big.write_bytes(bytes(4096))
+        victim = corrupt_checkpoint(tmp_path, span=64)
+        assert victim == big
+        assert small.read_bytes() == b"{}"
+        data = big.read_bytes()
+        assert any(x != 0 for x in data)  # bytes really flipped
+        assert len(data) == 4096  # size preserved: damage, not truncation
+
+
+class TestCheckpointIntegrity:
+    def test_restore_verified_rolls_back_past_corruption(self, tmp_path):
+        factory = _lm_factory()
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=4)
+        s0 = factory()
+        ck.save(s0, epoch=0)
+        ck.save(s0.replace(step=s0.step + 1), epoch=1)
+        ck.manager.wait_until_finished()
+        corrupt_checkpoint(ck.directory / "1")
+        state, epoch = ck.restore_verified(factory())
+        assert epoch == 0
+        assert int(state.step) == 0
+        assert tree_digests({"p": state.params}) == tree_digests({"p": s0.params})
+        ck.close()
+
+    def test_all_corrupt_history_raises(self, tmp_path):
+        factory = _lm_factory()
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=4)
+        ck.save(factory(), epoch=0)
+        ck.manager.wait_until_finished()
+        corrupt_checkpoint(ck.directory / "0")
+        with pytest.raises(CheckpointCorruption, match="tried epochs"):
+            ck.restore_verified(factory())
+        ck.close()
+
+    def test_step_without_manifest_restores_unverified(self, tmp_path):
+        # Pre-integrity history must keep restoring (legacy tolerance).
+        factory = _lm_factory()
+        ck = Checkpointer(tmp_path / "ck")
+        ck.save(factory(), epoch=0)
+        ck.manager.wait_until_finished()
+        assert manifest_path(ck.directory, 0).exists()
+        manifest_path(ck.directory, 0).unlink()
+        assert read_manifest(ck.directory, 0) is None
+        _, epoch = ck.restore_verified(factory())
+        assert epoch == 0
+        ck.close()
+
+    def test_chaos_corruption_is_injected_and_rolled_back(self, tmp_path):
+        factory = _lm_factory()
+        chaos = ChaosInjector(FaultPlan.parse("corrupt_ckpt@epoch:1"))
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=4, chaos=chaos)
+        s0 = factory()
+        ck.save(s0, epoch=0)
+        ck.save(s0.replace(step=s0.step + 1), epoch=1)  # corrupted on commit
+        _, epoch = ck.restore_verified(factory())
+        assert epoch == 0
+        assert chaos.balanced()
+        assert chaos.counts()[ROLLBACK] == 1
+        ck.close()
+
+    def test_manifest_retention_follows_max_to_keep(self, tmp_path):
+        factory = _lm_factory()
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=2)
+        state = factory()
+        for epoch in range(4):
+            ck.save(state, epoch=epoch)
+        ck.manager.wait_until_finished()
+        ck._prune_manifests()
+        kept = sorted(
+            int(p.stem.split("-", 1)[1])
+            for p in ck.directory.glob("manifest-*.json")
+        )
+        assert kept == sorted(ck.manager.all_steps())
+        assert len(kept) <= 2
+        ck.close()
+
+
+# -- supervisor: heartbeat, preflight, auto-resume, preemption ----------------
+
+class TestHeartbeat:
+    def test_beats_carry_progress_and_stop_stops(self, tmp_path):
+        path = tmp_path / "hb" / "heartbeat.json"
+        hb = Heartbeat(path, interval_s=0.02)
+        hb.progress = {"epoch": 3, "step_in_epoch": 7}
+        with hb:
+            deadline = time.monotonic() + 5.0
+            while not path.exists() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            payload = json.loads(path.read_text())
+        assert payload["epoch"] == 3
+        assert payload["step_in_epoch"] == 7
+        assert payload["pid"] == os.getpid()
+        assert hb._thread is None  # stopped by __exit__
+        mtime = path.stat().st_mtime_ns
+        time.sleep(0.08)
+        assert path.stat().st_mtime_ns == mtime  # no beats after stop
+
+    def test_stop_without_start_is_a_noop(self, tmp_path):
+        Heartbeat(tmp_path / "hb.json").stop()
+
+
+class TestPreflight:
+    def test_clean_config_passes(self, tmp_path, mesh):
+        preflight(
+            data_dir=str(tmp_path),
+            model_dir=str(tmp_path / "models"),
+            log_dir=str(tmp_path / "logs"),
+            global_batch_size=16, mesh=mesh, grad_accum=2,
+        )
+        assert (tmp_path / "models").is_dir()  # created, not just checked
+
+    def test_missing_data_dir_fails_specifically(self, tmp_path):
+        with pytest.raises(SystemExit, match="does not exist"):
+            preflight(data_dir=str(tmp_path / "nope"))
+
+    def test_indivisible_batch_fails_before_compile(self, mesh):
+        with pytest.raises(SystemExit, match="not divisible"):
+            preflight(global_batch_size=7, mesh=mesh)
+
+    def test_grad_accum_divisibility_checked(self, mesh):
+        with pytest.raises(SystemExit, match="grad_accum"):
+            preflight(global_batch_size=16, mesh=mesh, grad_accum=3)
+
+
+class _FakeCkpt:
+    def __init__(self, latest=None):
+        self.latest = latest
+
+    def latest_epoch(self):
+        return self.latest
+
+
+class TestAutoResume:
+    def test_resumes_from_epoch_after_latest_checkpoint(self):
+        ckpt = _FakeCkpt()
+        calls = []
+
+        def fit(start_epoch):
+            calls.append(start_epoch)
+            if len(calls) == 1:
+                ckpt.latest = 3  # "a checkpoint landed before the crash"
+                raise RuntimeError("simulated crash")
+            return "done"
+
+        out = run_with_auto_resume(fit, ckpt, max_restarts=2, restart_delay_s=0.0)
+        assert out == "done"
+        assert calls == [0, 4]
+
+    def test_restart_budget_exhaustion_raises_training_failure(self):
+        def fit(start_epoch):
+            raise RuntimeError("always down")
+
+        with pytest.raises(TrainingFailure, match="after 2 restarts"):
+            run_with_auto_resume(
+                fit, _FakeCkpt(), max_restarts=2, restart_delay_s=0.0
+            )
+
+    def test_preemption_never_burns_a_restart(self):
+        calls = []
+
+        def fit(start_epoch):
+            calls.append(start_epoch)
+            raise Preempted(1)
+
+        with pytest.raises(Preempted):
+            run_with_auto_resume(
+                fit, _FakeCkpt(), max_restarts=5, restart_delay_s=0.0
+            )
+        assert calls == [0]  # exactly one attempt
+
+
+class TestGracefulShutdown:
+    def test_manual_request_latches(self):
+        gs = GracefulShutdown()
+        assert not gs.requested()
+        gs.request()
+        assert gs.requested()
+
+    def test_sigterm_sets_the_flag_and_uninstall_restores(self):
+        gs = GracefulShutdown().install()
+        if not gs.installed:
+            pytest.skip("not on the main thread; install degraded")
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 2.0
+            while not gs.requested() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert gs.requested()
+        finally:
+            gs.uninstall()
+        assert signal.getsignal(signal.SIGTERM) is not gs._handler
+
+    def test_preempted_fit_checkpoints_and_raises(self, tmp_path, mesh):
+        factory = _lm_factory(mesh)
+        ck = Checkpointer(tmp_path / "ck")
+        loader = ShardedLoader(SyntheticTokens(16, 16), 8, mesh, shuffle=False)
+        shutdown = GracefulShutdown()  # manual: no signal in-process needed
+        trainer = Trainer(
+            factory(), "lm", mesh, checkpointer=ck, eval_every=1,
+            time_steps=False, shutdown=shutdown,
+        )
+        shutdown.request()
+        with pytest.raises(Preempted) as exc:
+            trainer.fit(loader, num_epochs=3)
+        assert exc.value.epoch == 0
+        assert ck.latest_epoch() == 0  # the graceful final checkpoint
+        ck.close()
+
+
+# -- loader watchdog ----------------------------------------------------------
+
+class TestResilientLoader:
+    def _loader(self, mesh, n=32, batch=8):
+        return ShardedLoader(
+            SyntheticTokens(n, 16), batch, mesh, shuffle=True, seed=0
+        )
+
+    def test_transparent_without_faults(self, mesh):
+        clean = list(self._loader(mesh).epoch(0))
+        wrapped = ResilientLoader(self._loader(mesh))
+        assert wrapped.steps_per_epoch() == 4  # __getattr__ delegation
+        got = list(wrapped.epoch(0))
+        assert len(got) == len(clean)
+        for a, b in zip(got, clean):
+            np.testing.assert_array_equal(
+                np.asarray(a["tokens"]), np.asarray(b["tokens"])
+            )
+
+    def test_stall_times_out_retries_and_delivers_same_batch(self, mesh):
+        chaos = ChaosInjector(
+            FaultPlan.parse("loader_stall@batch:1"), stall_s=1.0
+        )
+        wrapped = ResilientLoader(
+            self._loader(mesh), chaos=chaos,
+            batch_timeout_s=0.1, max_retries=2, backoff_s=0.01,
+        )
+        clean = list(self._loader(mesh).epoch(0))
+        got = list(wrapped.epoch(0))
+        assert wrapped.stalls >= 1  # the watchdog actually tripped
+        assert wrapped.retries >= 1
+        assert not wrapped.quarantined
+        assert len(got) == len(clean)  # nothing dropped
+        for a, b in zip(got, clean):  # retried batch is bit-identical
+            np.testing.assert_array_equal(
+                np.asarray(a["tokens"]), np.asarray(b["tokens"])
+            )
+        assert chaos.balanced()
+        assert chaos.counts()[labeled(RECOVERY, kind="loader_stall")] == 1
+
+    def test_poison_batch_quarantined_not_fatal(self, mesh):
+        chaos = ChaosInjector(FaultPlan.parse("loader_die@batch:2"))
+        wrapped = ResilientLoader(
+            self._loader(mesh), chaos=chaos,
+            batch_timeout_s=5.0, max_retries=1, backoff_s=0.0,
+        )
+        got = list(wrapped.epoch(0))
+        assert wrapped.quarantined == [2]
+        assert len(got) == 3  # 4 batches, one skipped
+        assert chaos.balanced()
+        assert chaos.counts()[labeled(RECOVERY, kind="loader_die")] == 1
+
+
+# -- scheduler shed accounting (labeled counter) ------------------------------
+
+class TestShedCounter:
+    def test_every_shed_reason_is_counted_and_labeled(self):
+        from deeplearning_mpi_tpu.serving import PagedKVPool, Request, Scheduler
+
+        def req(rid, prompt_len, max_new=2, arrival=0.0, deadline=None):
+            return Request(
+                rid=rid, prompt=np.arange(1, prompt_len + 1, dtype=np.int32),
+                max_new_tokens=max_new, arrival=arrival, deadline=deadline,
+            )
+
+        registry = MetricsRegistry()
+        pool = PagedKVPool(8, 4)
+        sched = Scheduler(
+            pool, max_slots=1, max_seq_len=8, max_queue=2, registry=registry,
+        )
+        assert registry.snapshot()["serve_shed_total"] == 0  # explicit zero
+
+        assert not sched.submit(req(1, 20))               # too_long
+        assert sched.submit(req(2, 2, deadline=1.0))
+        assert sched.submit(req(3, 2))
+        assert not sched.submit(req(4, 2))                # queue_full
+        assert sched.shed_expired(now=5.0)                # deadline (rid 2)
+        admitted = sched.admit(now=5.0)
+        assert [r.rid for r in admitted] == [3]
+        sched.evict(admitted[0])                          # evicted
+
+        snap = registry.snapshot()
+        assert snap["serve_shed_total"] == 4
+        for reason in ("too_long", "queue_full", "deadline", "evicted"):
+            assert snap[labeled("serve_shed_total", reason=reason)] == 1
+        pool.check()
+
+
+# -- headline e2e: chaos training run recovers onto the clean trajectory -----
+
+class TestTrainChaosE2E:
+    EPOCHS = 3
+    BATCH = 8
+    SEQS = 48  # 6 steps per epoch -> 18 total
+
+    def _run(self, mesh, tmp_path, chaos_spec=None):
+        from deeplearning_mpi_tpu.utils import config
+
+        factory = _lm_factory(mesh)
+        loader = ShardedLoader(
+            SyntheticTokens(self.SEQS, 32), self.BATCH, mesh,
+            shuffle=True, seed=0,
+        )
+        chaos = (
+            ChaosInjector(FaultPlan.parse(chaos_spec), stall_s=0.05)
+            if chaos_spec else None
+        )
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=5, chaos=chaos)
+        trainer = Trainer(
+            factory(), "lm", mesh, checkpointer=ck, eval_every=1,
+            time_steps=False, chaos=chaos,
+        )
+        trainer.place_state()
+        if chaos is not None:
+            chaos.bind_registry(trainer.metrics)
+            loader = ResilientLoader(
+                loader, chaos=chaos, batch_timeout_s=10.0, backoff_s=0.01
+            )
+        args = SimpleNamespace(
+            num_epochs=self.EPOCHS, max_restarts=2, eval_only=False,
+            resume=False, restart_delay_s=0.01,
+        )
+        try:
+            history = config.execute_training(
+                trainer, ck, args, loader, None, 0, state_factory=factory
+            )
+        finally:
+            ck.close()
+        return trainer, chaos, history
+
+    @pytest.fixture(scope="class")
+    def chaos_and_clean(self, tmp_path_factory):
+        from deeplearning_mpi_tpu.runtime.mesh import create_mesh
+
+        mesh = create_mesh()
+        tmp = tmp_path_factory.mktemp("chaos_e2e")
+        # kill fires 1 step into epoch 2; the newest checkpoint (epoch 1)
+        # was corrupted at commit, so recovery must roll back to epoch 0
+        # and RE-TRAIN epochs 1-2, not resume at 2 over a hole.
+        faulted = self._run(
+            mesh, tmp / "faulted",
+            "kill@step:13,corrupt_ckpt@epoch:1,loader_stall@batch:1",
+        )
+        clean = self._run(mesh, tmp / "clean")
+        return faulted, clean
+
+    def test_run_completes_all_planned_steps(self, chaos_and_clean):
+        (trainer, _, history), _ = chaos_and_clean
+        assert int(trainer.state.step) == self.EPOCHS * (self.SEQS // self.BATCH)
+        # Cumulative history: epochs 0,1 pre-kill + retrained 1,2.
+        assert [h["epoch"] for h in history] == [0, 1, 1, 2]
+
+    def test_recovered_trajectory_matches_unfaulted_run(self, chaos_and_clean):
+        (ft, _, fh), (ct, _, ch) = chaos_and_clean
+        # Bit-identical final params: the restore was exact and the replayed
+        # epochs saw identical batches (seeded per (seed, epoch) order).
+        assert tree_digests({"p": ft.state.params}) == tree_digests(
+            {"p": ct.state.params}
+        )
+        clean_loss = {h["epoch"]: h["loss"] for h in ch}
+        for h in fh:
+            assert h["loss"] == clean_loss[h["epoch"]], (
+                f"epoch {h['epoch']} diverged after recovery"
+            )
+
+    def test_fault_accounting_reconciles(self, chaos_and_clean):
+        (trainer, chaos, _), _ = chaos_and_clean
+        assert chaos.balanced(), chaos.summary()
+        assert not chaos.unrecovered()
+        snap = trainer.metrics.snapshot()
+        assert snap[FAULT_INJECTED] == 3
+        assert snap[RECOVERY] == 2          # kill + loader_stall
+        assert snap[ROLLBACK] == 1          # corrupt_ckpt
+        assert snap[FAULT_INJECTED] == snap[RECOVERY] + snap[ROLLBACK]
+        assert snap[labeled(FAULT_INJECTED, kind="kill")] == 1
+        assert any(k.startswith("recovery_latency_s") for k in snap)
+
+
+# -- headline e2e: serving crash recovery stays bit-identical -----------------
+
+class TestServeChaos:
+    def test_crash_recovery_keeps_greedy_parity(self):
+        from deeplearning_mpi_tpu.models.generate import generate
+        from deeplearning_mpi_tpu.serving import (
+            EngineConfig,
+            RequestState,
+            ServingEngine,
+        )
+
+        cfg = TransformerConfig.tiny()
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        registry = MetricsRegistry()
+        chaos = ChaosInjector(
+            FaultPlan.parse("serve_crash@step:3"), registry=registry
+        )
+        engine = ServingEngine(
+            cfg, params,
+            EngineConfig(max_slots=3, block_size=4, num_blocks=32,
+                         max_blocks_per_seq=8, prefill_chunk=4),
+            dtype=jnp.float32, registry=registry, chaos=chaos,
+        )
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(1, 255, size=n).astype(np.int32)
+            for n in (5, 9, 3, 12)
+        ]
+        max_new = 5
+        reqs = [engine.submit(p, max_new) for p in prompts]
+
+        engine.run_until_idle()  # recovers the injected crash in place
+
+        snap = registry.snapshot()
+        assert snap["serve_requeued_total"] >= 1  # crash hit live sequences
+        for req, prompt in zip(reqs, prompts):
+            assert req.state is RequestState.FINISHED
+            out = generate(
+                model, params, jnp.asarray(prompt)[None],
+                max_new_tokens=max_new, rng=jax.random.key(0),
+                temperature=0.0,
+            )
+            expect = np.asarray(out)[0, len(prompt):].tolist()
+            assert req.generated == expect, f"rid {req.rid} diverged"
+        engine.pool.check()
+        assert chaos.balanced()
+        assert snap[FAULT_INJECTED] == 1
+        assert snap[RECOVERY] == 1
+        assert snap[labeled(RECOVERY, kind="serve_crash")] == 1
