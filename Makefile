@@ -4,13 +4,13 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke chaos-smoke
+.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
 # serve-smoke and chaos-smoke prerequisites gate the tier-1 run on the
 # serving engine's end-to-end parity selftest and the fault-injection
 # recovery drill without touching the ROADMAP command itself.
-verify: serve-smoke chaos-smoke
+verify: serve-smoke chaos-smoke tune-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -29,6 +29,13 @@ serve-smoke:
 		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
 		--max_slots 3 --block_size 8 --num_blocks 32 \
 		--max_blocks_per_seq 6 --prefill_chunk 8
+
+# Compilation-service acceptance loop (docs/COMPILATION.md): autotune tiny
+# kernels into a tuning DB, round-trip it, verify tuned == default
+# numerics, and prove a warm-started serving engine hits the persistent
+# compile cache and performs zero compiles on its first request.
+tune-smoke:
+	env JAX_PLATFORMS=cpu python tools/autotune.py --selftest
 
 # 30-second observability demo: tiny CPU-mesh LM run with telemetry on,
 # rendered by the report tool (docs/OBSERVABILITY.md walks through it).

@@ -71,6 +71,16 @@ def build_parser() -> argparse.ArgumentParser:
     eng.add_argument("--use_kernel", action="store_true",
                      help="dispatch decode attention to the Pallas "
                      "flash_decode kernel (per-row fill levels)")
+    eng.add_argument("--tuning_db", default=None,
+                     help="autotuner tuning DB (tools/autotune.py output): "
+                     "decode schedule and kernel block sizes come from its "
+                     "winners; without --use_kernel the kernel-vs-einsum "
+                     "choice itself defers to the DB")
+    eng.add_argument("--warmup", action="store_true",
+                     help="AOT-compile the decode and prefill programs "
+                     "before accepting traffic (compiler/aot.py): first-"
+                     "request latency contains zero compiles, and "
+                     "compile-cache hit/miss counters land in the registry")
     trace = parser.add_argument_group("trace")
     trace.add_argument("--trace", default=None,
                        help="JSONL request trace (see module docstring); "
@@ -321,6 +331,14 @@ def main(argv: list[str] | None = None) -> int:
     from deeplearning_mpi_tpu.resilience import ChaosInjector
 
     chaos = ChaosInjector.from_spec(args.chaos, registry=registry)
+    if args.tuning_db:
+        from deeplearning_mpi_tpu.compiler.autotune import set_default_db
+
+        set_default_db(args.tuning_db)
+    # --use_kernel forces the Pallas path; with only a tuning DB the
+    # schedule choice itself (kernel vs einsum) defers to the DB's winner
+    # (use_kernel=None); otherwise the einsum default stands.
+    use_kernel = True if args.use_kernel else (None if args.tuning_db else False)
     engine = ServingEngine(
         cfg, params,
         EngineConfig(
@@ -330,10 +348,15 @@ def main(argv: list[str] | None = None) -> int:
             max_blocks_per_seq=args.max_blocks_per_seq,
             prefill_chunk=args.prefill_chunk,
             max_queue=args.max_queue,
-            use_kernel=args.use_kernel,
+            use_kernel=use_kernel,
         ),
         dtype=dtype, eos_id=eos_id, registry=registry, chaos=chaos,
     )
+    if args.warmup:
+        t_warm = time.monotonic()
+        engine.warmup()
+        print(f"warmup: decode+prefill compiled in "
+              f"{time.monotonic() - t_warm:.2f}s", file=sys.stderr)
 
     if args.trace:
         entries = _load_trace(args.trace, args.max_new_tokens, args.deadline)

@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
                        "sequence chunks of this size so [B, S, vocab] logits "
                        "never materialize (the long-context memory lever; "
                        "tied embeddings only). 0 = standard loss")
+    group.add_argument("--aot_warmup", action="store_true",
+                       help="AOT-compile the train step on a sample batch "
+                       "before the first epoch (compiler/aot.py): the compile "
+                       "leaves the timed loop, XLA's cost analysis backfills "
+                       "FLOPs/bytes telemetry, and compile-cache hit/miss "
+                       "counters land in the metrics registry")
     data = parser.add_argument_group("data")
     data.add_argument("--text_file", default=None,
                       help="train on this file's bytes (vocab 256); default: synthetic motifs")
@@ -289,6 +295,16 @@ def main(argv: list[str] | None = None) -> int:
             ),
             comm_bytes_per_step=comm_bytes,
         )
+        if args.aot_warmup and not args.eval_only:
+            # One real batch fixes the avals; the generator is closed
+            # immediately so its prefetch producer never overlaps training.
+            batches = train_loader.epoch(0)
+            try:
+                sample = next(iter(batches))
+            finally:
+                if hasattr(batches, "close"):
+                    batches.close()
+            trainer.warmup(sample)
         config.execute_training(
             trainer, checkpointer, args, train_loader, eval_loader, start_epoch,
             state_factory=state_factory,

@@ -1,0 +1,53 @@
+"""Compilation service: AOT warmup, persistent-cache management, autotuning.
+
+The single owner of every compile-time policy in the tree:
+
+- :mod:`~deeplearning_mpi_tpu.compiler.aot` — lower/compile programs before
+  traffic (``Trainer.warmup``, ``ServingEngine.warmup`` route here) and
+  surface XLA's own cost analysis to telemetry;
+- :mod:`~deeplearning_mpi_tpu.compiler.autotune` — deterministic Pallas
+  block-size / decode-schedule search with a persistent JSON tuning DB the
+  kernels consult at call-site;
+- :mod:`~deeplearning_mpi_tpu.compiler.cache` — persistent-compile-cache
+  keying, hit/miss telemetry, size-bounded eviction, corrupt-entry
+  quarantine, and the buffer-donation veto policy
+  (``runtime/compat.buffer_donation_supported`` delegates here).
+
+See ``docs/COMPILATION.md``.
+"""
+
+from deeplearning_mpi_tpu.compiler.aot import (
+    CompiledProgram,
+    WarmProgram,
+    WarmupRegistry,
+    abstractify,
+    compile_program,
+)
+from deeplearning_mpi_tpu.compiler.autotune import (
+    TuningDB,
+    default_db,
+    set_default_db,
+    tune_flash_attention,
+    tune_flash_decode,
+)
+from deeplearning_mpi_tpu.compiler.cache import (
+    CompileCache,
+    donation_safe,
+    enable,
+)
+
+__all__ = [
+    "CompileCache",
+    "CompiledProgram",
+    "TuningDB",
+    "WarmProgram",
+    "WarmupRegistry",
+    "abstractify",
+    "compile_program",
+    "default_db",
+    "donation_safe",
+    "enable",
+    "set_default_db",
+    "tune_flash_attention",
+    "tune_flash_decode",
+]

@@ -1,0 +1,201 @@
+"""AOT compilation + warmup registry: pay compile cost before traffic.
+
+``jax.jit`` compiles lazily — the first trainer step and the first serving
+request each stall for the full XLA compile (seconds on CPU, minutes for
+large pods). The AOT path (``jit(f).lower(args).compile()``) moves that
+stall to an explicit warmup phase, and the resulting ``Compiled`` object is
+directly callable and never retraces — which is also what makes "zero
+compiles on the first request" an assertable property rather than a hope.
+
+Three layers:
+
+- :func:`compile_program` — lower+compile one program, timing both phases,
+  classifying the compile as a persistent-cache hit or miss (via
+  :class:`~deeplearning_mpi_tpu.compiler.cache.CompileCache` snapshots) and
+  pulling XLA's own cost analysis (FLOPs / bytes accessed) through
+  ``telemetry/flops.xla_cost_analysis`` — the measured complement to the
+  analytic estimators.
+- :class:`WarmProgram` — the callable swapped into hot paths: the compiled
+  executable on the fast path, falling back to the original jitted callable
+  if an argument signature ever drifts (AOT executables reject unseen
+  avals with a TypeError instead of retracing).
+- :class:`WarmupRegistry` — named programs registered with their example
+  arguments, compiled in one ``warm_all()`` sweep; how the trainer step and
+  both serving programs (decode step, chunked prefill) precompile before
+  traffic (``Trainer.warmup`` / ``ServingEngine.warmup``).
+
+Donation: :func:`compile_program` applies the
+:func:`~deeplearning_mpi_tpu.compiler.cache.donation_safe` veto before
+jitting — an AOT program under a persistent cache is the cache-deserialized
+executable the veto exists for. Already-jitted callables keep whatever
+donation they were built with (their constructors route through the same
+policy via ``runtime/compat.buffer_donation_supported``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_mpi_tpu.compiler.cache import CompileCache, donation_safe
+
+__all__ = [
+    "CompiledProgram",
+    "WarmProgram",
+    "WarmupRegistry",
+    "abstractify",
+    "compile_program",
+]
+
+
+def abstractify(tree: Any) -> Any:
+    """Arrays (or anything shaped) -> ``ShapeDtypeStruct`` pytree, so
+    programs can be lowered without materializing example inputs."""
+    def one(x: Any) -> jax.ShapeDtypeStruct:
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+    return jax.tree.map(one, tree)
+
+
+@dataclasses.dataclass
+class CompiledProgram:
+    """One AOT-compiled executable plus everything warmup learned about it."""
+
+    name: str
+    compiled: Any  # jax.stages.Compiled — directly callable, never retraces
+    lower_seconds: float
+    compile_seconds: float
+    #: XLA cost analysis (None where the backend doesn't expose it) — the
+    #: executed FLOPs/bytes, not the analytic estimate.
+    flops: float | None
+    bytes_accessed: float | None
+    #: persistent-cache verdict: True deserialized, False compiled fresh,
+    #: None when no cache directory is configured.
+    cache_hit: bool | None
+    #: donate_argnums actually applied (after the donation_safe veto); for
+    #: pre-jitted callables this is always () — they own their donation.
+    donated: tuple[int, ...]
+
+    def __call__(self, *args: Any) -> Any:
+        return self.compiled(*args)
+
+
+def compile_program(
+    name: str,
+    fn: Callable[..., Any],
+    *args: Any,
+    donate_argnums: tuple[int, ...] = (),
+    registry: Any = None,
+    cache: CompileCache | None = None,
+    **jit_kwargs: Any,
+) -> CompiledProgram:
+    """Lower and compile ``fn`` for ``args`` (concrete arrays or
+    ``ShapeDtypeStruct`` trees) ahead of time.
+
+    ``fn`` may be a plain callable (jitted here, with ``donate_argnums``
+    subject to the :func:`donation_safe` veto) or an already-jitted one
+    (used as-is — it already routed donation through the same policy).
+    ``registry``/``cache`` wire the ``compile_*`` telemetry; when ``cache``
+    is omitted one is built over the configured cache dir so hit/miss
+    classification works out of the box.
+    """
+    if cache is None:
+        cache = CompileCache(registry=registry)
+    elif registry is None:
+        registry = cache.registry
+    donated = tuple(donate_argnums)
+    if hasattr(fn, "lower"):
+        jitted = fn
+        donated = ()  # pre-jitted: donation baked in at construction
+    else:
+        if donated and not donation_safe():
+            donated = ()
+        jitted = jax.jit(fn, donate_argnums=donated, **jit_kwargs)
+    before = cache.snapshot()
+    t0 = time.perf_counter()
+    lowered = jitted.lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    hit = cache.observe_compile(name, t2 - t1, before)
+    from deeplearning_mpi_tpu.telemetry.flops import xla_cost_analysis
+
+    costs = xla_cost_analysis(compiled)
+    return CompiledProgram(
+        name=name,
+        compiled=compiled,
+        lower_seconds=t1 - t0,
+        compile_seconds=t2 - t1,
+        flops=costs.get("flops"),
+        bytes_accessed=costs.get("bytes_accessed"),
+        cache_hit=hit,
+        donated=donated,
+    )
+
+
+class WarmProgram:
+    """The warmed callable: AOT executable first, original jit as a net.
+
+    A ``Compiled`` object raises ``TypeError`` on argument avals it wasn't
+    compiled for (AOT never retraces); the fallback keeps a signature drift
+    — a config change, an unexpected dtype — a silent recompile instead of
+    a crash. ``fallback_calls`` counts how often the net was needed (zero
+    in a correctly-warmed engine)."""
+
+    def __init__(self, program: CompiledProgram, fallback: Callable[..., Any]):
+        self.program = program
+        self.fallback = fallback
+        self.fallback_calls = 0
+
+    def __call__(self, *args: Any) -> Any:
+        try:
+            return self.program.compiled(*args)
+        except TypeError:
+            self.fallback_calls += 1
+            return self.fallback(*args)
+
+
+class WarmupRegistry:
+    """Named programs + example args, compiled in one sweep before traffic.
+
+    ``register`` is cheap (no tracing); ``warm_all`` pays every lower +
+    compile, records ``compile_*`` telemetry through the shared ``cache``,
+    and keeps the results addressable by name. Registering a name twice
+    replaces the earlier spec (last writer wins — e.g. re-warming after a
+    config change)."""
+
+    def __init__(
+        self, *, registry: Any = None, cache: CompileCache | None = None
+    ):
+        self.cache = cache if cache is not None else CompileCache(
+            registry=registry
+        )
+        self.registry = registry if registry is not None else self.cache.registry
+        self._specs: dict[str, tuple[Callable[..., Any], tuple, dict]] = {}
+        self.programs: dict[str, CompiledProgram] = {}
+
+    def register(
+        self,
+        name: str,
+        fn: Callable[..., Any],
+        *args: Any,
+        **jit_kwargs: Any,
+    ) -> None:
+        self._specs[name] = (fn, args, jit_kwargs)
+
+    def warm_all(self) -> dict[str, CompiledProgram]:
+        for name, (fn, args, jit_kwargs) in self._specs.items():
+            self.programs[name] = compile_program(
+                name, fn, *args,
+                registry=self.registry, cache=self.cache, **jit_kwargs,
+            )
+        return dict(self.programs)
+
+    def get(self, name: str) -> CompiledProgram:
+        return self.programs[name]

@@ -1,0 +1,419 @@
+"""Deterministic Pallas block-size autotuner + persistent JSON tuning DB.
+
+The flash kernels ship block-shape defaults from one v5e sweep
+(``flash_attention.py``: 1024x1024 was 8.5x faster than the flash-paper
+128x128 on that chip) — but the right blocks move with generation, dtype,
+and shape, and the decode path additionally has a *schedule* choice (fused
+Pallas kernel vs the dense einsum) whose crossover is an empirical fact,
+not a constant. This module searches those spaces the boring way:
+enumerate candidates in a fixed order, verify each against the dense
+oracle, time with median-of-repeats, persist the winner.
+
+DB entries are keyed by ``(kernel, shape, dtype, backend)`` — a tuning
+measured on one backend never leaks to another. Call sites
+(``ops/pallas/flash_attention.py``, ``ops/pallas/flash_decode.py``,
+``ops/attention.py`` and through it ``serving/engine.py``) consult
+:func:`default_db` lazily and fall back to the module defaults on any
+miss, parse error, or absent DB — tuning is an overlay, never a
+requirement.
+
+Determinism: fixed PRNG keys, a fixed candidate enumeration (descending,
+so ties break toward the measured-good larger blocks), numerics gated
+before timing (a fast-but-wrong candidate is discarded, not preferred),
+and median-of-repeats timing. Same machine, same DB.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
+
+__all__ = [
+    "ATTENTION_BLOCK_CANDIDATES",
+    "DECODE_BLOCK_CANDIDATES",
+    "TuningDB",
+    "default_db",
+    "set_default_db",
+    "tune_flash_attention",
+    "tune_flash_decode",
+    "tuned_attention_blocks",
+    "tuned_decode_schedule",
+    "tuning_key",
+]
+
+DB_VERSION = 1
+#: Env var naming the tuning DB consulted at kernel call sites.
+ENV_DB = "DMT_TUNING_DB"
+
+#: Default search space for flash-attention block shapes (descending: ties
+#: resolve toward the larger block, matching the measured preference).
+ATTENTION_BLOCK_CANDIDATES = (1024, 512, 256, 128)
+#: Default search space for the flash-decode KV block.
+DECODE_BLOCK_CANDIDATES = (2048, 1024, 512, 256)
+
+
+def tuning_key(
+    kernel: str, shape: tuple[int, ...], dtype: Any, backend: str
+) -> str:
+    dims = "x".join(str(int(s)) for s in shape)
+    return f"{kernel}|{dims}|{jnp.dtype(dtype).name}|{backend}"
+
+
+class TuningDB:
+    """JSON-backed map from tuning key to winning kernel parameters.
+
+    On-disk format (``docs/COMPILATION.md``)::
+
+        {"version": 1,
+         "entries": {"flash_attention|4x4096x8x64|bfloat16|tpu": {
+             "kernel": ..., "shape": [...], "dtype": ..., "backend": ...,
+             "params": {"block_q": 1024, "block_k": 512},
+             "best_seconds": ..., "candidates": [...]}}}
+
+    Writes go through ``resilience.integrity.atomic_write_json`` (tmp +
+    fsync + rename), so a crashed tuning run leaves the previous DB, never
+    a torn one; :meth:`load` treats a corrupt/missing file as empty for the
+    same reason — a tuning DB must never be able to take a run down.
+    """
+
+    def __init__(self, path: str | Path | None = None):
+        self.path = Path(path) if path else None
+        self.entries: dict[str, dict[str, Any]] = {}
+
+    @classmethod
+    def load(cls, path: str | Path) -> "TuningDB":
+        db = cls(path)
+        try:
+            payload = json.loads(Path(path).read_text())
+            if payload.get("version") == DB_VERSION:
+                db.entries = dict(payload["entries"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # absent or corrupt: start empty, keep the path
+        return db
+
+    def save(self, path: str | Path | None = None) -> Path:
+        path = Path(path) if path else self.path
+        if path is None:
+            raise ValueError("TuningDB has no path to save to")
+        self.path = path
+        atomic_write_json(
+            path, {"version": DB_VERSION, "entries": self.entries}
+        )
+        return path
+
+    def record(
+        self,
+        kernel: str,
+        shape: tuple[int, ...],
+        dtype: Any,
+        params: dict[str, Any],
+        *,
+        backend: str | None = None,
+        best_seconds: float | None = None,
+        candidates: list[dict[str, Any]] | None = None,
+    ) -> str:
+        backend = backend or jax.default_backend()
+        key = tuning_key(kernel, shape, dtype, backend)
+        self.entries[key] = {
+            "kernel": kernel,
+            "shape": [int(s) for s in shape],
+            "dtype": jnp.dtype(dtype).name,
+            "backend": backend,
+            "params": dict(params),
+            "best_seconds": best_seconds,
+            "candidates": candidates or [],
+        }
+        return key
+
+    def lookup(
+        self,
+        kernel: str,
+        shape: tuple[int, ...],
+        dtype: Any,
+        *,
+        backend: str | None = None,
+    ) -> dict[str, Any] | None:
+        """The winning params for this exact (kernel, shape, dtype,
+        backend), or None — no nearest-shape guessing; a wrong block size
+        can be slower than the default it replaced."""
+        backend = backend or jax.default_backend()
+        entry = self.entries.get(tuning_key(kernel, shape, dtype, backend))
+        return dict(entry["params"]) if entry else None
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+# -- process-default DB (what kernel call sites consult) ---------------------
+
+_UNSET = object()
+_default_db: Any = _UNSET
+
+
+def default_db() -> TuningDB | None:
+    """The process-wide tuning DB: whatever :func:`set_default_db` installed,
+    else ``$DMT_TUNING_DB`` loaded once, else None (kernels keep their
+    defaults)."""
+    global _default_db
+    if _default_db is _UNSET:
+        path = os.environ.get(ENV_DB)
+        _default_db = TuningDB.load(path) if path else None
+    return _default_db
+
+
+def set_default_db(db: TuningDB | str | Path | None) -> TuningDB | None:
+    """Install (or clear, with None) the process-default DB; paths are
+    loaded. Returns the installed DB. Passing None re-arms the
+    ``$DMT_TUNING_DB`` fallback on the next :func:`default_db` call only if
+    the env var is consulted again — i.e. it resets to 'unset'."""
+    global _default_db
+    if db is None:
+        _default_db = _UNSET
+        return None
+    if not isinstance(db, TuningDB):
+        db = TuningDB.load(db)
+    _default_db = db
+    return db
+
+
+def _consult(
+    kernel: str, shape: tuple[int, ...], dtype: Any
+) -> dict[str, Any] | None:
+    """Call-site lookup that must never raise: a broken DB degrades to
+    'no tuning', not to a failed forward pass."""
+    try:
+        db = default_db()
+        if db is None:
+            return None
+        return db.lookup(kernel, shape, dtype)
+    except Exception:
+        return None
+
+
+def tuned_attention_blocks(
+    shape: tuple[int, ...], dtype: Any
+) -> tuple[int, int] | None:
+    """``(block_q, block_k)`` for a ``[B, S, H, D]`` flash-attention call,
+    or None when untuned."""
+    params = _consult("flash_attention", shape, dtype)
+    if not params:
+        return None
+    try:
+        return int(params["block_q"]), int(params["block_k"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def tuned_decode_schedule(
+    shape: tuple[int, ...], dtype: Any
+) -> dict[str, Any] | None:
+    """``{"schedule": "kernel"|"einsum", "block": int|None}`` for a
+    ``[B, L, Hkv, D]`` decode buffer, or None when untuned."""
+    params = _consult("flash_decode", shape, dtype)
+    if not params or params.get("schedule") not in ("kernel", "einsum"):
+        return None
+    return params
+
+
+# -- measurement -------------------------------------------------------------
+
+def measure(
+    fn: Callable[..., Any], *args: Any, repeats: int = 3, warmup: int = 1
+) -> float:
+    """Median wall-seconds per call, fully synchronized. The first
+    (warmup) calls absorb compilation so block-shape timings compare
+    steady-state execution, which is what the serving/training hot loops
+    see."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _allclose(a: jax.Array, b: jax.Array, dtype: Any) -> bool:
+    tol = 2e-2 if jnp.dtype(dtype) == jnp.bfloat16 else 2e-5
+    return bool(
+        jnp.allclose(
+            a.astype(jnp.float32), b.astype(jnp.float32),
+            rtol=tol, atol=tol,
+        )
+    )
+
+
+# -- flash attention ---------------------------------------------------------
+
+def attention_candidates(
+    seq: int, candidates: tuple[int, ...] | None = None
+) -> list[tuple[int, int]]:
+    """Legal ``(block_q, block_k)`` pairs for ``seq``, in the fixed
+    (descending) search order."""
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import usable_blocks
+
+    cand = tuple(
+        sorted(set(candidates or ATTENTION_BLOCK_CANDIDATES), reverse=True)
+    )
+    return [
+        (bq, bk)
+        for bq in cand
+        for bk in cand
+        if bq <= seq and bk <= seq and usable_blocks(bq, bk, seq)
+    ]
+
+
+def tune_flash_attention(
+    shape: tuple[int, int, int, int],
+    dtype: Any = jnp.float32,
+    *,
+    db: TuningDB | None = None,
+    candidates: tuple[int, ...] | None = None,
+    repeats: int = 3,
+    causal: bool = True,
+    interpret: bool | None = None,
+) -> dict[str, Any]:
+    """Search flash-attention block shapes for one ``[B, S, H, D]`` shape.
+
+    Every candidate is verified against ``dense_attention`` (the oracle the
+    kernel's tests use) before it may win — a mis-tiled candidate that
+    returns garbage fast is discarded, not selected. Returns the winning
+    ``{"block_q", "block_k"}`` (recorded into ``db`` when given), or ``{}``
+    when no candidate legally tiles the shape.
+    """
+    from deeplearning_mpi_tpu.ops.pallas.flash_attention import (
+        flash_attention,
+    )
+    from deeplearning_mpi_tpu.ops.attention import dense_attention
+
+    batch, seq, heads, head_dim = shape
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, shape, dtype)
+    k = jax.random.normal(kk, shape, dtype)
+    v = jax.random.normal(kv, shape, dtype)
+    oracle = dense_attention(q, k, v, causal=causal)
+
+    results: list[dict[str, Any]] = []
+    best: dict[str, Any] | None = None
+    for bq, bk in attention_candidates(seq, candidates):
+        fn = jax.jit(
+            lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk,
+                interpret=interpret,
+            )
+        )
+        if not _allclose(fn(q, k, v), oracle, dtype):
+            results.append(
+                {"block_q": bq, "block_k": bk, "rejected": "numerics"}
+            )
+            continue
+        secs = measure(fn, q, k, v, repeats=repeats)
+        entry = {"block_q": bq, "block_k": bk, "seconds": secs}
+        results.append(entry)
+        if best is None or secs < best["seconds"]:
+            best = entry
+    if best is None:
+        return {}
+    params = {"block_q": best["block_q"], "block_k": best["block_k"]}
+    if db is not None:
+        db.record(
+            "flash_attention", shape, dtype, params,
+            best_seconds=best["seconds"], candidates=results,
+        )
+    return params
+
+
+# -- flash decode ------------------------------------------------------------
+
+def tune_flash_decode(
+    shape: tuple[int, int, int, int],
+    dtype: Any = jnp.float32,
+    *,
+    heads: int | None = None,
+    db: TuningDB | None = None,
+    blocks: tuple[int, ...] | None = None,
+    repeats: int = 3,
+    interpret: bool | None = None,
+) -> dict[str, Any]:
+    """Search the decode schedule (einsum vs Pallas kernel) and the
+    kernel's KV block for one ``[B, L, Hkv, D]`` buffer shape.
+
+    The einsum schedule (``batched_decode_attention``'s default — the
+    measured-roofline read-everything path) is always a candidate AND the
+    numerics oracle; kernel candidates must match it to compete. Returns
+    the winning ``{"schedule", "block"}`` (recorded into ``db``).
+    """
+    from deeplearning_mpi_tpu.ops.attention import batched_decode_attention
+    from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
+        decode_block_fits,
+        flash_decode,
+    )
+
+    batch, length, kv_heads, head_dim = shape
+    heads = heads or kv_heads
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (batch, 1, heads, head_dim), dtype)
+    k_buf = jax.random.normal(kk, shape, dtype)
+    v_buf = jax.random.normal(kv, shape, dtype)
+    # Deterministic spread of fill levels — the continuous-batching regime
+    # (every slot at its own depth) the schedule choice must serve.
+    index = jnp.asarray(
+        [length - 1 - (i * (length // 2)) // max(batch - 1, 1)
+         for i in range(batch)],
+        jnp.int32,
+    )
+
+    einsum_fn = jax.jit(
+        lambda q, k_buf, v_buf, index: batched_decode_attention(
+            q, k_buf, v_buf, index, use_kernel=False
+        )
+    )
+    oracle = einsum_fn(q, k_buf, v_buf, index)
+    results = [{
+        "schedule": "einsum", "block": None,
+        "seconds": measure(einsum_fn, q, k_buf, v_buf, index,
+                           repeats=repeats),
+    }]
+    best = results[0]
+
+    seen: set[int] = set()
+    for want in sorted(
+        set(blocks or DECODE_BLOCK_CANDIDATES), reverse=True
+    ):
+        fitted = decode_block_fits(want, length)
+        if fitted is None or fitted in seen:
+            continue
+        seen.add(fitted)
+        fn = jax.jit(
+            lambda q, k_buf, v_buf, index, b=fitted: flash_decode(
+                q, k_buf, v_buf, index, block=b, interpret=interpret
+            )
+        )
+        if not _allclose(fn(q, k_buf, v_buf, index), oracle, dtype):
+            results.append(
+                {"schedule": "kernel", "block": fitted,
+                 "rejected": "numerics"}
+            )
+            continue
+        secs = measure(fn, q, k_buf, v_buf, index, repeats=repeats)
+        entry = {"schedule": "kernel", "block": fitted, "seconds": secs}
+        results.append(entry)
+        if secs < best["seconds"]:
+            best = entry
+    params = {"schedule": best["schedule"], "block": best["block"]}
+    if db is not None:
+        db.record(
+            "flash_decode", shape, dtype, params,
+            best_seconds=best["seconds"], candidates=results,
+        )
+    return params

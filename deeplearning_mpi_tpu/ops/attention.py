@@ -97,6 +97,25 @@ def dense_attention(
     return out.astype(q.dtype)
 
 
+def _tuned_decode_schedule(
+    shape: tuple[int, ...], dtype,
+) -> tuple[bool, int | None]:
+    """(use_kernel, block) from the autotuner's DB for this ``[B, L, Hkv,
+    D]`` buffer — ``(False, None)`` when untuned/unavailable, so
+    ``use_kernel=None`` keeps today's einsum/walk behavior without a DB."""
+    try:
+        from deeplearning_mpi_tpu.compiler.autotune import (
+            tuned_decode_schedule,
+        )
+
+        tuned = tuned_decode_schedule(tuple(shape), dtype)
+    except Exception:
+        return False, None
+    if not tuned:
+        return False, None
+    return tuned["schedule"] == "kernel", tuned.get("block")
+
+
 #: Buffers at or below this length take the one-shot masked path: measured
 #: on a v5e (tools/bench_decode.py, device-looped timing), the single fused
 #: einsum runs at the HBM roofline (~72 us/token flat at B8 H12 D64
@@ -154,10 +173,11 @@ def decode_attention(
     caps the walk at ~45% of the HBM roofline, PERF_ANALYSIS §9), keeping
     O(index) — O(window) for sliding-window models — HBM traffic via its
     two-sided clamped index map. ``True`` selects it when the buffer tiles
-    (the interpreter off-TPU); ``None``/``False`` keep the walk —
-    auto-selection waits on an on-chip Mosaic validation + measurement
-    (tools/bench_decode.py ``--kernel``), at which point ``None`` should
-    flip to TPU-auto.
+    (the interpreter off-TPU); ``False`` keeps the walk; ``None`` consults
+    the autotuner's tuning DB for this buffer's (shape, dtype, backend) —
+    a recorded ``flash_decode`` winner selects the kernel at its measured
+    block, an untuned shape keeps the walk (``compiler/autotune.py``;
+    ``make tune-smoke`` exercises the loop end-to-end).
 
     Not differentiable (dynamic trip count) — decode is inference-only.
     """
@@ -193,6 +213,12 @@ def decode_attention(
             preferred_element_type=jnp.float32,
         )
         return out.reshape(batch, heads, head_dim)[:, None].astype(q.dtype)
+    if use_kernel is None:
+        use_kernel, tuned_block = _tuned_decode_schedule(
+            k_buf.shape, k_buf.dtype
+        )
+        if tuned_block:
+            block = tuned_block
     if use_kernel:
         from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
             decode_block_fits,
@@ -294,6 +320,11 @@ def batched_decode_attention(
       which takes the ``[B]`` index vector natively — per-row clamped DMAs
       keep HBM traffic O(own index) per row on long buffers. Falls back to
       the einsum when the buffer does not tile.
+    - ``use_kernel=None``: consult the autotuner's tuning DB for this
+      buffer's (shape, dtype, backend) — a recorded winner picks the
+      schedule (and the kernel's block); untuned shapes keep the einsum.
+      This is how ``serving/engine.py`` defers its dispatch decision to
+      measurements (``EngineConfig(use_kernel=None)``).
 
     Not differentiable; decode is inference-only.
     """
@@ -310,6 +341,12 @@ def batched_decode_attention(
         raise ValueError(
             f"index must be [{batch}] (one fill level per row), got {index.shape}"
         )
+    if use_kernel is None:
+        use_kernel, tuned_block = _tuned_decode_schedule(
+            k_buf.shape, k_buf.dtype
+        )
+        if tuned_block:
+            block = tuned_block
     if use_kernel:
         from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
             decode_block_fits,

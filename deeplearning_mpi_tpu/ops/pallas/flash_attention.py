@@ -581,6 +581,38 @@ def _bwd_pallas(
     return _swap_sh(dq), _swap_sh(dk), _swap_sh(dv)
 
 
+#: Module defaults, from the v5e sweep documented on
+#: :func:`flash_attention` — what an untuned call resolves to. A tuning DB
+#: (``compiler/autotune.py``) overrides per (shape, dtype, backend);
+#: explicit kwargs override everything.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def resolve_blocks(
+    block_q: int | None, block_k: int | None,
+    shape: tuple[int, ...], dtype,
+) -> tuple[int, int]:
+    """Block-size resolution: explicit kwarg > tuning-DB entry for this
+    ``[B, S, H, D]`` shape > module default. The DB consult can never
+    raise or change numerics — only which (verified-equivalent) tiling
+    runs."""
+    if block_q is not None and block_k is not None:
+        return block_q, block_k
+    tuned = None
+    try:
+        from deeplearning_mpi_tpu.compiler.autotune import (
+            tuned_attention_blocks,
+        )
+
+        tuned = tuned_attention_blocks(tuple(shape), dtype)
+    except Exception:
+        tuned = None
+    tq, tk = tuned if tuned else (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    return (block_q if block_q is not None else tq,
+            block_k if block_k is not None else tk)
+
+
 def fit_block(block: int, seq: int) -> int:
     """Shrink ``block`` (by halving, preserving MXU-friendly sizes) until it
     divides ``seq``: seq=1536 with the 1024 default tiles at 512 instead of
@@ -674,12 +706,19 @@ def flash_attention(
     *,
     causal: bool = True,
     window: int | None = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Tiled flash attention over ``[B, S, H, D]`` (drop-in for
     ``dense_attention`` and valid as ``TransformerLM(attention_fn=...)``).
+
+    ``block_q``/``block_k=None`` (the default) resolve through
+    :func:`resolve_blocks`: an autotuned entry for this exact (shape,
+    dtype, backend) when a tuning DB is installed
+    (``compiler.autotune.set_default_db`` / ``$DMT_TUNING_DB``), else the
+    1024×1024 module defaults — unchanged behavior for untuned callers.
+    Explicit ints pin the blocks regardless of any DB.
 
     ``window``: sliding-window (local) attention — each query sees only its
     last ``window`` keys, self included. Whole kv blocks outside every
@@ -703,6 +742,7 @@ def flash_attention(
     """
     window = _check_window(window, causal, q.shape[1])
     seq = q.shape[1]
+    block_q, block_k = resolve_blocks(block_q, block_k, q.shape, q.dtype)
     bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
     if not usable_blocks(bq, bk, seq):
         return dense_attention(q, k, v, causal=causal, window=window)
@@ -719,13 +759,16 @@ def flash_attention_bhsd(
     *,
     causal: bool = True,
     window: int | None = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """:func:`flash_attention` over ``[B, H, S, D]`` — the kernels' native
     layout, with NO transposes at either boundary (forward or backward).
     ``window`` = sliding-window attention (see :func:`flash_attention`).
+    ``block_q``/``block_k=None`` resolve through :func:`resolve_blocks`
+    (tuning-DB overlay, module defaults otherwise) against the canonical
+    BSHD shape — one DB entry serves both layout entry points.
 
     The BSHD entry pays six ``[B,S,H,D]``-sized XLA transposes per
     layer-step (q/k/v in, o out, then the mirror set in the backward) just
@@ -743,6 +786,10 @@ def flash_attention_bhsd(
     """
     seq = q.shape[2]
     window = _check_window(window, causal, seq)
+    batch, heads, _, head_dim = q.shape
+    block_q, block_k = resolve_blocks(
+        block_q, block_k, (batch, seq, heads, head_dim), q.dtype
+    )
     bq, bk = fit_block(block_q, seq), fit_block(block_k, seq)
     if not usable_blocks(bq, bk, seq):
         bshd = dense_attention(

@@ -72,6 +72,31 @@ def quantize_kv(buf: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q.astype(jnp.int8), scales
 
 
+#: Module default for the KV block — what an untuned :func:`flash_decode`
+#: call resolves to. A tuning DB entry for the buffer's exact (shape,
+#: dtype, backend) overrides it; an explicit ``block=`` kwarg overrides
+#: everything (``ops.attention`` passes the fitted block explicitly).
+DEFAULT_DECODE_BLOCK = 1024
+
+
+def resolve_decode_block(block: int | None, shape: tuple[int, ...], dtype) -> int:
+    """Block resolution: explicit kwarg > tuning-DB ``flash_decode`` entry
+    for this ``[B, L, Hkv, D]`` buffer > module default. Never raises."""
+    if block is not None:
+        return block
+    try:
+        from deeplearning_mpi_tpu.compiler.autotune import (
+            tuned_decode_schedule,
+        )
+
+        tuned = tuned_decode_schedule(tuple(shape), dtype)
+        if tuned and tuned.get("block"):
+            return int(tuned["block"])
+    except Exception:
+        pass
+    return DEFAULT_DECODE_BLOCK
+
+
 def _decode_kernel(
     idx_ref, q_ref, *refs,
     block: int, kv_heads: int, group: int, scale: float,
@@ -157,7 +182,7 @@ def flash_decode(
     v_buf: jax.Array,
     index: jax.Array,
     *,
-    block: int = 1024,
+    block: int | None = None,
     interpret: bool | None = None,
     window: int | None = None,
     k_scale: jax.Array | None = None,
@@ -170,7 +195,9 @@ def flash_decode(
     ``[B, 1, H, D]``, grouped cache buffers ``[B, L, Hkv, D]``, positions
     ``0..index`` filled (``window``: attend the last ``window`` of them
     only); returns ``[B, 1, H, D]``. Caller guarantees ``L % block == 0``
-    (see :func:`decode_block_fits`).
+    (see :func:`decode_block_fits`). ``block=None`` resolves through
+    :func:`resolve_decode_block` — a tuning-DB entry for this buffer shape
+    when installed, else the 1024 module default.
 
     ``index`` may be a scalar (every row at the same fill — the single-
     sequence CLI path) or ``[B]`` (per-row fills — continuous-batching
@@ -192,6 +219,7 @@ def flash_decode(
     batch, q_len, heads, head_dim = q.shape
     length, kv_heads = k_buf.shape[1], k_buf.shape[2]
     group = heads // kv_heads
+    block = resolve_decode_block(block, k_buf.shape, k_buf.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n_blocks = length // block

@@ -37,23 +37,17 @@ __all__ = [
 def buffer_donation_supported() -> bool:
     """Whether ``jit`` buffer donation is safe on this backend configuration.
 
-    False on XLA:CPU when the persistent compilation cache is enabled:
-    executing a cache-DESERIALIZED executable with donated inputs after an
-    in-process orbax/tensorstore checkpoint restore corrupts the native
-    heap — segfault or ``malloc()`` abort inside
-    ``ThunkExecutor::ProcessOutEdges`` (jaxlib 0.4.36; reproduced with a
-    30-line jit+orbax script; fresh-compiled executables and non-donating
-    deserialized ones are both immune). That sequence is exactly crash
-    auto-resume — train, crash, restore, retrain — under a warm compile
-    cache, the configuration the test suite runs. Donation is a memory
-    optimization, never semantics, so the guard costs only transient
-    buffers on the backend where model state is smallest; TPU/GPU and
-    cache-less CPU runs keep donating.
+    Back-compat shim over ``compiler.cache.donation_safe`` — the hazard is
+    a persistent-compile-cache property (donated inputs + a cache-
+    DESERIALIZED executable corrupt the heap on XLA:CPU), so the policy
+    lives with the cache's owner, ``deeplearning_mpi_tpu/compiler/cache.py``,
+    which documents the full failure mode and carries the regression test
+    (``tests/test_compiler.py``). Existing call sites (trainer and serving
+    jit construction) keep this name.
     """
-    return not (
-        jax.default_backend() == "cpu"
-        and jax.config.jax_compilation_cache_dir
-    )
+    from deeplearning_mpi_tpu.compiler.cache import donation_safe
+
+    return donation_safe()
 
 _NEW_SHARD_MAP = getattr(jax, "shard_map", None)
 if _NEW_SHARD_MAP is None:

@@ -98,8 +98,11 @@ class EngineConfig:
     max_queue: int = 64
     #: dispatch batched decode attention to the Pallas flash_decode kernel
     #: (which consumes the per-row index vector natively); False = the
-    #: dense einsum schedule
-    use_kernel: bool = False
+    #: dense einsum schedule; None = consult the autotuner's tuning DB for
+    #: the gathered-buffer shape (``compiler/autotune.py`` — a recorded
+    #: ``flash_decode`` winner picks the schedule and block, untuned shapes
+    #: keep the einsum)
+    use_kernel: bool | None = False
 
     @property
     def max_seq_len(self) -> int:
@@ -183,14 +186,95 @@ class ServingEngine:
                 registry.gauge(name)
             registry.histogram("serve_ttft_s")
             registry.histogram("serve_tpot_s")
+            registry.histogram("serve_compile_seconds")
+            registry.counter("serve_compile_total")
         # KV-cache donation, vetoed where unsafe (XLA:CPU + persistent
-        # compile cache — compat.buffer_donation_supported): the engine
-        # restores weights from disk and then runs these jitted steps, the
-        # exact restore-then-execute sequence that corrupts the heap with
-        # donated cache-deserialized executables.
+        # compile cache — compiler.cache.donation_safe, reached through the
+        # compat shim): the engine restores weights from disk and then runs
+        # these jitted steps, the exact restore-then-execute sequence that
+        # corrupts the heap with donated cache-deserialized executables.
         kv_donate = (1, 2) if buffer_donation_supported() else ()
-        self._decode_fn = jax.jit(self._decode_step, donate_argnums=kv_donate)
-        self._prefill_fn = jax.jit(self._prefill_chunk, donate_argnums=kv_donate)
+        self._decode_jit = jax.jit(self._decode_step, donate_argnums=kv_donate)
+        self._prefill_jit = jax.jit(self._prefill_chunk, donate_argnums=kv_donate)
+        # Lazily-compiling entry points until warmup() swaps in the AOT
+        # executables; the wrappers record first-call (= compile) wall time
+        # into serve_compile_seconds.
+        self._decode_fn = self._timed_first_call(self._decode_jit)
+        self._prefill_fn = self._timed_first_call(self._prefill_jit)
+
+    def _timed_first_call(self, jitted: Callable[..., Any]) -> Callable[..., Any]:
+        """Wrap a jitted program so its first dispatch — the one that pays
+        tracing + XLA compilation — lands in ``serve_compile_seconds``. A
+        warmed engine replaces this wrapper entirely, so the histogram then
+        holds warmup's compile times instead."""
+        state = {"first": True}
+
+        def call(*args: Any) -> Any:
+            if not state["first"]:
+                return jitted(*args)
+            state["first"] = False
+            t0 = time.perf_counter()
+            out = jitted(*args)
+            if self._metrics is not None:
+                self._metrics.histogram("serve_compile_seconds").observe(
+                    time.perf_counter() - t0
+                )
+            return out
+
+        return call
+
+    def warmup(self, *, cache: Any = None) -> dict[str, Any]:
+        """AOT-compile both serving programs before traffic.
+
+        Lowers and compiles the batched decode step and the chunked-prefill
+        program at their exact serving shapes (every jitted shape is static
+        by design — see the module docstring — so warmup's avals are the
+        only avals the engine will ever call with), then swaps the compiled
+        executables into the hot path wrapped in
+        :class:`~deeplearning_mpi_tpu.compiler.aot.WarmProgram`. A compiled
+        executable never retraces, so a warmed engine performs ZERO
+        compiles on its first request — asserted by the
+        ``serve_compile_total`` trace counter in ``tests/test_compiler.py``
+        and the ``tools/autotune.py --selftest`` acceptance check.
+
+        ``cache`` is an optional
+        :class:`~deeplearning_mpi_tpu.compiler.cache.CompileCache`; under a
+        persistent cache directory a restarted engine's warmup
+        deserializes instead of compiling (``compile_cache_hit_total``).
+        Compile wall time lands in ``serve_compile_seconds``. Returns the
+        compiled programs by name.
+        """
+        from deeplearning_mpi_tpu.compiler import aot
+
+        e = self.engine
+        reg = aot.WarmupRegistry(registry=self._metrics, cache=cache)
+        slots_i32 = jnp.zeros((e.max_slots,), jnp.int32)
+        reg.register(
+            "serve_decode_step", self._decode_jit,
+            self.params, self._k, self._v,
+            jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
+            slots_i32, slots_i32, jnp.zeros((e.max_slots,), bool),
+        )
+        reg.register(
+            "serve_prefill_chunk", self._prefill_jit,
+            self.params, self._k, self._v,
+            jnp.zeros((e.max_blocks_per_seq,), jnp.int32),
+            jnp.zeros((e.prefill_chunk,), jnp.int32),
+            jnp.int32(0), jnp.int32(1),
+        )
+        programs = reg.warm_all()
+        if self._metrics is not None:
+            for prog in programs.values():
+                self._metrics.histogram("serve_compile_seconds").observe(
+                    prog.lower_seconds + prog.compile_seconds
+                )
+        self._decode_fn = aot.WarmProgram(
+            programs["serve_decode_step"], self._decode_jit
+        )
+        self._prefill_fn = aot.WarmProgram(
+            programs["serve_prefill_chunk"], self._prefill_jit
+        )
+        return programs
 
     # -- public API ---------------------------------------------------------
     def submit(
@@ -470,6 +554,11 @@ class ServingEngine:
         tokens: jax.Array,   # [S] int32 token fed this step (position len-1)
         active: jax.Array,   # [S] bool
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        # Host side effect at TRACE time only: one tick per compilation of
+        # this program. A warmed engine calls the AOT executable directly
+        # (never retraces), so "zero compiles on the first request" is an
+        # assertable counter delta, not a timing heuristic.
+        self._inc("serve_compile_total")
         cfg, e = self.config, self.engine
         S, MB, BS = e.max_slots, e.max_blocks_per_seq, e.block_size
         L = MB * BS
@@ -524,6 +613,8 @@ class ServingEngine:
         start: jax.Array,   # scalar int32: absolute position of tokens[0]
         n_valid: jax.Array,  # scalar int32: real rows in the chunk
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        # Trace-time compile tick — see _decode_step.
+        self._inc("serve_compile_total")
         cfg, e = self.config, self.engine
         MB, BS, C = e.max_blocks_per_seq, e.block_size, e.prefill_chunk
         L = MB * BS

@@ -69,6 +69,37 @@ def device_peak_flops(device: Any | None = None) -> float:
     return CPU_NOMINAL_PEAK_FLOPS
 
 
+def xla_cost_analysis(compiled: Any) -> dict[str, float]:
+    """FLOPs / bytes the compiled executable will actually execute, from
+    XLA's own cost analysis — the *measured* complement to the analytic
+    estimators below (which count only the model's useful math and are what
+    MFU is defined over; XLA's number additionally includes remat, padding,
+    and masked work, so comparing the two bounds the overhead).
+
+    Accepts a ``jax.stages.Compiled`` (``compiler/aot.py`` passes one per
+    warmed program). jaxlib 0.4.x returns a list of one dict keyed
+    ``'flops'`` / ``'bytes accessed'``; newer jax returns the dict
+    directly — both are handled. Returns ``{}`` where the backend exposes
+    nothing (keys absent, never faked — same convention as ``hbm_usage``).
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out: dict[str, float] = {}
+    flops = ca.get("flops")
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["flops"] = float(flops)
+    nbytes = ca.get("bytes accessed")
+    if isinstance(nbytes, (int, float)) and nbytes > 0:
+        out["bytes_accessed"] = float(nbytes)
+    return out
+
+
 def mfu(
     flops_per_step: float,
     step_seconds: float,

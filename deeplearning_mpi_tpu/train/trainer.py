@@ -579,6 +579,47 @@ class Trainer:
         elif jax.process_index() == 0:
             print(msg)
 
+    def warmup(self, batch: Batch, *, cache: Any = None) -> Any:
+        """AOT-compile the train step for ``batch``'s shapes before the loop.
+
+        Pays the compile outside the timed epoch (step 0 stops hiding it in
+        ``images_per_s``) and swaps ``self.train_step`` for the compiled
+        executable wrapped in a shape-mismatch fallback
+        (``compiler.aot.WarmProgram``) — a later loader with different batch
+        shapes silently falls back to the original jit, it does not crash.
+
+        Side effects on the trainer's registry: ``train_compile_seconds``
+        gauge, ``compile_cache_{hit,miss}_total`` counters (via the
+        ``CompileCache`` built here or passed in), and — when XLA's cost
+        analysis yields them — ``xla_flops_per_step`` / ``xla_bytes_per_step``
+        gauges. When the caller gave no analytic ``flops_per_step``, the XLA
+        count backfills it so epoch MFU appears without manual accounting.
+
+        Call AFTER :meth:`place_state` — placement may rebuild the step, and
+        the compile must see the final placement's avals.
+        """
+        from deeplearning_mpi_tpu.compiler import aot
+
+        prog = aot.compile_program(
+            "train_step", self.train_step, self.state, batch,
+            registry=self.metrics, cache=cache,
+        )
+        self.metrics.gauge("train_compile_seconds").set(
+            prog.lower_seconds + prog.compile_seconds
+        )
+        if prog.flops:
+            self.metrics.gauge("xla_flops_per_step").set(prog.flops)
+            if not self.flops_per_step:
+                self.flops_per_step = prog.flops
+        if prog.bytes_accessed:
+            self.metrics.gauge("xla_bytes_per_step").set(prog.bytes_accessed)
+        self.train_step = aot.WarmProgram(prog, self.train_step)
+        self._log(
+            f"warmup: train_step compiled in {prog.compile_seconds:.2f}s "
+            f"(cache {'hit' if prog.cache_hit else 'miss' if prog.cache_hit is not None else 'n/a'})"
+        )
+        return prog
+
     #: step window traced when a profiler is attached (skips compile steps).
     PROFILE_STEPS = (3, 6)
 

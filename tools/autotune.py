@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Offline Pallas kernel autotuner — search block sizes, write a tuning DB.
+
+    # tune flash attention + the decode schedule for serving shapes,
+    # persist the winners (the kernels consult this DB at call-site)
+    python tools/autotune.py --db tuned.json \
+        --attn_shape 4x4096x8x64 --decode_shape 8x2048x8x64
+
+    # consume it
+    python -m deeplearning_mpi_tpu.cli.serve_lm --tuning_db tuned.json ...
+    DMT_TUNING_DB=tuned.json python -m deeplearning_mpi_tpu.cli.train_lm ...
+
+    python tools/autotune.py --selftest   # CI gate (`make tune-smoke`)
+
+Shapes are ``BxSxHxD`` for attention (the BSHD call layout) and
+``BxLxHkvxD`` for the decode KV buffer. Every candidate is verified
+against the dense oracle before it may win, so the DB can only ever make
+things faster, never wrong (``deeplearning_mpi_tpu/compiler/autotune.py``;
+docs/COMPILATION.md).
+
+``--selftest`` runs the full acceptance loop on tiny CPU shapes: tune both
+kernels, round-trip the DB, check tuned kernels match the defaults
+numerically, then AOT-warm two serving engines against one persistent
+compile cache — the second engine must see cache HITS and serve its first
+request with ZERO compiles (the ``serve_compile_total`` trace counter).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# Runnable as `python tools/autotune.py` from anywhere — the package root
+# is this file's grandparent, not necessarily on sys.path.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _parse_shape(spec: str, what: str) -> tuple[int, int, int, int]:
+    try:
+        dims = tuple(int(d) for d in spec.lower().split("x"))
+        if len(dims) != 4 or any(d <= 0 for d in dims):
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"bad {what} '{spec}': want 4 positive dims like 4x4096x8x64"
+        )
+    return dims
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dmt-autotune", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("--db", default="tuned.json",
+                        help="tuning DB to create or update (existing "
+                        "entries for other keys are kept)")
+    parser.add_argument("--attn_shape", action="append", default=[],
+                        metavar="BxSxHxD",
+                        help="flash-attention shape to tune (repeatable)")
+    parser.add_argument("--decode_shape", action="append", default=[],
+                        metavar="BxLxHkvxD",
+                        help="decode KV-buffer shape to tune (repeatable)")
+    parser.add_argument("--heads", type=int, default=None,
+                        help="query heads for decode tuning (default: Hkv "
+                        "— no GQA)")
+    parser.add_argument("--dtype", default="float32",
+                        choices=("float32", "bfloat16"))
+    parser.add_argument("--blocks", default=None,
+                        help="comma-separated candidate block sizes "
+                        "(default: the module's search space)")
+    parser.add_argument("--repeats", type=int, default=3,
+                        help="timing repeats per candidate (median wins)")
+    parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
+    parser.add_argument("--selftest", action="store_true",
+                        help="tiny-shape end-to-end check: tune, round-trip "
+                        "the DB, verify numerics, and prove a warmed engine "
+                        "compiles nothing on its first request")
+    return parser
+
+
+def selftest() -> int:
+    """The `make tune-smoke` acceptance loop (ISSUE 4); CPU-safe, <1 min."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.compiler import autotune
+    from deeplearning_mpi_tpu.compiler import cache as ccache
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.ops.pallas import flash_attention
+    from deeplearning_mpi_tpu.serving import (
+        EngineConfig,
+        RequestState,
+        ServingEngine,
+    )
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+    ok = True
+
+    def check(cond: bool, label: str) -> None:
+        nonlocal ok
+        print(("PASS " if cond else "FAIL ") + label, file=sys.stderr)
+        ok = ok and cond
+
+    with tempfile.TemporaryDirectory(prefix="dmt_tune_") as td:
+        # 1. Tune both kernels on tiny shapes; persist the DB.
+        db_path = Path(td) / "tuning.json"
+        db = autotune.TuningDB(db_path)
+        attn_shape = (1, 64, 2, 16)
+        attn = autotune.tune_flash_attention(
+            attn_shape, db=db, candidates=(16, 32, 64), repeats=1,
+        )
+        check(bool(attn), f"attention tuned: {attn}")
+        dec = autotune.tune_flash_decode(
+            (2, 64, 2, 16), db=db, blocks=(16, 32), repeats=1,
+        )
+        check(dec.get("schedule") in ("kernel", "einsum"),
+              f"decode tuned: {dec}")
+        db.save()
+
+        # 2. Round-trip: reload and look the winners back up.
+        db2 = autotune.TuningDB.load(db_path)
+        check(len(db2) == 2, f"DB round-trip: {len(db2)} entries")
+        check(
+            db2.lookup("flash_attention", attn_shape, jnp.float32) == attn,
+            "DB lookup returns the recorded winner",
+        )
+
+        # 3. Tuned kernel matches the default kernel numerically — both
+        # explicitly-threaded blocks and the DB-consulting default path.
+        kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+        q = jax.random.normal(kq, attn_shape)
+        k = jax.random.normal(kk, attn_shape)
+        v = jax.random.normal(kv, attn_shape)
+        default_out = flash_attention(q, k, v)
+        tuned_out = flash_attention(
+            q, k, v, block_q=attn["block_q"], block_k=attn["block_k"]
+        )
+        check(
+            bool(jnp.allclose(tuned_out, default_out, rtol=2e-5, atol=2e-5)),
+            "tuned blocks match default-kernel output",
+        )
+        autotune.set_default_db(db2)
+        try:
+            db_out = flash_attention(q, k, v)  # blocks resolved from the DB
+            check(
+                bool(jnp.allclose(db_out, default_out, rtol=2e-5, atol=2e-5)),
+                "DB-resolved blocks match default-kernel output",
+            )
+        finally:
+            autotune.set_default_db(None)
+
+        # 4. Warm-engine contract under one persistent compile cache: the
+        # second engine's warmup deserializes (cache hits) and its first
+        # request triggers zero compiles (the trace counter stays put).
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            ccache.enable(Path(td) / "xla_cache")
+            cfg = TransformerConfig.tiny()
+            model = TransformerLM(config=cfg, dtype=jnp.float32)
+            params = model.init(
+                jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+            eng_cfg = EngineConfig(
+                max_slots=2, block_size=8, num_blocks=16,
+                max_blocks_per_seq=4, prefill_chunk=8, max_queue=8,
+            )
+
+            def make_engine():
+                registry = MetricsRegistry()
+                engine = ServingEngine(
+                    cfg, params, eng_cfg,
+                    dtype=jnp.float32, registry=registry,
+                )
+                engine.warmup(cache=ccache.CompileCache(registry=registry))
+                return engine, registry
+
+            make_engine()  # cold: populates the persistent cache
+            engine, registry = make_engine()  # warm: must hit
+            hits = registry.counter("compile_cache_hit_total").value
+            check(hits > 0, f"warm engine start: compile_cache_hit_total={hits}")
+
+            before = registry.counter("serve_compile_total").value
+            req = engine.submit(np.arange(1, 9, dtype=np.int32), 4)
+            while not engine.scheduler.idle():
+                engine.step()
+            after = registry.counter("serve_compile_total").value
+            check(
+                req.state is RequestState.FINISHED,
+                f"first request finished ({len(req.generated)} tokens)",
+            )
+            check(
+                after == before,
+                f"zero compiles on first request "
+                f"(serve_compile_total {before} -> {after})",
+            )
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_min
+            )
+            ccache._reset_backend_cache()  # un-pin the tmp dir
+
+    print("tune-smoke " + ("OK" if ok else "FAILED"), file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    if args.selftest:
+        return selftest()
+    if not args.attn_shape and not args.decode_shape:
+        print("nothing to tune: pass --attn_shape and/or --decode_shape "
+              "(or --selftest)", file=sys.stderr)
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning_mpi_tpu.compiler import autotune
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    blocks = (
+        tuple(int(b) for b in args.blocks.split(",")) if args.blocks else None
+    )
+    db = autotune.TuningDB.load(args.db)
+    print(f"backend: {jax.default_backend()}, DB: {args.db} "
+          f"({len(db)} existing entries)", file=sys.stderr)
+    for spec in args.attn_shape:
+        shape = _parse_shape(spec, "--attn_shape")
+        params = autotune.tune_flash_attention(
+            shape, dtype, db=db, candidates=blocks, repeats=args.repeats,
+        )
+        print(f"flash_attention {spec}: {params or 'no legal candidate'}",
+              file=sys.stderr)
+    for spec in args.decode_shape:
+        shape = _parse_shape(spec, "--decode_shape")
+        params = autotune.tune_flash_decode(
+            shape, dtype, heads=args.heads, db=db, blocks=blocks,
+            repeats=args.repeats,
+        )
+        print(f"flash_decode {spec}: {params}", file=sys.stderr)
+    db.save()
+    print(f"wrote {args.db}: {len(db)} entries", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
