@@ -4,13 +4,14 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke
+.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke pod-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
-# serve-smoke and chaos-smoke prerequisites gate the tier-1 run on the
-# serving engine's end-to-end parity selftest and the fault-injection
-# recovery drill without touching the ROADMAP command itself.
-verify: serve-smoke chaos-smoke tune-smoke
+# serve-smoke, chaos-smoke, tune-smoke, and pod-smoke prerequisites gate
+# the tier-1 run on the serving engine's end-to-end parity selftest, the
+# fault-injection recovery drill, the autotune loop, and the elastic-pod
+# rank-failure drill without touching the ROADMAP command itself.
+verify: serve-smoke chaos-smoke tune-smoke pod-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -67,3 +68,13 @@ chaos-smoke:
 		--metrics_dir /tmp/dmt_chaos/metrics \
 		--model_dir /tmp/dmt_chaos/models --log_dir /tmp/dmt_chaos/logs
 	env JAX_PLATFORMS=cpu python -c 'import json; recs = [json.loads(l) for l in open("/tmp/dmt_chaos/metrics/metrics.jsonl")]; s = [r for r in recs if r["kind"] == "run_summary"][-1]; f, r, b = (s.get(k, 0) for k in ("fault_injected_total", "recovery_total", "rollback_total")); assert f >= 2 and f == r + b, (f, r, b); print("chaos-smoke OK: injected=%d recovered=%d rolled_back=%d" % (f, r, b))'
+
+# Elastic-pod rank-failure drill (docs/RESILIENCE.md "Elastic pods",
+# docs/TPU_POD_RUNBOOK.md): a 2-process CPU pod loses rank 1 to a planned
+# rank_kill mid-epoch-1; the supervisor must detect it, re-form a world of
+# one, resume from the epoch-0 checkpoint, and land on a loss trajectory
+# bit-identical to a clean single-process from-checkpoint run — with the
+# pod-level chaos books reconciling in pod_metrics.jsonl.
+pod-smoke:
+	env JAX_PLATFORMS=cpu python tools/pod_drill.py --fault rank_kill \
+		--root /tmp/dmt_pod_smoke

@@ -1,0 +1,94 @@
+"""Elastic pod launcher — the framework's ``torchrun``.
+
+Where the reference launches every multi-process run through ``torchrun
+--nproc-per-node ... train.py`` (``pytorch/unet/run.sh:100-112``), this CLI
+wraps any training command in the :class:`~..resilience.pod.PodSupervisor`:
+one worker process per simulated host, pod-level liveness from aggregated
+heartbeats, and on a rank loss an elastic re-form onto the survivors —
+smaller world, fresh rendezvous, resume from the latest verified checkpoint.
+
+Usage::
+
+    dmt-launch-pod --num_processes 2 --pod_dir /tmp/pod \\
+        --chaos rank_kill@step:6 -- \\
+        python -m deeplearning_mpi_tpu.cli.train_lm --platform cpu --resume ...
+
+Everything after ``--`` is the worker command, run verbatim once per rank
+with the rendezvous/heartbeat/chaos env contract injected. The worker MUST
+pass ``--resume`` (a re-formed world that starts from scratch defeats the
+point). Exit status: 0 when every rank of the final world exits 0, 1 when
+the pod fails (survivors below ``--min_world_size`` or restart budget
+spent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from deeplearning_mpi_tpu.resilience.pod import PodFailure, PodSupervisor
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dmt-launch-pod",
+        description="Supervise an elastic multi-process (simulated pod) run.",
+    )
+    p.add_argument("--num_processes", type=int, required=True,
+                   help="initial world size (one worker process per rank)")
+    p.add_argument("--pod_dir", required=True,
+                   help="supervisor state: heartbeats, per-rank logs, "
+                        "pod_metrics.jsonl")
+    p.add_argument("--chaos", default=None,
+                   help="chaos spec forwarded to workers via $DMT_CHAOS; "
+                        "rank_kill/rank_hang entries are accounted here")
+    p.add_argument("--heartbeat_deadline_s", type=float, default=60.0,
+                   help="progress stall past this = hung rank")
+    p.add_argument("--heartbeat_interval_s", type=float, default=1.0,
+                   help="worker heartbeat cadence ($DMT_HEARTBEAT_INTERVAL_S)")
+    p.add_argument("--spawn_grace_s", type=float, default=120.0,
+                   help="startup window (spawn+import+compile) before a "
+                        "never-progressed rank counts as hung")
+    p.add_argument("--poll_interval_s", type=float, default=0.5)
+    p.add_argument("--min_world_size", type=int, default=1,
+                   help="fail the pod rather than re-form below this")
+    p.add_argument("--max_pod_restarts", type=int, default=2)
+    p.add_argument("--straggler_factor", type=float, default=4.0,
+                   help="flag a rank whose progress age exceeds this multiple "
+                        "of the median inter-progress interval")
+    p.add_argument("worker_cmd", nargs=argparse.REMAINDER,
+                   help="worker command (prefix with --); must pass --resume")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.worker_cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("dmt-launch-pod: no worker command given (after --)",
+              file=sys.stderr)
+        return 2
+    sup = PodSupervisor(
+        cmd,
+        args.num_processes,
+        args.pod_dir,
+        chaos=args.chaos,
+        heartbeat_deadline_s=args.heartbeat_deadline_s,
+        heartbeat_interval_s=args.heartbeat_interval_s,
+        spawn_grace_s=args.spawn_grace_s,
+        poll_interval_s=args.poll_interval_s,
+        min_world_size=args.min_world_size,
+        max_pod_restarts=args.max_pod_restarts,
+        straggler_factor=args.straggler_factor,
+    )
+    try:
+        result = sup.run()
+    except PodFailure:
+        return 1
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,12 @@ from deeplearning_mpi_tpu.resilience.integrity import (  # noqa: F401
     dir_digests,
     tree_digests,
 )
+from deeplearning_mpi_tpu.resilience.pod import (  # noqa: F401
+    LivenessTracker,
+    PodFailure,
+    PodResult,
+    PodSupervisor,
+)
 from deeplearning_mpi_tpu.resilience.preemption import (  # noqa: F401
     GracefulShutdown,
     Preempted,
@@ -45,6 +51,7 @@ from deeplearning_mpi_tpu.resilience.supervisor import (  # noqa: F401
     Heartbeat,
     TrainingFailure,
     preflight,
+    restart_delay,
     run_with_auto_resume,
 )
 from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F401
@@ -58,6 +65,10 @@ __all__ = [
     "Heartbeat",
     "InjectedFault",
     "InjectedKill",
+    "LivenessTracker",
+    "PodFailure",
+    "PodResult",
+    "PodSupervisor",
     "Preempted",
     "ResilientLoader",
     "TrainingFailure",
@@ -65,6 +76,7 @@ __all__ = [
     "corrupt_checkpoint",
     "dir_digests",
     "preflight",
+    "restart_delay",
     "run_with_auto_resume",
     "tree_digests",
 ]

@@ -28,7 +28,22 @@ kind             unit    injection site
                          batch) — the watchdog must quarantine it
 ``serve_crash``   step   serving engine raises mid-step — recovery must
                          requeue in-flight sequences and reconcile the pool
+``rank_kill``     step   the TARGET RANK hard-exits (``os._exit``) — a
+                         simulated host loss only the pod supervisor can
+                         survive (in-process auto-resume never sees it)
+``rank_hang``     step   the target rank's training thread blocks forever
+                         while its heartbeat daemon keeps beating — the
+                         hung-collective shape: liveness must watch progress,
+                         not file freshness
 ===============  ======  =====================================================
+
+``rank_kill``/``rank_hang`` are *pod-level* kinds (:data:`POD_KINDS`): the
+faulted process cannot account for its own fault (it is dead or wedged), so
+the pod supervisor (:mod:`.pod`) carries their accounting — it marks the
+spec fired when it observes the failure (:meth:`ChaosInjector.fire_observed`)
+and records the recovery when the re-formed world makes progress. The target
+rank defaults to the last rank (``process_count - 1``); ``$DMT_CHAOS_RANK``
+overrides.
 
 Accounting contract (the reconciliation invariant): every fault increments
 ``fault_injected_total`` exactly once when it first fires, and the layer
@@ -56,6 +71,7 @@ from deeplearning_mpi_tpu.telemetry.registry import labeled
 
 __all__ = [
     "ChaosInjector",
+    "ENV_RANK",
     "ENV_SPEC",
     "ENV_STALL",
     "FAULT_INJECTED",
@@ -63,9 +79,13 @@ __all__ = [
     "FaultSpec",
     "InjectedFault",
     "InjectedKill",
+    "POD_KINDS",
+    "RANK_KILL_EXIT",
     "RECOVERY",
     "RECOVERY_LATENCY",
     "ROLLBACK",
+    "pod_entries",
+    "strip_entries",
 ]
 
 #: trigger unit per fault kind — the grammar's validity table.
@@ -76,7 +96,17 @@ FAULT_UNITS = {
     "loader_stall": "batch",
     "loader_die": "batch",
     "serve_crash": "step",
+    "rank_kill": "step",
+    "rank_hang": "step",
 }
+
+#: kinds whose accounting lives in the pod supervisor, not the worker: the
+#: faulted process is dead or wedged before it could emit a run_summary.
+POD_KINDS = frozenset({"rank_kill", "rank_hang"})
+
+#: exit code of a rank_kill'd worker — distinguishable from collateral
+#: crashes (a peer's collective erroring out) in the supervisor's logs.
+RANK_KILL_EXIT = 23
 
 #: kinds that keep firing on retries of the same trigger (a poison batch is
 #: poison every attempt); still COUNTED once — the fault is one event, the
@@ -93,8 +123,62 @@ RECOVERY_LATENCY = "recovery_latency_s"
 ENV_SPEC = "DMT_CHAOS"
 #: env override for the stall sleep (seconds).
 ENV_STALL = "DMT_CHAOS_STALL_S"
+#: env override for the rank a rank_kill/rank_hang targets (default: last).
+ENV_RANK = "DMT_CHAOS_RANK"
 
 _ENTRY = re.compile(r"(\w+)@(\w+):(\d+)")
+
+
+def pod_entries(spec: str) -> list[str]:
+    """The ``kind@unit:at`` tokens of ``spec`` whose kind is pod-level."""
+    return [
+        e.strip()
+        for e in spec.split(",")
+        if e.strip() and e.strip().split("@", 1)[0] in POD_KINDS
+    ]
+
+
+def strip_entries(spec: str, entries: list[str]) -> str:
+    """Remove each token in ``entries`` from ``spec`` once (first match).
+
+    The supervisor strips a pod fault it has accounted as fired before
+    respawning the world: a resumed worker restarts its step counter at 0,
+    so an unstripped ``rank_kill@step:N`` would fire again every attempt.
+    """
+    remaining = list(entries)
+    kept = []
+    for token in (e.strip() for e in spec.split(",")):
+        if token and token in remaining:
+            remaining.remove(token)
+            continue
+        if token:
+            kept.append(token)
+    return ",".join(kept)
+
+
+def _exit_rank(step: int) -> None:
+    """``rank_kill`` lands here: a hard exit no in-process handler can catch
+    — ``os._exit`` skips atexit/finally, exactly like a host loss. Module-
+    level so tests can monkeypatch the detonation."""
+    print(
+        f"chaos: injected rank_kill@step:{step} — hard exit "
+        f"{RANK_KILL_EXIT} (simulated host loss)",
+        flush=True,
+    )
+    os._exit(RANK_KILL_EXIT)
+
+
+def _hang_rank(step: int) -> None:
+    """``rank_hang`` lands here: block the calling (training) thread forever.
+    The heartbeat daemon thread keeps beating, so the file stays fresh while
+    progress freezes — the signature of a hung collective."""
+    print(
+        f"chaos: injected rank_hang@step:{step} — training thread blocked "
+        "(heartbeat daemon still beating)",
+        flush=True,
+    )
+    while True:
+        time.sleep(60.0)
 
 
 class InjectedFault(RuntimeError):
@@ -250,6 +334,28 @@ class ChaosInjector:
         if self.should_fire("kill", step):
             raise InjectedKill(f"chaos: injected kill@step:{step}")
 
+    def check_rank_fault(self, *, step: int) -> None:
+        """Trainer hook: pod-level rank faults, fired on the target rank only.
+
+        The target defaults to the LAST rank (``process_count - 1`` — the
+        canonical "kill rank 1 of a 2-proc pod" drill); ``$DMT_CHAOS_RANK``
+        overrides. Non-target ranks return before :meth:`should_fire` so
+        they never count a fault they did not suffer — the pod supervisor
+        owns the authoritative accounting either way (this process is about
+        to die or wedge).
+        """
+        if not any(s.kind in POD_KINDS and not s.fired for s in self.plan.specs):
+            return
+        import jax
+
+        target = int(os.environ.get(ENV_RANK, str(jax.process_count() - 1)))
+        if jax.process_index() != target:
+            return
+        if self.should_fire("rank_kill", step):
+            _exit_rank(step)
+        if self.should_fire("rank_hang", step):
+            _hang_rank(step)
+
     def check_serve_crash(self, *, step: int) -> None:
         """Serving-engine hook, mid-step (after prefill mutated host state)."""
         if self.should_fire("serve_crash", step):
@@ -288,6 +394,23 @@ class ChaosInjector:
     def should_corrupt(self, *, epoch: int) -> bool:
         """Checkpointer hook, after a save lands."""
         return self.should_fire("corrupt_ckpt", epoch)
+
+    def fire_observed(self, kind: str) -> Optional[FaultSpec]:
+        """Supervisor-side firing: mark the oldest unfired ``kind`` spec
+        fired because its EFFECT was observed externally (a dead or hung
+        rank), rather than triggered through an in-process hook — the
+        process that detonated cannot report. Returns the spec so the
+        caller can pair the eventual :meth:`record_recovery`, or ``None``
+        when the observed failure matches no planned fault (a real crash —
+        counted by the supervisor's own failure counters, not chaos)."""
+        for spec in self.plan.specs:
+            if spec.kind == kind and not spec.fired:
+                spec.fired = True
+                spec.fired_at = time.monotonic()
+                self._inc(FAULT_INJECTED)
+                self._inc(labeled(FAULT_INJECTED, kind=kind))
+                return spec
+        return None
 
     # -- recovery accounting ------------------------------------------------
     def record_recovery(

@@ -1,0 +1,604 @@
+"""Pod supervisor: elastic multi-process training with failure re-form.
+
+The reference stack leans on ``torchrun --max-restarts`` for elasticity: an
+agent per host watches its workers, and on any failure tears down the whole
+world and re-execs it at the same size (SURVEY.md §5.3). This module is that
+agent, grown two capabilities the reference lacks:
+
+1. **Hang detection.** A wedged collective never returns to Python — the
+   worker cannot crash, so exit-code watching misses the most common pod
+   failure. Every worker's :class:`~.supervisor.Heartbeat` daemon keeps
+   beating through a hang (it is a separate thread), so file freshness is
+   NOT liveness. The supervisor instead watches ``progress_seq`` — bumped
+   only by the training loop — and timestamps observed *changes* with its
+   own monotonic clock (cross-process monotonic values are incomparable).
+   No change past ``heartbeat_deadline_s`` ⇒ the rank is hung.
+2. **Elastic re-form.** Instead of respawning at the same world size (which
+   deadlocks when a host is actually gone), the survivors re-rendezvous as
+   a SMALLER world — fresh coordinator port, ``NUM_PROCESSES`` = survivor
+   count, contiguous re-numbered ``PROCESS_ID``s — and resume from the
+   latest digest-verified checkpoint via the elastic restore path
+   (``train/checkpoint.py::restore_elastic``): orbax re-shards the saved
+   state onto the new mesh, and the loader's seed-only batch order makes
+   the resumed run bit-identical to a clean from-checkpoint run at the
+   surviving world size (``tools/pod_drill.py`` asserts exactly that).
+
+Chaos accounting: ``rank_kill``/``rank_hang`` detonate *inside* a worker,
+which is then dead or wedged — it can never emit its own run summary. The
+supervisor therefore owns their books: it marks the spec fired when it
+observes the failure (:meth:`ChaosInjector.fire_observed`), records the
+recovery when the re-formed world first makes progress, and strips the
+fired entry from the spec before respawning (workers restart their step
+count at 0, so an unstripped entry would re-fire every attempt). The
+pod-level reconciliation invariant — ``fault_injected_total ==
+recovery_total + rollback_total`` — lands in ``pod_metrics.jsonl``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import statistics
+import subprocess
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
+
+from deeplearning_mpi_tpu.resilience.faults import (
+    ENV_RANK,
+    ChaosInjector,
+    FaultPlan,
+    pod_entries,
+    strip_entries,
+)
+from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
+from deeplearning_mpi_tpu.telemetry.registry import (
+    JsonlSink,
+    MetricsRegistry,
+    labeled,
+)
+
+__all__ = [
+    "ENV_HEARTBEAT_DIR",
+    "ENV_HEARTBEAT_INTERVAL",
+    "LivenessTracker",
+    "POD_RANK_FAILURES",
+    "POD_RESTARTS",
+    "POD_STRAGGLERS",
+    "POD_WORLD_SIZE",
+    "PodFailure",
+    "PodResult",
+    "PodSupervisor",
+]
+
+#: directory workers write per-rank ``heartbeat-{rank}.json`` files into —
+#: the supervisor↔worker contract (``utils/config.py::build_observability``
+#: switches to this layout when the var is set).
+ENV_HEARTBEAT_DIR = "DMT_HEARTBEAT_DIR"
+#: heartbeat interval override (seconds) — drills crank it down to 0.2s.
+ENV_HEARTBEAT_INTERVAL = "DMT_HEARTBEAT_INTERVAL_S"
+
+POD_RANK_FAILURES = "pod_rank_failures_total"
+POD_RESTARTS = "pod_restarts_total"
+POD_WORLD_SIZE = "pod_world_size"
+POD_STRAGGLERS = "pod_straggler_flags_total"
+
+
+class PodFailure(RuntimeError):
+    """The pod cannot continue: survivors below ``min_world_size`` or the
+    restart budget is spent. Mirrors ``TrainingFailure`` one level up."""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LivenessTracker:
+    """Pod-level liveness view over per-rank heartbeat payloads.
+
+    All stall math uses THIS process's ``clock`` (injectable for tests) and
+    timestamps of observed ``progress_seq`` *changes* — never the payload's
+    own ``monotonic``/``time`` fields, which belong to another host's clock.
+
+    Three verdicts per rank:
+
+    - **stalled**: no heartbeat file within ``grace_s`` of tracker start
+      (worker never came up), no first progress within ``grace_s`` (wedged
+      in startup/compile), or no progress change within ``deadline_s``
+      after progressing at least once — the hung-collective signature.
+    - **straggler**: progressing, but its current progress age exceeds
+      ``straggler_factor`` × the median observed inter-progress interval
+      across ranks (and is still under the deadline) — slow, not dead.
+    - healthy otherwise.
+    """
+
+    def __init__(
+        self,
+        ranks: Iterable[int],
+        *,
+        deadline_s: float,
+        grace_s: float,
+        straggler_factor: float = 4.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.deadline_s = deadline_s
+        self.grace_s = grace_s
+        self.straggler_factor = straggler_factor
+        self._clock = clock
+        self._start = clock()
+        self._ranks = list(ranks)
+        self._last_seq: dict[int, Any] = {}
+        self._last_change: dict[int, float] = {}
+        self._last_step: dict[int, float] = {}
+        self._interval_ema: dict[int, float] = {}
+        self._seen_progress: set[int] = set()
+
+    def observe(self, rank: int, payload: Mapping[str, Any] | None) -> None:
+        """Feed one heartbeat read (``None`` = file missing/unreadable)."""
+        if payload is None:
+            return
+        now = self._clock()
+        if isinstance(payload.get("step"), (int, float)):
+            self._last_step[rank] = float(payload["step"])
+        seq = payload.get("progress_seq", payload.get("time"))
+        prev = self._last_seq.get(rank)
+        if prev is None:
+            self._last_seq[rank] = seq
+            self._last_change[rank] = now
+            if isinstance(seq, (int, float)) and seq and seq > 0:
+                # First read already shows training-loop progress (a fast
+                # worker beat us to it) — count it as progress, not baseline.
+                self._seen_progress.add(rank)
+            return
+        if seq != prev:
+            interval = now - self._last_change[rank]
+            if rank in self._seen_progress:
+                ema = self._interval_ema.get(rank)
+                self._interval_ema[rank] = (
+                    interval if ema is None else 0.5 * ema + 0.5 * interval
+                )
+            self._seen_progress.add(rank)
+            self._last_seq[rank] = seq
+            self._last_change[rank] = now
+
+    def any_progress(self) -> bool:
+        """True once ANY rank's training loop has demonstrably advanced —
+        the supervisor's "the re-formed world is alive" signal that closes
+        pending chaos recoveries."""
+        return bool(self._seen_progress)
+
+    def progress_age_s(self, rank: int) -> float:
+        """Seconds (supervisor clock) since ``rank`` last changed state."""
+        return self._clock() - self._last_change.get(rank, self._start)
+
+    def stalled(self, rank: int) -> bool:
+        if rank not in self._seen_progress:
+            # Startup (spawn + import + compile) gets the grace window,
+            # whether or not the heartbeat file has appeared yet.
+            return self._clock() - self._start > self.grace_s
+        return self.progress_age_s(rank) > self.deadline_s
+
+    def hang_culprits(self, stalled: Iterable[int]) -> list[int]:
+        """Pick the rank(s) that CAUSED a stall from the ranks exhibiting one.
+
+        One wedged rank stalls the whole world: every peer eventually blocks
+        inside a collective waiting for it, so after the deadline ALL ranks
+        look hung. Timing cannot break the tie (the cascade completes within
+        milliseconds), but progress content can: the culprit froze *before*
+        its step, while peers dispatched at least one step further (async
+        dispatch keeps their host loop — and progress marks — running until
+        a device fetch blocks). The culprit is therefore the stalled rank
+        with the LOWEST last-reported progress ``step``; a rank that never
+        reported a step (wedged in startup) is always a culprit. Ties mean
+        the signal is ambiguous — every tied rank is treated as a culprit
+        rather than guessing.
+        """
+        stalled = list(stalled)
+        if not stalled:
+            return []
+        steps = {r: self._last_step.get(r, float("-inf")) for r in stalled}
+        lowest = min(steps.values())
+        return [r for r in stalled if steps[r] == lowest]
+
+    def stragglers(self, active: Iterable[int]) -> list[int]:
+        known = [v for v in self._interval_ema.values() if v > 0]
+        if not known:
+            return []
+        threshold = self.straggler_factor * statistics.median(known)
+        out = []
+        for rank in active:
+            if rank not in self._seen_progress:
+                continue
+            age = self.progress_age_s(rank)
+            if threshold < age <= self.deadline_s:
+                out.append(rank)
+        return out
+
+
+@dataclasses.dataclass
+class PodResult:
+    """What a :meth:`PodSupervisor.run` accomplished."""
+
+    ok: bool
+    world_sizes: list[int]  # world size of each attempt, in order
+    restarts: int
+    rank_failures: int
+    snapshot: dict[str, Any]  # final registry snapshot (all pod counters)
+    chaos_balanced: Optional[bool]  # None when no chaos spec was given
+
+
+class PodSupervisor:
+    """Spawn one worker per simulated host, watch liveness, re-form on loss.
+
+    ``worker_cmd`` is the full training command (e.g. ``[sys.executable,
+    "-m", "deeplearning_mpi_tpu.cli.train_lm", ...]``); it MUST pass
+    ``--resume`` so a respawned world restores from the latest checkpoint.
+    Per-rank env gets the :mod:`~..runtime.bootstrap` rendezvous contract
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID`` — a fresh
+    port every attempt), the heartbeat contract (:data:`ENV_HEARTBEAT_DIR`,
+    per-attempt subdir), and the current chaos spec via ``$DMT_CHAOS``.
+
+    On a detected failure the remaining world is torn down immediately with
+    SIGKILL — with a peer dead, every pending collective would hang, so a
+    graceful drain is impossible by construction; recovery is the previous
+    checkpoint, which is exactly what the elastic restore path replays.
+    """
+
+    def __init__(
+        self,
+        worker_cmd: Sequence[str],
+        num_processes: int,
+        pod_dir: str | Path,
+        *,
+        chaos: str | None = None,
+        heartbeat_deadline_s: float = 60.0,
+        heartbeat_interval_s: float = 1.0,
+        spawn_grace_s: float = 120.0,
+        poll_interval_s: float = 0.5,
+        min_world_size: int = 1,
+        max_pod_restarts: int = 2,
+        straggler_factor: float = 4.0,
+        registry: MetricsRegistry | None = None,
+        env: Mapping[str, str] | None = None,
+    ) -> None:
+        self.worker_cmd = list(worker_cmd)
+        self.num_processes = num_processes
+        self.pod_dir = Path(pod_dir)
+        self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.spawn_grace_s = spawn_grace_s
+        self.poll_interval_s = poll_interval_s
+        self.min_world_size = min_world_size
+        self.max_pod_restarts = max_pod_restarts
+        self.straggler_factor = straggler_factor
+        self.extra_env = dict(env or {})
+        self._own_registry = registry is None
+        self.registry = registry or MetricsRegistry()
+
+    def _log(self, msg: str) -> None:
+        print(f"pod: {msg}", flush=True)
+
+    def _chaos_target(self, spec: str, world: int) -> Optional[int]:
+        """Rank a planned ``rank_kill``/``rank_hang`` detonates on, or None.
+
+        Drills wedge a KNOWN rank (``faults.py``: last rank unless
+        ``$DMT_CHAOS_RANK`` overrides). When culprit analysis ties — every
+        peer froze at the same last step because it blocked inside its very
+        next dispatch instead of running ahead — the plan is the one signal
+        that can still break the tie, and the supervisor owns the plan.
+        Real incidents have no plan and get ``None``.
+        """
+        if not pod_entries(spec):
+            return None
+        raw = self.extra_env.get(ENV_RANK, os.environ.get(ENV_RANK))
+        try:
+            return int(raw) if raw is not None else world - 1
+        except ValueError:
+            return None
+
+    # -- spawning ------------------------------------------------------------
+    def _spawn(
+        self, attempt: int, world: int, spec: str
+    ) -> tuple[dict[int, subprocess.Popen], list[Any], Path]:
+        hb_dir = self.pod_dir / f"attempt{attempt}" / "heartbeats"
+        hb_dir.mkdir(parents=True, exist_ok=True)
+        base = dict(os.environ)
+        base.update(self.extra_env)
+        base[ENV_HEARTBEAT_DIR] = str(hb_dir)
+        base[ENV_HEARTBEAT_INTERVAL] = str(self.heartbeat_interval_s)
+        if spec:
+            base["DMT_CHAOS"] = spec
+        else:
+            base.pop("DMT_CHAOS", None)
+        if world > 1:
+            port = _free_port()
+            base["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            base["NUM_PROCESSES"] = str(world)
+        else:
+            # A world of one needs no rendezvous — and leftover coordinator
+            # vars would make the lone survivor wait for peers forever.
+            for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+                base.pop(k, None)
+        procs: dict[int, subprocess.Popen] = {}
+        handles: list[Any] = []
+        for rank in range(world):
+            env = dict(base)
+            if world > 1:
+                env["PROCESS_ID"] = str(rank)
+            log_path = self.pod_dir / f"attempt{attempt}-rank{rank}.log"
+            f = log_path.open("w")
+            handles.append(f)
+            procs[rank] = subprocess.Popen(
+                self.worker_cmd,
+                env=env,
+                stdout=f,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,  # isolate signals from the supervisor
+            )
+        self._log(
+            f"attempt {attempt}: spawned world of {world} "
+            f"(pids {[p.pid for p in procs.values()]}, chaos={spec or 'none'})"
+        )
+        return procs, handles, hb_dir
+
+    @staticmethod
+    def _kill_all(procs: dict[int, subprocess.Popen]) -> None:
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+    # -- the supervision loop ------------------------------------------------
+    def run(self) -> PodResult:
+        self.pod_dir.mkdir(parents=True, exist_ok=True)
+        self.registry.add_sink(JsonlSink(self.pod_dir / "pod_metrics.jsonl"))
+        injector: ChaosInjector | None = None
+        if self.chaos_spec.strip():
+            injector = ChaosInjector(
+                FaultPlan.parse(self.chaos_spec), registry=self.registry
+            )
+        for name in (POD_RANK_FAILURES, POD_RESTARTS, POD_STRAGGLERS):
+            self.registry.counter(name)
+        world = self.num_processes
+        spec = self.chaos_spec
+        self.registry.gauge(POD_WORLD_SIZE).set(world)
+        world_sizes: list[int] = []
+        restarts = 0
+        rank_failures = 0
+        # (kind, detection time) pairs awaiting the re-formed world's first
+        # progress — that observation closes the chaos recovery.
+        pending_recoveries: list[tuple[str, float]] = []
+        ok = False
+        try:
+            attempt = 0
+            while True:
+                world_sizes.append(world)
+                procs, handles, hb_dir = self._spawn(attempt, world, spec)
+                tracker = LivenessTracker(
+                    procs,
+                    deadline_s=self.heartbeat_deadline_s,
+                    grace_s=self.spawn_grace_s,
+                    straggler_factor=self.straggler_factor,
+                )
+                flagged: set[int] = set()
+                dead: list[int] = []
+                hung: list[int] = []
+                running: list[int] = list(procs)
+                stall_settle_until: float | None = None
+                try:
+                    while True:
+                        time.sleep(self.poll_interval_s)
+                        for rank in procs:
+                            tracker.observe(
+                                rank,
+                                Heartbeat.read(hb_dir / f"heartbeat-{rank}.json"),
+                            )
+                        if pending_recoveries and tracker.any_progress():
+                            now = time.monotonic()
+                            for kind, detected in pending_recoveries:
+                                assert injector is not None
+                                injector.record_recovery(
+                                    kind, latency_s=now - detected
+                                )
+                                self._log(
+                                    f"recovery: {kind} closed — re-formed "
+                                    f"world progressing "
+                                    f"({now - detected:.1f}s after detection)"
+                                )
+                            pending_recoveries.clear()
+                        rcs = {r: p.poll() for r, p in procs.items()}
+                        dead = [r for r, rc in rcs.items() if rc not in (None, 0)]
+                        if not dead and all(rc == 0 for rc in rcs.values()):
+                            ok = True
+                            return self._result(
+                                True, world_sizes, restarts, rank_failures,
+                                injector,
+                            )
+                        running = [r for r, rc in rcs.items() if rc is None]
+                        if not dead:
+                            stalled = [r for r in running if tracker.stalled(r)]
+                            if stalled:
+                                # One wedged rank cascades into a world-wide
+                                # stall within milliseconds, but OBSERVING it
+                                # is beat+poll granular: peers' deadlines
+                                # expire up to one beat interval apart, so
+                                # blaming at first expiry can pin the rank
+                                # whose final file write merely landed
+                                # earliest. Let the stall set settle for the
+                                # observation lag bound, THEN blame the
+                                # culprit(s) — not the peers blocked behind
+                                # them (live hosts that belong in the
+                                # re-formed world).
+                                now = time.monotonic()
+                                settle = 2.0 * (
+                                    self.heartbeat_interval_s
+                                    + self.poll_interval_s
+                                )
+                                if stall_settle_until is None:
+                                    stall_settle_until = now + settle
+                                    self._log(
+                                        f"stall: rank(s) {stalled} past "
+                                        f"deadline — settling {settle:.1f}s "
+                                        f"before blame"
+                                    )
+                                if now >= stall_settle_until:
+                                    hung = tracker.hang_culprits(stalled)
+                                    if len(hung) > 1:
+                                        target = self._chaos_target(
+                                            spec, world
+                                        )
+                                        if target in hung:
+                                            self._log(
+                                                f"stall: ranks {hung} tied "
+                                                f"at the same last step — "
+                                                f"blaming planned chaos "
+                                                f"target rank {target}"
+                                            )
+                                            hung = [target]
+                            else:
+                                stall_settle_until = None
+                        for rank in tracker.stragglers(running):
+                            if rank not in flagged and rank not in hung:
+                                flagged.add(rank)
+                                self.registry.counter(POD_STRAGGLERS).inc()
+                                self._log(
+                                    f"straggler: rank {rank} progress age "
+                                    f"{tracker.progress_age_s(rank):.1f}s "
+                                    f"(flagged, not failed)"
+                                )
+                        if dead or hung:
+                            break
+                finally:
+                    if not ok:
+                        # A dead peer wedges every pending collective; the
+                        # only safe teardown is immediate.
+                        self._kill_all(procs)
+                    for f in handles:
+                        f.close()
+
+                whole_world_hang = (
+                    not dead and len(hung) > 1 and set(hung) == set(running)
+                )
+                if whole_world_hang:
+                    # Every running rank stalled at the same last step and no
+                    # chaos plan could break the tie: the culprit is
+                    # unknowable from the outside. A hang is a wedge, not a
+                    # host loss — every process was alive until the teardown
+                    # SIGKILL — so the safe recovery is the torchrun one:
+                    # restart the WHOLE world at the same size. Account the
+                    # collective hang once.
+                    self._log(
+                        f"stall: ranks {sorted(hung)} tied at the same last "
+                        f"step — culprit unknowable, restarting the whole "
+                        f"world of {world}"
+                    )
+                    hung = [min(hung)]
+                failures = [(r, "rank_kill") for r in dead] + [
+                    (r, "rank_hang") for r in hung
+                ]
+                detected = time.monotonic()
+                for rank, kind in failures:
+                    rank_failures += 1
+                    self.registry.counter(POD_RANK_FAILURES).inc()
+                    self.registry.counter(
+                        labeled(POD_RANK_FAILURES, kind=kind)
+                    ).inc()
+                    rc = procs[rank].poll()
+                    why = f"exit {rc}" if kind == "rank_kill" else (
+                        f"progress stalled {tracker.progress_age_s(rank):.1f}s"
+                    )
+                    hit = injector.fire_observed(kind) if injector else None
+                    if hit is not None:
+                        pending_recoveries.append((kind, detected))
+                        self._log(
+                            f"rank {rank} failed ({why}) — matches planned "
+                            f"{hit.kind}@{hit.unit}:{hit.at}"
+                        )
+                    else:
+                        self._log(f"rank {rank} failed ({why}) — unplanned")
+
+                # Survivors = ranks still alive at DETECTION time, minus the
+                # culprits. The teardown SIGKILL that just ran does not
+                # disqualify them — those are live hosts, killed only because
+                # a world with a dead peer cannot drain its collectives.
+                survivors = [r for r in running if r not in dead and r not in hung]
+                if whole_world_hang:
+                    # Blame was unknowable, so nobody is excluded: the
+                    # blamed rank is a live process like its peers and
+                    # rejoins the same-size world.
+                    survivors = list(running)
+                new_world = len(survivors)
+                if new_world < self.min_world_size:
+                    raise PodFailure(
+                        f"{new_world} survivor(s) after "
+                        f"{[r for r, _ in failures]} failed — below "
+                        f"min_world_size={self.min_world_size}"
+                    )
+                if restarts >= self.max_pod_restarts:
+                    raise PodFailure(
+                        f"restart budget spent ({self.max_pod_restarts}) — "
+                        f"not re-forming"
+                    )
+                if injector is not None:
+                    # Remove faults this attempt consumed: respawned workers
+                    # restart their step count at zero and would re-detonate.
+                    fired = [
+                        f"{s.kind}@{s.unit}:{s.at}"
+                        for s in injector.plan.specs
+                        if s.kind in ("rank_kill", "rank_hang") and s.fired
+                    ]
+                    spec = strip_entries(spec, fired)
+                restarts += 1
+                attempt += 1
+                self.registry.counter(POD_RESTARTS).inc()
+                self.registry.gauge(POD_WORLD_SIZE).set(new_world)
+                self._log(
+                    f"re-forming: world {world} -> {new_world} "
+                    f"(restart {restarts}/{self.max_pod_restarts})"
+                )
+                world = new_world
+        except PodFailure as err:
+            self._log(f"FAILED: {err}")
+            self._result(False, world_sizes, restarts, rank_failures, injector)
+            raise
+        finally:
+            if self._own_registry:
+                self.registry.close()
+
+    def _result(
+        self,
+        ok: bool,
+        world_sizes: list[int],
+        restarts: int,
+        rank_failures: int,
+        injector: ChaosInjector | None,
+    ) -> PodResult:
+        values: dict[str, Any] = {
+            **self.registry.snapshot(),
+            "ok": ok,
+            "world_sizes": "->".join(str(w) for w in world_sizes),
+        }
+        if injector is not None:
+            values["chaos_balanced"] = injector.balanced()
+            self._log(injector.summary())
+        self.registry.emit("pod_summary", values)
+        return PodResult(
+            ok=ok,
+            world_sizes=world_sizes,
+            restarts=restarts,
+            rank_failures=rank_failures,
+            snapshot=self.registry.snapshot(),
+            chaos_balanced=injector.balanced() if injector else None,
+        )

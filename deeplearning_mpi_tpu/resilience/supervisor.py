@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from pathlib import Path
@@ -38,11 +39,45 @@ import jax
 
 from deeplearning_mpi_tpu.resilience.preemption import Preempted
 
-__all__ = ["Heartbeat", "TrainingFailure", "preflight", "run_with_auto_resume"]
+__all__ = [
+    "Heartbeat",
+    "TrainingFailure",
+    "preflight",
+    "restart_delay",
+    "run_with_auto_resume",
+]
+
+#: counter mirrored into a bound registry on every in-process restart — the
+#: single-process sibling of the pod supervisor's ``pod_restarts_total``.
+TRAIN_RESTARTS = "train_restarts_total"
 
 
 class TrainingFailure(RuntimeError):
     """Raised when training exhausted its restart budget."""
+
+
+def restart_delay(
+    attempt: int,
+    base_s: float,
+    *,
+    backoff: float = 2.0,
+    max_delay_s: float = 300.0,
+    jitter: float = 0.25,
+) -> float:
+    """Exponential backoff with DETERMINISTIC jitter for restart ``attempt``
+    (1-based): ``min(base * backoff**(attempt-1), max) * U(1±jitter)``.
+
+    The jitter draw is seeded by ``(attempt, process_index)`` — different
+    ranks decorrelate (no thundering-herd re-rendezvous against a shared
+    coordinator/filesystem), yet the same run replays to the same delays,
+    keeping chaos-drill timings reproducible. ``base_s=0`` means no delay
+    (the tests' fast path).
+    """
+    if base_s <= 0:
+        return 0.0
+    delay = min(base_s * backoff ** (attempt - 1), max_delay_s)
+    rng = random.Random((attempt << 16) ^ jax.process_index())
+    return delay * rng.uniform(1.0 - jitter, 1.0 + jitter)
 
 
 def run_with_auto_resume(
@@ -52,6 +87,9 @@ def run_with_auto_resume(
     max_restarts: int = 2,
     logger: Any = None,
     restart_delay_s: float = 5.0,
+    backoff: float = 2.0,
+    max_delay_s: float = 300.0,
+    registry: Any = None,
 ) -> Any:
     """Run ``fit(start_epoch)``, auto-restarting from checkpoints on failure.
 
@@ -61,6 +99,15 @@ def run_with_auto_resume(
     already took its graceful checkpoint and must not burn a restart; after
     ``max_restarts`` retries the last exception propagates wrapped in
     :class:`TrainingFailure`.
+
+    Restart ``k`` sleeps :func:`restart_delay` — exponential from
+    ``restart_delay_s`` with deterministic jitter — instead of a fixed
+    delay: a crash loop with a persistent cause (filesystem flapping, a
+    peer rank cycling) backs off instead of hammering the restore path,
+    while the jitter decorrelates ranks re-rendezvousing together. Each
+    restart increments ``train_restarts_total`` in ``registry`` when one is
+    bound, so the retry burn rate is visible in the run summary next to the
+    chaos triple.
     """
     log = logger.log if logger is not None else print
     attempt = 0
@@ -84,24 +131,59 @@ def run_with_auto_resume(
                 raise TrainingFailure(
                     f"training failed after {max_restarts} restarts"
                 ) from err
-            time.sleep(restart_delay_s)
+            if registry is not None:
+                registry.counter(TRAIN_RESTARTS).inc()
+            delay = restart_delay(
+                attempt, restart_delay_s, backoff=backoff, max_delay_s=max_delay_s
+            )
+            if delay > 0:
+                log(f"auto-resume: backing off {delay:.1f}s before restart {attempt}")
+                time.sleep(delay)
 
 
 class Heartbeat:
     """Background liveness probe: a JSON file rewritten every ``interval_s``.
 
-    External watchdogs alarm when ``now - mtime`` exceeds a few intervals —
-    catching wedged collectives that neither crash nor progress. Update
-    :attr:`progress` (any JSON-serializable dict) from the training loop;
-    thread-safety is a simple attribute swap.
+    Beats are written atomically (temp file + ``os.replace``), so a reader
+    never sees torn JSON. Update :attr:`progress` (any JSON-serializable
+    dict) from the training loop; thread-safety is a simple attribute swap.
+
+    All stall math rides ``time.monotonic()``, never wall clocks or file
+    mtimes (NTP steps and clock skew make those lie): each beat carries
+
+    - ``progress_seq`` — bumped on every :attr:`progress` assignment; a
+      reader detects a stall by this number NOT advancing between its own
+      monotonic-timestamped reads. This is the load-bearing signal: a hung
+      collective blocks the training thread while THIS daemon thread keeps
+      beating, so file freshness alone proves only that the process exists.
+    - ``progress_age_s`` — seconds (this process's monotonic clock) since
+      the last progress update, for human inspection. Raw ``monotonic``
+      values are also included but are comparable only within one process
+      — cross-process readers (the pod supervisor) must timestamp observed
+      *changes* with their own clock.
     """
 
     def __init__(self, path: str | Path, *, interval_s: float = 10.0) -> None:
         self.path = Path(path)
         self.interval_s = interval_s
-        self.progress: dict[str, Any] = {}
+        self._progress: dict[str, Any] = {}
+        self._progress_seq = 0
+        self._progress_mono = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    @property
+    def progress(self) -> dict[str, Any]:
+        return self._progress
+
+    @progress.setter
+    def progress(self, value: dict[str, Any]) -> None:
+        # Seq first, then the dict swap: a beat racing this setter may pair
+        # the new seq with the old dict for one beat — harmless, the seq
+        # advance is what liveness reads.
+        self._progress_seq += 1
+        self._progress_mono = time.monotonic()
+        self._progress = dict(value)
 
     def start(self) -> "Heartbeat":
         self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -110,15 +192,30 @@ class Heartbeat:
         return self
 
     def _beat(self) -> None:
+        now = time.monotonic()
         payload = {
             "time": time.time(),
+            "monotonic": now,
             "pid": os.getpid(),
             "process_index": jax.process_index(),
-            **self.progress,
+            "interval_s": self.interval_s,
+            "progress_seq": self._progress_seq,
+            "progress_age_s": now - self._progress_mono,
+            **self._progress,
         }
         tmp = self.path.with_suffix(".tmp")
         tmp.write_text(json.dumps(payload))
         os.replace(tmp, self.path)  # atomic: readers never see partial JSON
+
+    @staticmethod
+    def read(path: str | Path) -> dict[str, Any] | None:
+        """Tolerant reader: ``None`` for a missing/unreadable beat file (the
+        writer may not have started yet; never let a racy read kill a
+        watchdog). Torn JSON cannot occur — writes are atomic renames."""
+        try:
+            return json.loads(Path(path).read_text())
+        except (OSError, ValueError):
+            return None
 
     def _run(self) -> None:
         while not self._stop.is_set():

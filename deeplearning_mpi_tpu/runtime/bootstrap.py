@@ -42,6 +42,21 @@ import jax
 _initialized_distributed = False
 
 
+def _distributed_active() -> bool:
+    """Whether a live distributed client exists RIGHT NOW, asked of jax
+    itself rather than our module flag: a caller may tear the runtime down
+    with ``jax.distributed.shutdown()`` directly (elastic re-rendezvous does
+    exactly this), leaving the flag stale — and a stale ``True`` would make
+    the next :func:`init` silently skip the re-initialize, training N
+    independent models. Falls back to the flag if jax's internals move."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # noqa: BLE001 — private API; degrade to our own flag
+        return _initialized_distributed
+
+
 def _looks_like_tpu_pod() -> bool:
     """Detect a multi-host TPU slice from the TPU runtime's own env vars.
 
@@ -128,7 +143,26 @@ def init(
         or (num_processes is not None and num_processes > 1)
         or _looks_like_tpu_pod()
     )
-    if multi_process and not _initialized_distributed:
+    if multi_process and not _distributed_active():
+        # Safely re-enterable: after a shutdown (ours or a direct
+        # jax.distributed.shutdown()), _distributed_active() is False and a
+        # new rendezvous — possibly a different coordinator/world size, the
+        # elastic re-form path — proceeds from scratch.
+        _initialized_distributed = False
+        plats = (
+            platform
+            or os.environ.get("JAX_PLATFORMS")
+            or str(jax.config.read("jax_platforms") or "")
+        )
+        if "cpu" in plats:
+            # Cross-process CPU collectives need the gloo transport (the
+            # default CPU backend has none) — the reference's gloo backend
+            # switch, applied automatically so pod workers launched from a
+            # plain training CLI just work.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 — older jax: flag absent
+                pass
         # With all-None args on a TPU pod, jax auto-discovers topology from
         # TPU metadata — the no-flag path for real slices.
         jax.distributed.initialize(
@@ -154,12 +188,34 @@ def shutdown() -> None:
     Parity with ``dist.destroy_process_group()`` in the reference's
     ``finally`` blocks (``pytorch/hello_world/hello_world.py:37-39``,
     ``pytorch/resnet/main.py:149-153``, ``pytorch/unet/train.py:257-276``).
-    A no-op in single-process mode.
+    A no-op in single-process mode, idempotent always: a double shutdown
+    (or one following a direct ``jax.distributed.shutdown()``) must not
+    raise, and the flag ALWAYS resets so a later :func:`init` can
+    re-rendezvous — the elastic re-form path depends on init→shutdown→init
+    round-tripping cleanly.
     """
     global _initialized_distributed
-    if _initialized_distributed:
-        jax.distributed.shutdown()
+    was_distributed = _initialized_distributed or _distributed_active()
+    try:
+        if was_distributed:
+            jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # already torn down elsewhere — idempotence over ceremony
+    finally:
         _initialized_distributed = False
+    if was_distributed:
+        # ``jax.distributed.initialize`` refuses to run once any backend has
+        # been touched, and merely shutting the client down does not reset
+        # that — so without this, init→shutdown→init (the elastic re-form
+        # round-trip) dies on the second init. Only done when a distributed
+        # client actually existed: clearing backends in a plain
+        # single-process caller would invalidate every live device array.
+        try:
+            from jax.extend import backend as jex_backend
+
+            jex_backend.clear_backends()
+        except Exception:  # noqa: BLE001 — best-effort across jax versions
+            pass
 
 
 def is_coordinator() -> bool:

@@ -183,6 +183,61 @@ class Checkpointer:
             f"(tried epochs {steps})"
         )
 
+    def restore_elastic(
+        self, template: TrainState, *, registry: Any = None
+    ) -> tuple[TrainState, int]:
+        """Digest-verified restore onto a template built for a DIFFERENT
+        dp/ZeRO world size than the one that saved; ``(state, epoch)``.
+
+        The elastic-pod resume path: a checkpoint written by a world of N
+        hosts must restore onto the survivors' smaller mesh. This works
+        because the GLOBAL shapes are world-size invariant — dp/ZeRO only
+        changes how leaves are laid out across devices — and orbax's
+        ``StandardRestore`` takes the template's arrays as the abstract
+        target, re-sharding every leaf to the NEW mesh's placement as it
+        reads (``restore`` docstring: template shardings, not the shardings
+        recorded at save time, win). So the whole digest-verified rollback
+        walk of :meth:`restore_verified` is reused verbatim; what this
+        method adds is the elastic contract made explicit:
+
+        - every restored leaf is ASSERTED to land on the template's
+          sharding — a leaf silently left on the saved-world layout would
+          train correctly until the first collective, then deadlock or
+          reshard per-step;
+        - the resharding is counted (``elastic_restore_total``) so a pod
+          that recovered via a world-size change is visible in telemetry.
+
+        Batch-order determinism rides on the loader, not this method: the
+        global shuffle is a function of (seed, epoch) only
+        (``ShardedLoader._epoch_order``), so the resumed smaller world
+        consumes the SAME global batch sequence a clean run at that world
+        size would — which is what makes elastic resume bit-identical to a
+        clean from-checkpoint run (``tests/test_multiprocess.py``).
+        """
+        state, epoch = self.restore_verified(template)
+        mismatched: list[str] = []
+
+        def check(path, t, r):
+            if (
+                hasattr(t, "sharding")
+                and hasattr(r, "sharding")
+                and not t.sharding.is_equivalent_to(r.sharding, t.ndim)
+            ):
+                mismatched.append(jax.tree_util.keystr(path))
+
+        jax.tree_util.tree_map_with_path(
+            check, _arrays_only(template), _arrays_only(state)
+        )
+        if mismatched:
+            raise RuntimeError(
+                "elastic restore left leaves on the saved world's sharding "
+                f"instead of the template's: {mismatched[:5]}"
+                + ("..." if len(mismatched) > 5 else "")
+            )
+        if registry is not None:
+            registry.counter("elastic_restore_total").inc()
+        return state, epoch
+
     def _note_corrupt(self, epoch: int, why: str) -> None:
         print(f"checkpoint epoch {epoch} CORRUPT — rolling back ({why})")
         if self.chaos is not None:

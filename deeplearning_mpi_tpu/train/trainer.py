@@ -579,6 +579,14 @@ class Trainer:
         elif jax.process_index() == 0:
             print(msg)
 
+    def _mark_progress(self, **fields: Any) -> None:
+        """Bump the heartbeat's progress at phase boundaries (eval start,
+        checkpoint save, per-eval-batch): long non-train phases must not
+        read as a hung rank to pod-level liveness, whose deadline only has
+        to cover one phase transition's compile, not eval+save+epoch."""
+        if self.heartbeat is not None:
+            self.heartbeat.progress = {"step": self._global_step, **fields}
+
     def warmup(self, batch: Batch, *, cache: Any = None) -> Any:
         """AOT-compile the train step for ``batch``'s shapes before the loop.
 
@@ -646,6 +654,10 @@ class Trainer:
                 if self.chaos is not None:
                     # Kill BEFORE the step: kill@step:N means exactly N steps ran.
                     self.chaos.check_kill(step=self._global_step)
+                    # Pod-level faults (rank_kill/rank_hang) detonate on the
+                    # target rank only — a hard exit or a wedged thread the
+                    # pod supervisor, not this process, must survive.
+                    self.chaos.check_rank_fault(step=self._global_step)
                     # NaN poisoning rides the batch; the jitted step's own
                     # finite-guard — not the injector — must skip the update.
                     batch = self.chaos.maybe_poison(batch, self.task, step=self._global_step)
@@ -664,7 +676,15 @@ class Trainer:
                     self.metrics.record_step(self._global_step, metrics)
                 self._global_step += 1
                 if self.heartbeat is not None:
-                    self.heartbeat.progress = {"epoch": epoch, "step_in_epoch": n_batches}
+                    # Per-batch progress is what pod-level liveness watches:
+                    # each assignment bumps the beat's progress_seq, so a
+                    # hung collective (thread wedged, daemon still beating)
+                    # reads as a progress stall, and per-rank step cadence
+                    # feeds straggler flagging.
+                    self.heartbeat.progress = {
+                        "epoch": epoch, "step_in_epoch": n_batches,
+                        "step": self._global_step, "phase": "train",
+                    }
                 # Accumulate on device, excluding non-finite batches from the mean
                 # (the reference `continue`s before accumulating epoch loss,
                 # pytorch/unet/train.py:186-188) — one NaN batch must not poison
@@ -791,8 +811,11 @@ class Trainer:
         sums: dict[str, jax.Array] = {}
         weight: jax.Array | None = None
         batches = prefetch(loader.epoch(0))
+        n_eval = 0
         try:
             for batch in batches:
+                self._mark_progress(phase="eval", eval_batch=n_eval)
+                n_eval += 1
                 metrics = self.eval_step(self.state, batch)
                 w = metrics.pop("weight")  # real (non-padded) examples this batch
                 for k, v in metrics.items():
@@ -849,6 +872,7 @@ class Trainer:
                         + ", ".join(f"{k} {v:.4f}" for k, v in eval_metrics.items())
                     )
                 if self.checkpointer is not None:
+                    self._mark_progress(phase="checkpoint", epoch=epoch)
                     self.checkpointer.save(self.state, epoch=epoch)
                     last_saved = epoch
             self.history.append(stats)

@@ -311,7 +311,12 @@ def restore_for_start(args, checkpointer, state, logger):
             logger.log(f"--resume: no checkpoint under {checkpointer.directory}; starting fresh")
         else:
             try:
-                state, epoch = checkpointer.restore_verified(state)
+                # Elastic path: the template's shardings describe THIS run's
+                # mesh, which need not match the world that saved — a pod
+                # re-formed on survivors restores a dp=4/ZeRO checkpoint
+                # onto a dp=2 (or dp=1) mesh, orbax re-sharding against the
+                # template and the assertion confirming placement landed.
+                state, epoch = checkpointer.restore_elastic(state)
             except CheckpointCorruption as err:
                 # --resume is lenient about a MISSING checkpoint; stay
                 # consistent for an all-corrupt history: warn and start
@@ -383,21 +388,41 @@ def build_observability(
     trainer's own state + mesh — every data-parallel run gets collective
     accounting for free.
     """
+    import os
+    import pathlib
+
+    import jax
+
+    from deeplearning_mpi_tpu.resilience.pod import (
+        ENV_HEARTBEAT_DIR,
+        ENV_HEARTBEAT_INTERVAL,
+    )
     from deeplearning_mpi_tpu.train.resilience import Heartbeat
     from deeplearning_mpi_tpu.utils.profiling import Profiler
 
     if getattr(args, "profile_dir", None):
         trainer.profiler = Profiler(args.profile_dir)
     if getattr(args, "log_dir", None):
-        import pathlib
-
-        trainer.heartbeat = Heartbeat(
-            pathlib.Path(args.log_dir) / "heartbeat.json"
-        ).start()
+        # Under a pod supervisor ($DMT_HEARTBEAT_DIR), each rank beats into
+        # its own file in the shared heartbeat dir — the supervisor's
+        # pod-level liveness view aggregates them. Standalone runs keep the
+        # single heartbeat.json beside the logs.
+        hb_dir = os.environ.get(ENV_HEARTBEAT_DIR)
+        hb_path = (
+            pathlib.Path(hb_dir) / f"heartbeat-{jax.process_index()}.json"
+            if hb_dir
+            else pathlib.Path(args.log_dir) / "heartbeat.json"
+        )
+        interval_s = float(os.environ.get(ENV_HEARTBEAT_INTERVAL, "10.0"))
+        trainer.heartbeat = Heartbeat(hb_path, interval_s=interval_s).start()
     metrics_dir = getattr(args, "metrics_dir", None)
-    if metrics_dir:
-        import pathlib
-
+    if metrics_dir and jax.process_index() == 0:
+        # Process 0 only: every rank computes identical global scalars (the
+        # records are collective results), so N ranks appending to one
+        # metrics.jsonl would duplicate each record N times — and an
+        # elastically resumed world would change the duplication factor
+        # mid-file, breaking the per-step loss series the parity drills
+        # compare.
         from deeplearning_mpi_tpu.telemetry.registry import JsonlSink
 
         trainer.metrics.add_sink(
@@ -523,6 +548,7 @@ def execute_training(
                 fit, checkpointer,
                 max_restarts=args.max_restarts, logger=trainer.logger,
                 restart_delay_s=getattr(args, "restart_delay_s", 5.0),
+                registry=getattr(trainer, "metrics", None),
             )
         return fit(start_epoch)
     except Preempted as p:

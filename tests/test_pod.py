@@ -1,0 +1,496 @@
+"""Pod-supervisor tests: liveness math, culprit analysis, backoff, and the
+elastic re-form loop driven end-to-end with fake (no-JAX) workers.
+
+The real 2-process training drill lives in ``tests/test_multiprocess.py``
+(slow lane) and ``make pod-smoke``; everything here runs in milliseconds-to-
+seconds on stub processes so the supervision logic itself sits in tier 1:
+
+- :class:`LivenessTracker` — grace window, progress-stall deadline,
+  hang-culprit selection by lowest reported step, straggler flagging.
+- :func:`restart_delay` — exponential growth, cap, deterministic jitter.
+- :func:`run_with_auto_resume` — ``train_restarts_total`` accounting.
+- chaos grammar + hooks — ``rank_kill``/``rank_hang`` parsing, target-rank
+  gating, supervisor-side ``fire_observed`` accounting, spec stripping.
+- :class:`Heartbeat` — the ``progress_seq`` contract the tracker reads.
+- :class:`PodSupervisor` — kill drill, hang drill (culprit dies, blocked
+  peer survives into the re-formed world), and the give-up path.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+from deeplearning_mpi_tpu.resilience import (
+    ChaosInjector,
+    FaultPlan,
+    Heartbeat,
+    LivenessTracker,
+    PodFailure,
+    PodSupervisor,
+    restart_delay,
+    run_with_auto_resume,
+)
+from deeplearning_mpi_tpu.resilience import faults as faults_mod
+from deeplearning_mpi_tpu.resilience.faults import (
+    pod_entries,
+    strip_entries,
+)
+from deeplearning_mpi_tpu.resilience.pod import (
+    POD_RANK_FAILURES,
+    POD_RESTARTS,
+    POD_WORLD_SIZE,
+)
+from deeplearning_mpi_tpu.resilience.supervisor import TRAIN_RESTARTS
+from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- LivenessTracker ----------------------------------------------------------
+
+class TestLivenessTracker:
+    def _tracker(self, clk, ranks=(0, 1), deadline=5.0, grace=10.0, factor=4.0):
+        return LivenessTracker(
+            ranks, deadline_s=deadline, grace_s=grace,
+            straggler_factor=factor, clock=clk,
+        )
+
+    def test_startup_grace_window(self, ):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        # No heartbeat file yet: healthy inside the grace window...
+        clk.advance(9.0)
+        assert not t.stalled(0)
+        # ...stalled past it, whether the file is missing or progress-free.
+        clk.advance(2.0)
+        assert t.stalled(0)
+        assert not t.any_progress()
+
+    def test_baseline_read_is_not_progress(self):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 0})
+        assert not t.any_progress()
+        clk.advance(11.0)
+        t.observe(0, {"progress_seq": 0})  # beating, but the loop never moved
+        assert t.stalled(0)
+
+    def test_first_read_with_progress_counts(self):
+        # A fast worker may have beaten the supervisor to its first step —
+        # a nonzero seq on the baseline read is progress, not baseline.
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 7, "step": 3})
+        assert t.any_progress()
+        assert not t.stalled(0)
+
+    def test_progress_resets_the_deadline(self):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 0})
+        clk.advance(1.0)
+        t.observe(0, {"progress_seq": 1})
+        clk.advance(4.0)
+        assert not t.stalled(0)  # age 4 < deadline 5
+        t.observe(0, {"progress_seq": 2})
+        clk.advance(4.0)
+        assert not t.stalled(0)  # the new change reset the clock
+        clk.advance(2.0)
+        t.observe(0, {"progress_seq": 2})  # fresh file, frozen seq
+        assert t.stalled(0)  # age 6 > deadline: the hung-collective signature
+
+    def test_hang_culprit_is_lowest_step(self):
+        # One wedged rank stalls the world; peers block inside collectives
+        # having dispatched further. Blame the lowest reported step only.
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 9, "step": 7})
+        t.observe(1, {"progress_seq": 9, "step": 5})
+        assert t.hang_culprits([0, 1]) == [1]
+        assert t.hang_culprits([]) == []
+
+    def test_hang_culprit_never_reported_step(self):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 3, "step": 2})
+        t.observe(1, {"progress_seq": 1})  # wedged before its first step
+        assert t.hang_culprits([0, 1]) == [1]
+
+    def test_hang_culprit_tie_blames_all(self):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 4, "step": 5})
+        t.observe(1, {"progress_seq": 4, "step": 5})
+        assert t.hang_culprits([0, 1]) == [0, 1]
+
+    def test_straggler_flagged_between_threshold_and_deadline(self):
+        clk = FakeClock()
+        t = self._tracker(clk, deadline=20.0, factor=4.0)
+        # Two changes after baseline feed the interval EMA (the first change
+        # only establishes that the rank progresses at all).
+        for rank in (0, 1):
+            t.observe(rank, {"progress_seq": 0})
+        for seq in (1, 2, 3):
+            clk.advance(1.0)
+            for rank in (0, 1):
+                t.observe(rank, {"progress_seq": seq})
+        # Rank 1 goes quiet; rank 0 keeps moving.
+        for seq in (4, 5, 6, 7, 8):
+            clk.advance(1.0)
+            t.observe(0, {"progress_seq": seq})
+            t.observe(1, {"progress_seq": 3})
+        # Rank 1's age is 5s: past 4 x median interval (1s), under the 20s
+        # deadline — slow, not dead.
+        assert t.stragglers([0, 1]) == [1]
+        assert not t.stalled(1)
+
+    def test_straggler_needs_an_interval_baseline(self):
+        clk = FakeClock()
+        t = self._tracker(clk)
+        t.observe(0, {"progress_seq": 0})
+        clk.advance(1.0)
+        t.observe(0, {"progress_seq": 1})
+        # One change = no EMA yet: nothing to call anyone slow against.
+        clk.advance(100.0)
+        assert t.stragglers([0]) == []
+
+
+# -- restart_delay ------------------------------------------------------------
+
+class TestRestartDelay:
+    def test_exponential_growth_and_cap(self):
+        assert restart_delay(1, 5.0, jitter=0.0) == 5.0
+        assert restart_delay(2, 5.0, jitter=0.0) == 10.0
+        assert restart_delay(3, 5.0, jitter=0.0) == 20.0
+        assert restart_delay(10, 5.0, jitter=0.0, max_delay_s=300.0) == 300.0
+
+    def test_zero_base_means_no_delay(self):
+        assert restart_delay(1, 0.0) == 0.0
+        assert restart_delay(7, -1.0) == 0.0
+
+    def test_jitter_is_deterministic_and_bounded(self):
+        a = restart_delay(3, 5.0, jitter=0.25)
+        b = restart_delay(3, 5.0, jitter=0.25)
+        assert a == b  # same (attempt, process) -> same draw, replayable
+        assert 20.0 * 0.75 <= a <= 20.0 * 1.25
+        # Different attempts draw differently (decorrelated re-rendezvous).
+        assert a != restart_delay(4, 5.0, jitter=0.25) / 2.0
+
+
+class TestAutoResumeAccounting:
+    def test_restarts_are_counted(self):
+        registry = MetricsRegistry()
+        calls = []
+
+        class Ck:
+            def latest_epoch(self):
+                return None
+
+        def fit(start_epoch):
+            calls.append(start_epoch)
+            if len(calls) < 3:
+                raise RuntimeError("boom")
+            return "done"
+
+        out = run_with_auto_resume(
+            fit, Ck(), max_restarts=3, restart_delay_s=0.0, registry=registry,
+        )
+        assert out == "done"
+        assert len(calls) == 3
+        assert registry.snapshot()[TRAIN_RESTARTS] == 2
+        registry.close()
+
+
+# -- chaos grammar + hooks ----------------------------------------------------
+
+class TestRankFaultGrammar:
+    def test_parse_pod_kinds(self):
+        plan = FaultPlan.parse("rank_kill@step:6,rank_hang@step:9")
+        assert [(s.kind, s.unit, s.at) for s in plan.specs] == [
+            ("rank_kill", "step", 6),
+            ("rank_hang", "step", 9),
+        ]
+
+    def test_pod_kinds_trigger_on_steps_only(self):
+        with pytest.raises(ValueError, match="triggers on 'step'"):
+            FaultPlan.parse("rank_kill@epoch:1")
+
+    def test_pod_entries_and_strip(self):
+        spec = "nan_grad@step:2,rank_kill@step:6,rank_hang@step:9"
+        assert pod_entries(spec) == ["rank_kill@step:6", "rank_hang@step:9"]
+        assert (
+            strip_entries(spec, ["rank_kill@step:6"])
+            == "nan_grad@step:2,rank_hang@step:9"
+        )
+        # Stripping a token that is not there must be harmless — the
+        # supervisor strips whatever it accounted, racy or not.
+        assert strip_entries(spec, ["rank_kill@step:99"]) == spec
+
+    def test_rank_kill_fires_on_target_rank(self, monkeypatch):
+        detonated = []
+        monkeypatch.setattr(
+            faults_mod, "_exit_rank", lambda step: detonated.append(step)
+        )
+        monkeypatch.setenv("DMT_CHAOS_RANK", "0")  # this test process
+        inj = ChaosInjector(FaultPlan.parse("rank_kill@step:3"))
+        inj.check_rank_fault(step=1)
+        assert detonated == []
+        inj.check_rank_fault(step=3)
+        assert detonated == [3]
+        assert inj.plan.specs[0].fired
+
+    def test_rank_hang_fires_on_target_rank(self, monkeypatch):
+        wedged = []
+        monkeypatch.setattr(
+            faults_mod, "_hang_rank", lambda step: wedged.append(step)
+        )
+        monkeypatch.setenv("DMT_CHAOS_RANK", "0")
+        inj = ChaosInjector(FaultPlan.parse("rank_hang@step:5"))
+        inj.check_rank_fault(step=5)
+        assert wedged == [5]
+
+    def test_non_target_rank_never_fires_or_counts(self, monkeypatch):
+        monkeypatch.setattr(
+            faults_mod, "_exit_rank",
+            lambda step: pytest.fail("fired on a non-target rank"),
+        )
+        monkeypatch.setenv("DMT_CHAOS_RANK", "5")  # not this process
+        inj = ChaosInjector(FaultPlan.parse("rank_kill@step:3"))
+        inj.check_rank_fault(step=3)
+        assert not inj.plan.specs[0].fired
+        assert inj.counts() == {}
+
+    def test_fire_observed_then_recovery_balances(self):
+        inj = ChaosInjector(FaultPlan.parse("rank_kill@step:6"))
+        hit = inj.fire_observed("rank_kill")
+        assert hit is not None and hit.fired
+        assert inj.fire_observed("rank_kill") is None  # fire-once
+        assert not inj.balanced()
+        assert inj.record_recovery("rank_kill", latency_s=0.5)
+        assert inj.balanced()
+
+
+# -- Heartbeat progress contract ----------------------------------------------
+
+class TestHeartbeatProgress:
+    def test_progress_seq_advances_with_assignments(self, tmp_path):
+        path = tmp_path / "hb.json"
+        hb = Heartbeat(path, interval_s=0.02)
+        with hb:
+            deadline = time.monotonic() + 5.0
+            hb.progress = {"step": 3, "epoch": 1}
+            payload = None
+            while time.monotonic() < deadline:
+                payload = Heartbeat.read(path)
+                if payload and payload.get("progress_seq", 0) >= 1:
+                    break
+                time.sleep(0.01)
+        assert payload is not None
+        assert payload["progress_seq"] >= 1
+        assert payload["step"] == 3
+        # The cross-process caveat, encoded: monotonic/progress_age_s are the
+        # WRITER's clock; a supervisor only compares seq across its own reads.
+        assert "monotonic" in payload and "progress_age_s" in payload
+        assert payload["pid"] == os.getpid()
+
+    def test_read_is_tolerant(self, tmp_path):
+        assert Heartbeat.read(tmp_path / "missing.json") is None
+        garbage = tmp_path / "torn.json"
+        garbage.write_text('{"progress_seq": 1')
+        assert Heartbeat.read(garbage) is None
+
+
+# -- PodSupervisor on fake workers --------------------------------------------
+
+_WORKER = textwrap.dedent(
+    """
+    import json, os, sys, time
+
+    MODE = sys.argv[1]
+    rank = int(os.environ.get("PROCESS_ID", "0"))
+    world = int(os.environ.get("NUM_PROCESSES", "1"))
+    chaos = os.environ.get("DMT_CHAOS", "")
+    hb = os.path.join(
+        os.environ["DMT_HEARTBEAT_DIR"], f"heartbeat-{rank}.json"
+    )
+
+    def beat(seq, step):
+        tmp = hb + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"progress_seq": seq, "step": step, "pid": os.getpid()}, f)
+        os.replace(tmp, hb)
+
+    target = rank == world - 1
+    for step in range(30):
+        beat(step + 1, step)
+        if MODE == "crash" and step == 2:
+            sys.exit(1)
+        if target and "rank_kill" in chaos and step == 5:
+            os._exit(23)
+        if MODE in ("tie", "tie_unplanned") and step == 5:
+            # BOTH ranks freeze at the same step: the peer blocked inside
+            # its very next dispatch instead of running ahead, so culprit
+            # analysis has nothing to discriminate on. The unplanned
+            # variant only wedges on attempt 0 so the same-size restart
+            # can then run clean.
+            wedge = (
+                "rank_hang" in chaos
+                if MODE == "tie"
+                else "attempt0" in os.environ["DMT_HEARTBEAT_DIR"]
+            )
+            if wedge:
+                while True:
+                    beat(6, 5)
+                    time.sleep(0.02)
+        if MODE not in ("tie", "tie_unplanned") and "rank_hang" in chaos:
+            # The culprit wedges at step 5; its peer 'blocks in a
+            # collective' two steps later. Both keep beating (the heartbeat
+            # daemon outlives a hung training thread) with FROZEN progress.
+            freeze = 5 if target else 7
+            if step == freeze:
+                while True:
+                    beat(freeze + 1, freeze)
+                    time.sleep(0.02)
+        time.sleep(0.02)
+    """
+)
+
+
+@pytest.fixture()
+def worker_script(tmp_path):
+    path = tmp_path / "fake_worker.py"
+    path.write_text(_WORKER)
+    return path
+
+
+def _supervisor(worker_script, mode, pod_dir, **kw):
+    kw.setdefault("heartbeat_deadline_s", 0.6)
+    kw.setdefault("heartbeat_interval_s", 0.02)
+    kw.setdefault("spawn_grace_s", 10.0)
+    kw.setdefault("poll_interval_s", 0.05)
+    return PodSupervisor(
+        [sys.executable, str(worker_script), mode],
+        2,
+        pod_dir,
+        **kw,
+    )
+
+
+class TestPodSupervisor:
+    def test_clean_run_single_attempt(self, worker_script, tmp_path):
+        result = _supervisor(worker_script, "ok", tmp_path / "pod").run()
+        assert result.ok
+        assert result.world_sizes == [2]
+        assert result.restarts == 0
+        assert result.rank_failures == 0
+        assert result.chaos_balanced is None  # no chaos spec given
+
+    def test_kill_drill_reforms_smaller_world(self, worker_script, tmp_path):
+        result = _supervisor(
+            worker_script, "ok", tmp_path / "pod", chaos="rank_kill@step:5",
+        ).run()
+        assert result.ok
+        assert result.world_sizes == [2, 1]
+        assert result.restarts == 1
+        assert result.rank_failures == 1
+        # The fired entry was stripped before the respawn (an unstripped one
+        # would re-detonate at step 5 of every attempt and exhaust the
+        # budget) and the recovery closed when the new world progressed.
+        assert result.chaos_balanced is True
+        snap = result.snapshot
+        assert snap[POD_RANK_FAILURES] == 1
+        assert snap[POD_RESTARTS] == 1
+        assert snap[POD_WORLD_SIZE] == 1
+        summaries = [
+            rec
+            for rec in map(
+                json.loads, (tmp_path / "pod" / "pod_metrics.jsonl").open()
+            )
+            if rec.get("kind") == "pod_summary"
+        ]
+        assert summaries and summaries[-1]["ok"] is True
+        assert summaries[-1]["world_sizes"] == "2->1"
+
+    def test_hang_drill_blames_culprit_not_blocked_peer(
+        self, worker_script, tmp_path
+    ):
+        # Rank 1 wedges at step 5; rank 0 'blocks' at step 7 — both look
+        # stalled after the deadline. Culprit analysis must kill only rank 1
+        # and carry rank 0 into the world of one.
+        result = _supervisor(
+            worker_script, "ok", tmp_path / "pod", chaos="rank_hang@step:5",
+        ).run()
+        assert result.ok
+        assert result.world_sizes == [2, 1]
+        assert result.restarts == 1
+        assert result.rank_failures == 1  # the culprit, not the peer
+        assert result.chaos_balanced is True
+
+    def test_hang_tie_broken_toward_planned_chaos_target(
+        self, worker_script, tmp_path
+    ):
+        # BOTH ranks freeze at step 5 (the peer blocked inside its very
+        # next dispatch instead of running ahead) — step content cannot
+        # discriminate. The chaos plan can: the supervisor owns the spec
+        # and knows which rank the drill wedges, so it blames the target
+        # and still re-forms a smaller world deterministically.
+        result = _supervisor(
+            worker_script, "tie", tmp_path / "pod", chaos="rank_hang@step:5",
+        ).run()
+        assert result.ok
+        assert result.world_sizes == [2, 1]
+        assert result.restarts == 1
+        assert result.rank_failures == 1
+        assert result.chaos_balanced is True
+
+    def test_unplanned_whole_world_tie_restarts_same_size(
+        self, worker_script, tmp_path
+    ):
+        # Same tie with NO chaos plan to break it: the culprit is
+        # unknowable, but every process is alive (a hang is a wedge, not
+        # a host loss) — the supervisor must restart the whole world at
+        # the same size instead of declaring zero survivors.
+        result = _supervisor(
+            worker_script, "tie_unplanned", tmp_path / "pod",
+        ).run()
+        assert result.ok
+        assert result.world_sizes == [2, 2]
+        assert result.restarts == 1
+        assert result.rank_failures == 1  # the collective hang, once
+
+    def test_no_survivors_is_pod_failure(self, worker_script, tmp_path):
+        sup = _supervisor(worker_script, "crash", tmp_path / "pod")
+        with pytest.raises(PodFailure, match="below min_world_size"):
+            sup.run()
+        summaries = [
+            rec
+            for rec in map(
+                json.loads, (tmp_path / "pod" / "pod_metrics.jsonl").open()
+            )
+            if rec.get("kind") == "pod_summary"
+        ]
+        assert summaries and summaries[-1]["ok"] is False
+
+    def test_restart_budget_is_enforced(self, worker_script, tmp_path):
+        # Only the target rank crashes (exit 1 at step 2 is rank-agnostic in
+        # 'crash' mode, so use kill chaos twice with budget 0 instead): the
+        # first failure must refuse to re-form when no restarts remain.
+        sup = _supervisor(
+            worker_script, "ok", tmp_path / "pod",
+            chaos="rank_kill@step:5", max_pod_restarts=0,
+        )
+        with pytest.raises(PodFailure, match="restart budget"):
+            sup.run()

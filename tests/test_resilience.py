@@ -677,3 +677,125 @@ class TestServeChaos:
         assert snap[FAULT_INJECTED] == 1
         assert snap[RECOVERY] == 1
         assert snap[labeled(RECOVERY, kind="serve_crash")] == 1
+
+
+# -- elastic restore: re-shard a checkpoint onto a smaller world --------------
+
+class TestElasticRestore:
+    """A dp=4/ZeRO-1 checkpoint must restore onto dp=2 and dp=1 meshes with
+    every leaf re-sharded to the NEW mesh's placement and values bit-equal
+    to a single-device restore — the pod supervisor's re-form path
+    (``Checkpointer.restore_elastic``, docs/RESILIENCE.md "Elastic pods").
+
+    d_model=64 x d_ff=256 makes the MLP kernels exactly 16384 elements —
+    the ZeRO MIN_SIZE floor — so the optimizer moments really shard over
+    "data" at dp=4 (a replicated-everything state would test nothing).
+    """
+
+    @staticmethod
+    def _axes(spec):
+        names = set()
+        for entry in spec or ():
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                names.update(entry)
+            else:
+                names.add(entry)
+        return names
+
+    def _factory(self, mesh, zero):
+        cfg = TransformerConfig(
+            vocab_size=128, num_layers=1, num_heads=4, head_dim=16,
+            d_model=64, d_ff=256,
+        )
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        tx = build_optimizer("adam", 1e-2, clip_norm=1.0)
+        return create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx,
+            mesh=mesh, zero=zero,
+        )
+
+    @pytest.fixture(scope="class")
+    def saved_dp4(self, tmp_path_factory):
+        """Train 2 real ZeRO steps on a dp=4 mesh and checkpoint them."""
+        from deeplearning_mpi_tpu.runtime.mesh import (
+            MeshSpec, batch_sharding, create_mesh,
+        )
+        from deeplearning_mpi_tpu.train import make_train_step
+
+        mesh4 = create_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        state = self._factory(mesh4, zero=True)
+        mu_ff = state.opt_state[1][0].mu["layer_0"]["mlp"]["gate_proj"]["kernel"]
+        assert "data" in self._axes(mu_ff.sharding.spec), (
+            "ZeRO must actually shard the moments at dp=4 for this test "
+            "to mean anything"
+        )
+        step = make_train_step("lm", donate=False)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32)
+        batch = {"tokens": jax.device_put(tokens, batch_sharding(mesh4, ndim=2))}
+        for _ in range(2):
+            state, _ = step(state, batch)
+        ck_dir = tmp_path_factory.mktemp("elastic") / "ck"
+        ck = Checkpointer(ck_dir, max_to_keep=2)
+        ck.save(state, epoch=0)
+        ck.close()
+        yield ck_dir
+
+    @pytest.mark.parametrize("dp", [2, 1])
+    def test_restores_onto_smaller_world_tree_equal_to_oracle(
+        self, saved_dp4, dp
+    ):
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        registry = MetricsRegistry()
+        mesh_small = create_mesh(
+            MeshSpec(data=dp), devices=jax.devices()[:dp]
+        )
+        ck = Checkpointer(saved_dp4, max_to_keep=2)
+        restored, epoch = ck.restore_elastic(
+            self._factory(mesh_small, zero=True), registry=registry
+        )
+        assert epoch == 0
+        assert int(restored.step) == 2
+        assert registry.snapshot()["elastic_restore_total"] == 1
+        if dp > 1:
+            # The re-sharded leaves live on the NEW data axis...
+            mu_ff = restored.opt_state[1][0].mu["layer_0"]["mlp"][
+                "gate_proj"]["kernel"]
+            assert "data" in self._axes(mu_ff.sharding.spec)
+
+        # ...and every value is bit-equal to the single-device oracle.
+        mesh1 = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+        oracle, _ = ck.restore_verified(self._factory(mesh1, zero=False))
+        ck.close()
+        got = jax.tree.leaves(
+            {"p": restored.params, "o": restored.opt_state}
+        )
+        want = jax.tree.leaves({"p": oracle.params, "o": oracle.opt_state})
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert np.array_equal(np.asarray(g), np.asarray(w))
+
+    def test_mismatched_placement_fails_loud(self, saved_dp4, monkeypatch):
+        """A leaf left on the wrong sharding must raise, not limp along."""
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        mesh1 = create_mesh(MeshSpec(data=1), devices=jax.devices()[:1])
+        ck = Checkpointer(saved_dp4, max_to_keep=2)
+        template = self._factory(mesh1, zero=True)
+        real_restore = Checkpointer.restore_verified
+
+        def sabotage(self_, tmpl):
+            # Hand back arrays still on a dp=4 layout: what a broken orbax
+            # target would produce. restore_elastic must refuse it.
+            mesh4 = create_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+            wrong = self._factory(mesh4, zero=True)
+            return wrong, 0
+
+        monkeypatch.setattr(Checkpointer, "restore_verified", sabotage)
+        with pytest.raises(RuntimeError, match="elastic restore"):
+            ck.restore_elastic(template)
+        monkeypatch.setattr(Checkpointer, "restore_verified", real_restore)
+        ck.close()

@@ -1,6 +1,8 @@
 """Tests for the runtime layer: bootstrap, mesh, collectives, hello_world."""
 
 import dataclasses
+import os
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +58,62 @@ class TestBootstrap:
 
     def test_shutdown_noop_single_process(self):
         bootstrap.shutdown()  # must not raise
+
+    def test_init_reenterable_after_shutdown(self):
+        # The elastic re-form path: init -> shutdown -> init must
+        # re-rendezvous cleanly (fresh coordinator port the second time).
+        # ``jax.distributed.initialize`` refuses to run once the backend is
+        # up, and this pytest process initialized its backend long ago — so
+        # the round-trip runs in a pristine subprocess.
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+
+        def port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        script = textwrap.dedent(
+            f"""
+            from jax._src import distributed
+
+            from deeplearning_mpi_tpu.runtime import bootstrap
+
+            topo = bootstrap.init(
+                coordinator_address="127.0.0.1:{port()}",
+                num_processes=1, process_id=0, platform="cpu",
+            )
+            assert topo.num_processes == 1
+            assert distributed.global_state.client is not None
+            bootstrap.shutdown()
+            assert distributed.global_state.client is None
+            bootstrap.shutdown()  # idempotent
+            # Second life: a NEW rendezvous on a NEW port must succeed.
+            bootstrap.init(
+                coordinator_address="127.0.0.1:{port()}",
+                num_processes=1, process_id=0, platform="cpu",
+            )
+            assert distributed.global_state.client is not None
+            bootstrap.shutdown()
+            print("REENTRY_OK")
+            """
+        )
+        env = dict(os.environ)
+        repo = str(Path(__file__).parent.parent)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo, env.get("PYTHONPATH", "")) if p
+        )
+        env.pop("COORDINATOR_ADDRESS", None)
+        env.pop("NUM_PROCESSES", None)
+        env.pop("PROCESS_ID", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "REENTRY_OK" in proc.stdout
 
 
 class TestMesh:

@@ -1,0 +1,223 @@
+"""Rank-failure drill: kill (or hang) a rank mid-run, prove elastic recovery.
+
+The acceptance check for the pod supervisor (``resilience/pod.py``,
+``docs/RESILIENCE.md`` "Elastic pods"), runnable standalone (``make
+pod-smoke``) or from ``tests/test_multiprocess.py``:
+
+1. Launch a 2-process CPU pod (1 virtual device each) training the tiny
+   chaos-smoke LM for 4 epochs, checkpointing every epoch, with
+   ``rank_kill@step:6`` (or ``rank_hang@step:6``) planned — the fault
+   detonates on rank 1 in epoch 1, after the epoch-0 checkpoint landed.
+2. The supervisor must detect the failure (exit code for the kill;
+   progress-stall culprit analysis for the hang), tear down the survivor,
+   and re-form a world of 1 that resumes from the epoch-0 checkpoint and
+   finishes epochs 1-3.
+3. **Parity oracle**: copy the model dir, prune it back to exactly the
+   epoch-0 checkpoint, and run a clean single-process ``--resume`` at the
+   surviving world size. The resumed pod's loss trajectory — every
+   per-step loss and every epoch mean for epochs >= 1 — must be
+   bit-identical to the oracle's. This is the determinism contract end to
+   end: seed-only global batch order + elastic restore = a failure is
+   invisible in the numbers.
+4. **Accounting**: ``pod_metrics.jsonl``'s final ``pod_summary`` must
+   reconcile (``fault_injected_total == recovery_total + rollback_total``)
+   and carry ``pod_rank_failures_total == 1``, ``pod_restarts_total == 1``,
+   ``pod_world_size == 1``.
+
+Why the comparison is strict equality on floats: the JSONL records
+round-trip ``repr`` exactly, so ``==`` on the parsed values is bitwise
+equality for finite floats. A partially-trained epoch never pollutes the
+comparison — per-step scalars buffer on device and only flush at epoch
+end, and the killed attempt dies mid-epoch, before any flush.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: the chaos-smoke model: 40 sequences - 4 eval = 36 train rows -> 4 steps
+#: per epoch at batch 8, so step 6 lands in epoch 1 with epoch 0 saved.
+WORKER_FLAGS = [
+    "--platform", "cpu", "--n_virtual_devices", "1",
+    "--num_epochs", "4", "--batch_size", "8",
+    "--train_sequences", "40", "--seq_len", "32",
+    "--num_layers", "1", "--d_model", "32", "--d_ff", "64",
+    "--num_heads", "2", "--head_dim", "16",
+    "--eval_every", "1", "--keep_checkpoints", "10",
+    "--num_workers", "0", "--resume",
+]
+FAULT_STEP = 6
+
+
+def _base_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    # Same persistent compile cache the test suite uses (tests/conftest.py):
+    # the drill's programs recompile across attempts/world sizes otherwise.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+    # The drill owns the pod contract; inherited vars would leak into the
+    # oracle (and a stale DMT_CHAOS would re-arm the fault there).
+    for k in ("DMT_CHAOS", "DMT_CHAOS_RANK", "DMT_HEARTBEAT_DIR",
+              "DMT_HEARTBEAT_INTERVAL_S", "COORDINATOR_ADDRESS",
+              "NUM_PROCESSES", "PROCESS_ID"):
+        env.pop(k, None)
+    return env
+
+
+def _worker_cmd(model_dir: Path, log_dir: Path, metrics_dir: Path) -> list[str]:
+    return [
+        sys.executable, "-m", "deeplearning_mpi_tpu.cli.train_lm",
+        *WORKER_FLAGS,
+        "--model_dir", str(model_dir),
+        "--log_dir", str(log_dir),
+        "--metrics_dir", str(metrics_dir),
+    ]
+
+
+def _prune_to_epoch0(ckpt_dir: Path) -> None:
+    """Rewind a checkpoint history to exactly the epoch-0 step: the state
+    the re-formed pod resumed from, which is what the oracle must see."""
+    for child in ckpt_dir.iterdir():
+        if child.is_dir() and child.name.isdigit() and int(child.name) > 0:
+            shutil.rmtree(child)
+        elif child.name.startswith("manifest-"):
+            try:
+                epoch = int(child.stem.split("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if epoch > 0:
+                child.unlink()
+
+
+def _losses(metrics_path: Path) -> tuple[dict, dict]:
+    """(epoch, step) -> loss for step records, epoch -> loss for epoch
+    records, epochs >= 1 only (epoch 0 predates the failure)."""
+    step_losses: dict[tuple[int, int], float] = {}
+    epoch_losses: dict[int, float] = {}
+    with metrics_path.open() as f:
+        for line in f:
+            rec = json.loads(line)
+            epoch = rec.get("epoch")
+            if epoch is None or epoch < 1 or "loss" not in rec:
+                continue
+            if rec.get("kind") == "step":
+                step_losses[(int(epoch), int(rec["step"]))] = rec["loss"]
+            elif rec.get("kind") == "epoch":
+                epoch_losses[int(epoch)] = rec["loss"]
+    return step_losses, epoch_losses
+
+
+def run_drill(root: Path, fault: str = "rank_kill") -> dict:
+    from deeplearning_mpi_tpu.resilience.pod import PodSupervisor
+
+    assert fault in ("rank_kill", "rank_hang"), fault
+    root = Path(root)
+    if root.exists():
+        shutil.rmtree(root)
+    root.mkdir(parents=True)
+
+    # -- 1+2: the pod run, fault planned, supervisor in charge -------------
+    sup = PodSupervisor(
+        _worker_cmd(root / "models", root / "logs", root / "metrics"),
+        num_processes=2,
+        pod_dir=root / "pod",
+        chaos=f"{fault}@step:{FAULT_STEP}",
+        heartbeat_interval_s=0.2,
+        heartbeat_deadline_s=60.0,  # must clear one mid-run compile, not eval+save+epoch
+        spawn_grace_s=600.0,  # cold-cache startup compile on one shared core
+        poll_interval_s=0.25,
+        min_world_size=1,
+        max_pod_restarts=2,
+        env=_base_env(),
+    )
+    result = sup.run()
+    assert result.ok, "pod did not finish"
+    assert result.world_sizes == [2, 1], result.world_sizes
+    assert result.restarts == 1, result.restarts
+    assert result.rank_failures == 1, result.rank_failures
+    assert result.chaos_balanced, result.snapshot
+
+    # -- 4: the supervisor's own books must reconcile ----------------------
+    summaries = [
+        rec
+        for rec in map(
+            json.loads, (root / "pod" / "pod_metrics.jsonl").open()
+        )
+        if rec.get("kind") == "pod_summary"
+    ]
+    s = summaries[-1]
+    injected = s.get("fault_injected_total", 0)
+    recovered = s.get("recovery_total", 0)
+    rolled_back = s.get("rollback_total", 0)
+    assert injected == 1 and injected == recovered + rolled_back, s
+    assert s.get("pod_rank_failures_total") == 1, s
+    assert s.get("pod_restarts_total") == 1, s
+    assert s.get("pod_world_size") == 1, s
+    assert s.get("chaos_balanced") is True, s
+
+    # -- 3: clean from-checkpoint oracle at the surviving world size -------
+    shutil.copytree(root / "models", root / "oracle_models")
+    _prune_to_epoch0(root / "oracle_models" / "lm")
+    proc = subprocess.run(
+        _worker_cmd(
+            root / "oracle_models", root / "oracle_logs",
+            root / "oracle_metrics",
+        ),
+        env=_base_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"oracle run failed:\n{proc.stdout[-4000:]}"
+
+    pod_steps, pod_epochs = _losses(root / "metrics" / "metrics.jsonl")
+    ora_steps, ora_epochs = _losses(root / "oracle_metrics" / "metrics.jsonl")
+    assert ora_steps and ora_epochs, "oracle produced no post-resume records"
+    assert pod_steps == ora_steps, (
+        "resumed per-step losses diverge from the clean from-checkpoint "
+        f"run: pod={pod_steps} oracle={ora_steps}"
+    )
+    assert pod_epochs == ora_epochs, (
+        f"resumed epoch losses diverge: pod={pod_epochs} oracle={ora_epochs}"
+    )
+    print(
+        f"pod-drill OK ({fault}): world 2 -> 1, {len(ora_steps)} resumed "
+        f"steps bit-identical to the clean resume, books reconciled "
+        f"(injected={injected:.0f} recovered={recovered:.0f})"
+    )
+    return {
+        "world_sizes": result.world_sizes,
+        "restarts": result.restarts,
+        "rank_failures": result.rank_failures,
+        "steps_compared": len(ora_steps),
+        "chaos_balanced": result.chaos_balanced,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fault", default="rank_kill",
+                        choices=("rank_kill", "rank_hang"))
+    parser.add_argument("--root", default="/tmp/dmt_pod_drill")
+    args = parser.parse_args(argv)
+    sys.path.insert(0, str(REPO))
+    run_drill(Path(args.root), args.fault)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
