@@ -4,14 +4,15 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke pod-smoke
+.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
-# serve-smoke, chaos-smoke, tune-smoke, and pod-smoke prerequisites gate
-# the tier-1 run on the serving engine's end-to-end parity selftest, the
-# fault-injection recovery drill, the autotune loop, and the elastic-pod
-# rank-failure drill without touching the ROADMAP command itself.
-verify: serve-smoke chaos-smoke tune-smoke pod-smoke
+# serve-smoke, chaos-smoke, tune-smoke, pod-smoke, and overlap-smoke
+# prerequisites gate the tier-1 run on the serving engine's end-to-end
+# parity selftest, the fault-injection recovery drill, the autotune loop,
+# the elastic-pod rank-failure drill, and the overlapped-ZeRO-1
+# bit-equality drill without touching the ROADMAP command itself.
+verify: serve-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -30,6 +31,13 @@ serve-smoke:
 		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
 		--max_slots 3 --block_size 8 --num_blocks 32 \
 		--max_blocks_per_seq 6 --prefill_chunk 8
+
+# Overlapped-ZeRO-1 bit-equality drill (docs/PERF_ANALYSIS.md): 5 training
+# steps at dp=2 (two virtual CPU devices) through the explicit bucketed
+# reduce-scatter/all-gather schedule vs the GSPMD ZeRO-1 path — losses,
+# optimizer state, and params must be BIT-identical (no tolerance).
+overlap-smoke:
+	env JAX_PLATFORMS=cpu python tools/overlap_drill.py
 
 # Compilation-service acceptance loop (docs/COMPILATION.md): autotune tiny
 # kernels into a tuning DB, round-trip it, verify tuned == default

@@ -34,8 +34,16 @@ def build_parser() -> argparse.ArgumentParser:
         model_filename="lm",
     )
     group = config.add_lm_model_flags(parser)
-    group.add_argument("--remat", action="store_true",
-                       help="checkpoint each block (recompute in backward) — trades FLOPs for HBM")
+    group.add_argument("--remat", nargs="?", const="full", default="none",
+                       choices=("none", "dots", "full"),
+                       help="rematerialization policy per block: bare "
+                       "--remat (= full) recomputes each block's forward "
+                       "(max HBM savings, one extra forward of FLOPs); "
+                       "'dots' saves matmul outputs and recomputes only "
+                       "elementwise glue (near-free FLOPs). MFU accounting "
+                       "stays honest either way: recompute lands in "
+                       "mfu_issued/mfu_gap, never in mfu "
+                       "(telemetry/flops.py)")
     group.add_argument("--microbatches", type=int, default=4,
                        help="GPipe microbatches when --pp > 1 (bubble fraction = (pp-1)/(M+pp-1))")
     group.add_argument("--attention", default="dense",
@@ -93,6 +101,34 @@ def main(argv: list[str] | None = None) -> int:
     from deeplearning_mpi_tpu.utils import config
 
     topo, mesh = config.setup_runtime(args)
+
+    if args.tuned_step:
+        # Consult BEFORE anything is built: remat is a model property and
+        # grad_accum feeds preflight's divisibility checks. Never-raise —
+        # a missing/corrupt DB or an untuned shape keeps the flag defaults.
+        import jax.numpy as _jnp
+
+        from deeplearning_mpi_tpu.compiler.autotune import (
+            TuningDB,
+            tuned_step_schedule,
+        )
+
+        tuned = tuned_step_schedule(
+            "lm", (args.batch_size, args.seq_len), mesh,
+            _jnp.bfloat16 if args.dtype == "bfloat16" else _jnp.float32,
+            db=TuningDB.load(args.tuned_step),
+        )
+        if tuned:
+            args.remat = tuned.get("remat", args.remat)
+            if tuned.get("grad_accum"):
+                args.grad_accum = int(tuned["grad_accum"])
+            if "overlap" in tuned:
+                args.zero_overlap = bool(tuned["overlap"])
+            print(f"tuned step schedule ({args.tuned_step}): {tuned}",
+                  file=sys.stderr)
+        else:
+            print(f"no step tuning for this shape in {args.tuned_step}; "
+                  "using flag defaults", file=sys.stderr)
 
     from deeplearning_mpi_tpu.train.resilience import preflight
 
@@ -235,7 +271,9 @@ def main(argv: list[str] | None = None) -> int:
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
             grad_accum=args.grad_accum, loss_chunk=args.loss_chunk,
-            zero=args.zero, ema_decay=args.ema, chaos=chaos,
+            zero=args.zero, overlap=args.zero_overlap,
+            clip_norm=1.0,  # the optimizer chain's clip, mirrored by overlap
+            ema_decay=args.ema, chaos=chaos,
         )
         trainer.place_state()
         if chaos is not None:
@@ -253,7 +291,10 @@ def main(argv: list[str] | None = None) -> int:
         # telemetry/comms.py): gradient sync over data, plus whichever
         # sequence/pipeline/expert collectives this run's flags engaged.
         from deeplearning_mpi_tpu.telemetry import comms
-        from deeplearning_mpi_tpu.telemetry.flops import transformer_train_flops
+        from deeplearning_mpi_tpu.telemetry.flops import (
+            transformer_issued_flops,
+            transformer_train_flops,
+        )
 
         dp = mesh.shape.get("data", 1)
         sp = mesh.shape.get("seq", 1)
@@ -292,6 +333,11 @@ def main(argv: list[str] | None = None) -> int:
             args, trainer,
             flops_per_step=transformer_train_flops(
                 cfg, args.batch_size, args.seq_len
+            ),
+            # Remat recompute counts in ISSUED flops only — mfu stays the
+            # paper-comparable model-FLOPs number, mfu_gap shows the tax.
+            issued_flops_per_step=transformer_issued_flops(
+                cfg, args.batch_size, args.seq_len, remat=args.remat
             ),
             comm_bytes_per_step=comm_bytes,
         )

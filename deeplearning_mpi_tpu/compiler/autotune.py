@@ -40,13 +40,18 @@ from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
 __all__ = [
     "ATTENTION_BLOCK_CANDIDATES",
     "DECODE_BLOCK_CANDIDATES",
+    "STEP_REMAT_CANDIDATES",
     "TuningDB",
     "default_db",
     "set_default_db",
+    "step_candidates",
+    "step_tuning_key",
     "tune_flash_attention",
     "tune_flash_decode",
+    "tune_step_schedule",
     "tuned_attention_blocks",
     "tuned_decode_schedule",
+    "tuned_step_schedule",
     "tuning_key",
 ]
 
@@ -66,6 +71,47 @@ def tuning_key(
 ) -> str:
     dims = "x".join(str(int(s)) for s in shape)
     return f"{kernel}|{dims}|{jnp.dtype(dtype).name}|{backend}"
+
+
+def _mesh_desc(mesh: Any) -> str:
+    """Terse mesh descriptor for tuning keys: ``data2`` / ``data2,model2``.
+    Accepts a ``jax.sharding.Mesh``, an ``{axis: size}`` dict, or a
+    pre-formatted string."""
+    if isinstance(mesh, str):
+        return mesh
+    if isinstance(mesh, dict):
+        items = list(mesh.items())
+    else:
+        items = list(zip(mesh.axis_names, mesh.devices.shape))
+    # Canonical: size-1 axes carry no sharding, so they must not fork keys
+    # between otherwise-identical meshes (MeshSpec always materializes
+    # every axis; a hand-built Mesh may not).
+    active = [(a, int(n)) for a, n in items if int(n) > 1]
+    if not active:
+        return "1"
+    return ",".join(f"{a}{n}" for a, n in active)
+
+
+def step_tuning_key(
+    model: str,
+    shape: tuple[int, ...],
+    mesh: Any,
+    dtype: Any,
+    backend: str | None = None,
+) -> str:
+    """Key for a whole-step schedule entry:
+    ``step|<model>|<batch>x<seq>|<mesh>|<dtype>|<backend>``.
+
+    A step schedule (remat policy, grad-accum chunking, donation, overlap)
+    tuned for one model/shape/mesh/dtype says nothing about another — same
+    exact-key-only contract as the kernel entries.
+    """
+    backend = backend or jax.default_backend()
+    dims = "x".join(str(int(s)) for s in shape)
+    return (
+        f"step|{model}|{dims}|{_mesh_desc(mesh)}|"
+        f"{jnp.dtype(dtype).name}|{backend}"
+    )
 
 
 class TuningDB:
@@ -88,6 +134,12 @@ class TuningDB:
     def __init__(self, path: str | Path | None = None):
         self.path = Path(path) if path else None
         self.entries: dict[str, dict[str, Any]] = {}
+        #: provenance of every successful lookup this process made through
+        #: this DB (one record per distinct key), so benchmarks can report
+        #: exactly which tunings influenced a run (``bench.py`` surfaces it
+        #: as ``details.tuning_provenance``).
+        self.consulted: list[dict[str, Any]] = []
+        self._consulted_keys: set[str] = set()
 
     @classmethod
     def load(cls, path: str | Path) -> "TuningDB":
@@ -134,6 +186,42 @@ class TuningDB:
         }
         return key
 
+    def record_key(
+        self,
+        key: str,
+        params: dict[str, Any],
+        *,
+        best_seconds: float | None = None,
+        candidates: list[dict[str, Any]] | None = None,
+        **meta: Any,
+    ) -> str:
+        """Store a winning entry under an arbitrary pre-built key (the
+        ``step|...`` whole-step entries use this; kernel entries keep the
+        typed :meth:`record`). Extra ``meta`` keyword fields land in the
+        entry verbatim."""
+        self.entries[key] = {
+            "params": dict(params),
+            "best_seconds": best_seconds,
+            "candidates": candidates or [],
+            **meta,
+        }
+        return key
+
+    def lookup_key(self, key: str) -> dict[str, Any] | None:
+        """Params for an exact key, or None; a hit is noted in
+        :attr:`consulted` (once per distinct key)."""
+        entry = self.entries.get(key)
+        if not entry:
+            return None
+        if key not in self._consulted_keys:
+            self._consulted_keys.add(key)
+            self.consulted.append({
+                "key": key,
+                "params": dict(entry["params"]),
+                "best_seconds": entry.get("best_seconds"),
+            })
+        return dict(entry["params"])
+
     def lookup(
         self,
         kernel: str,
@@ -146,8 +234,7 @@ class TuningDB:
         backend), or None — no nearest-shape guessing; a wrong block size
         can be slower than the default it replaced."""
         backend = backend or jax.default_backend()
-        entry = self.entries.get(tuning_key(kernel, shape, dtype, backend))
-        return dict(entry["params"]) if entry else None
+        return self.lookup_key(tuning_key(kernel, shape, dtype, backend))
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -417,3 +504,223 @@ def tune_flash_decode(
             best_seconds=best["seconds"], candidates=results,
         )
     return params
+
+
+# -- whole-step schedule ------------------------------------------------------
+
+#: Remat policies the step tuner tries, cheapest-memory last
+#: (``models.transformer.TransformerLM.remat``).
+STEP_REMAT_CANDIDATES = ("none", "dots", "full")
+
+
+def step_candidates(
+    dp: int, *, grad_accums: tuple[int, ...] = (1, 2)
+) -> list[dict[str, Any]]:
+    """Default whole-step search space: remat policy × grad-accum chunking
+    × {GSPMD, overlapped} schedule. Donation stays on (the runtime vetoes
+    it where unsafe); overlap candidates only exist with real data
+    parallelism."""
+    overlaps = (False, True) if dp > 1 else (False,)
+    return [
+        {"remat": remat, "grad_accum": ga, "donate": True, "overlap": ov}
+        for remat in STEP_REMAT_CANDIDATES
+        for ga in grad_accums
+        for ov in overlaps
+    ]
+
+
+def tune_step_schedule(
+    model: str = "lm",
+    *,
+    batch_size: int = 8,
+    seq_len: int = 16,
+    config: Any = None,
+    mesh: Any = None,
+    dtype: Any = jnp.float32,
+    db: TuningDB | None = None,
+    candidates: list[dict[str, Any]] | None = None,
+    steps: int = 5,
+    repeats: int = 2,
+    rtol: float = 1e-5,
+) -> dict[str, Any]:
+    """Search the whole-train-step schedule space for one (model, shape,
+    mesh, dtype) and persist the winner under its ``step|...`` key.
+
+    Oracle-first, like the kernel tuners: the UNTUNED step (no remat,
+    ``grad_accum=1``, GSPMD schedule, no donation) is run first and its
+    per-step loss trajectory recorded; every candidate must reproduce that
+    trajectory (within ``rtol`` — grad-accum chunking only reassociates
+    float sums) over the same ``steps`` batches *before* it may be timed.
+    A schedule that changes the training math is rejected
+    (``rejected: "numerics"``), not preferred — the DB makes steps faster,
+    never different.
+
+    Candidates the configuration cannot run (overlap on dp=1, a batch the
+    grad-accum factor doesn't divide, ``OverlapUnsupported``) are recorded
+    as ``rejected: "unsupported"`` and skipped. Currently LM-only — the
+    ``step`` key space is per-model-family, so extending to the vision
+    tasks is a new candidate builder, not a schema change.
+    """
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.parallel import shard_state
+    from deeplearning_mpi_tpu.parallel.tensor_parallel import (
+        infer_state_sharding,
+    )
+    from deeplearning_mpi_tpu.parallel.zero import (
+        OverlapUnsupported,
+        make_overlapped_train_step,
+    )
+    from deeplearning_mpi_tpu.runtime.mesh import (
+        MeshSpec,
+        batch_sharding,
+        create_mesh,
+    )
+    from deeplearning_mpi_tpu.train import create_train_state, make_train_step
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    if model != "lm":
+        raise ValueError(
+            f"step tuning currently covers the 'lm' task only, got {model!r}"
+        )
+    if mesh is None:
+        mesh = create_mesh(MeshSpec(data=len(jax.devices())))
+    dp = int(mesh.shape.get("data", 1))
+    zero = dp > 1
+    cfg = config or TransformerConfig(
+        vocab_size=256, num_layers=1, num_heads=2, head_dim=32,
+        d_model=64, d_ff=256, onehot_embed=True,
+    )
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(steps):
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch_size, seq_len)), jnp.int32
+        )
+        mask = jnp.asarray(
+            rng.integers(0, 2, (batch_size, seq_len)), jnp.float32
+        )
+        batches.append({
+            "tokens": jax.device_put(tokens, batch_sharding(mesh, ndim=2)),
+            "mask": jax.device_put(mask, batch_sharding(mesh, ndim=2)),
+        })
+
+    def build_state(remat: Any):
+        mdl = TransformerLM(config=cfg, dtype=dtype, remat=remat)
+        st = create_train_state(
+            mdl, jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+            build_optimizer("adam", 1e-2),
+        )
+        return shard_state(st, mesh, zero=zero)
+
+    def build_step(cand: dict[str, Any], state: Any):
+        if cand.get("overlap"):
+            return make_overlapped_train_step(
+                model, state, mesh,
+                donate=cand.get("donate", True),
+                grad_accum=cand.get("grad_accum", 1),
+            )
+        shardings = (
+            infer_state_sharding(state, mesh, zero=zero) if zero else None
+        )
+        return make_train_step(
+            model, donate=cand.get("donate", True),
+            grad_accum=cand.get("grad_accum", 1),
+            state_shardings=shardings,
+        )
+
+    def run(cand: dict[str, Any]) -> list[float]:
+        state = build_state(cand.get("remat", "none"))
+        step = build_step(cand, state)
+        losses = []
+        for b in batches:
+            state, metrics = step(state, b)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    oracle_cand = {
+        "remat": "none", "grad_accum": 1, "donate": False, "overlap": False,
+    }
+    oracle = run(oracle_cand)
+
+    results: list[dict[str, Any]] = []
+    best: dict[str, Any] | None = None
+    for cand in candidates if candidates is not None else step_candidates(dp):
+        entry = dict(cand)
+        ga = cand.get("grad_accum", 1)
+        local_batch = batch_size // dp if cand.get("overlap") else batch_size
+        if local_batch % ga:
+            entry["rejected"] = "unsupported"
+            results.append(entry)
+            continue
+        try:
+            losses = run(cand)
+        except OverlapUnsupported:
+            entry["rejected"] = "unsupported"
+            results.append(entry)
+            continue
+        if not np.allclose(losses, oracle, rtol=rtol, atol=1e-7):
+            entry["rejected"] = "numerics"
+            results.append(entry)
+            continue
+        # Timing: whole verified N-step loop, fresh state per repeat so
+        # donation candidates never re-consume a donated buffer.
+        times = []
+        for _ in range(repeats):
+            state = build_state(cand.get("remat", "none"))
+            step = build_step(cand, state)
+            state, _ = step(state, batches[0])  # absorb compile
+            jax.block_until_ready(state.params)
+            t0 = time.perf_counter()
+            for b in batches:
+                state, _ = step(state, b)
+            jax.block_until_ready(state.params)
+            times.append((time.perf_counter() - t0) / steps)
+        entry["seconds"] = statistics.median(times)
+        results.append(entry)
+        if best is None or entry["seconds"] < best["seconds"]:
+            best = entry
+    if best is None:
+        return {}
+    params = {
+        k: best[k] for k in ("remat", "grad_accum", "donate", "overlap")
+    }
+    if db is not None:
+        db.record_key(
+            step_tuning_key(model, (batch_size, seq_len), mesh, dtype),
+            params,
+            best_seconds=best["seconds"],
+            candidates=results,
+            kernel="step",
+            model=model,
+            shape=[int(batch_size), int(seq_len)],
+            mesh=_mesh_desc(mesh),
+            dtype=jnp.dtype(dtype).name,
+            backend=jax.default_backend(),
+        )
+    return params
+
+
+def tuned_step_schedule(
+    model: str,
+    shape: tuple[int, ...],
+    mesh: Any,
+    dtype: Any = jnp.float32,
+    *,
+    db: TuningDB | None = None,
+) -> dict[str, Any] | None:
+    """The tuned whole-step schedule for this exact (model, shape, mesh,
+    dtype), or None when untuned — never raises, like every call-site
+    consult: a missing/corrupt/poisoned DB means 'use the defaults', not a
+    failed training run."""
+    try:
+        db = db if db is not None else default_db()
+        if db is None:
+            return None
+        return db.lookup_key(
+            step_tuning_key(model, tuple(shape), mesh, dtype)
+        )
+    except Exception:
+        return None

@@ -46,6 +46,7 @@ from deeplearning_mpi_tpu.models.transformer import (
     Block,
     RMSNorm,
     TransformerConfig,
+    _remat_block,
 )
 from deeplearning_mpi_tpu.parallel.pipeline import (
     merge_microbatches,
@@ -69,13 +70,13 @@ class StageBlocks(nn.Module):
     num_blocks: int
     dtype: Any = jnp.bfloat16
     attention_fn: Any = None
-    remat: bool = False
+    remat: bool | str = False
     mlp_cls: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.config
-        block_cls = nn.remat(Block) if self.remat else Block
+        block_cls = _remat_block(self.remat)
         for i in range(self.num_blocks):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,
@@ -138,7 +139,7 @@ class PipelinedLM:
         num_microbatches: int = 4,
         dtype: Any = jnp.bfloat16,
         attention_fn: Any = None,
-        remat: bool = False,
+        remat: bool | str = False,
         return_prehead: bool = False,
     ) -> None:
         if return_prehead and not config.tied_embeddings:

@@ -449,6 +449,16 @@ class TransformerConfig:
     #: prefill, and KV-cached decode all mask with it, so a window-trained
     #: checkpoint decodes with the same receptive field it learned.
     attention_window: int = 0
+    #: embedding lookup as a one-hot matmul instead of a gather. Forward
+    #: values are identical (rows of exact 0/1 select the same f32 bits),
+    #: but the *gradient* becomes a dot-general instead of a scatter-add —
+    #: the classic TPU embedding trick (scatter serializes on TPU; the MXU
+    #: eats the one-hot dot), and the property the explicit ZeRO-1 schedule
+    #: needs for bit-equality: GSPMD reshards a scatter-add gradient by
+    #: all-gathering tokens and accumulating in *global* token order, while
+    #: a dot-general keeps per-rank partial sums + all-reduce — the same
+    #: association the shard_map path computes (parallel/zero.py).
+    onehot_embed: bool = False
 
     @staticmethod
     def tiny() -> "TransformerConfig":
@@ -462,18 +472,41 @@ class TransformerConfig:
         return dataclasses.replace(TransformerConfig.tiny(), moe_experts=num_experts)
 
 
+def _remat_block(policy: bool | str) -> type[nn.Module]:
+    """Resolve a remat policy name to the (possibly wrapped) Block class."""
+    if isinstance(policy, str):
+        policy = policy.lower()
+    if policy in (False, None, "", "none"):
+        return Block
+    if policy in (True, "full"):
+        return nn.remat(Block)
+    if policy == "dots":
+        return nn.remat(
+            Block, policy=jax.checkpoint_policies.checkpoint_dots
+        )
+    raise ValueError(
+        f"unknown remat policy {policy!r} (expected False/'none', "
+        "True/'full', or 'dots')"
+    )
+
+
 class TransformerLM(nn.Module):
     """Causal LM: token embed → N blocks → final norm → logits.
 
     ``remat`` wraps each block in ``jax.checkpoint`` — rematerialisation
     trades recompute FLOPs for HBM, the standard TPU memory lever for long
-    sequences.
+    sequences. ``True``/``"full"`` saves only block boundaries (backward
+    re-runs each block's forward — one extra forward of block FLOPs,
+    ``telemetry.flops.transformer_remat_flops``); ``"dots"`` saves matmul
+    outputs and recomputes only the elementwise glue
+    (``jax.checkpoint_policies.checkpoint_dots`` — near-zero extra FLOPs,
+    intermediate memory); ``False``/``"none"`` saves everything.
     """
 
     config: TransformerConfig
     dtype: Any = jnp.bfloat16
     attention_fn: AttentionFn | None = None
-    remat: bool = False
+    remat: bool | str = False
     mlp_cls: type[nn.Module] | None = None
     #: False | True | "prefill": KV-cached decode modes (see Attention.decode)
     decode: bool | str = False
@@ -507,13 +540,21 @@ class TransformerLM(nn.Module):
             cfg.vocab_size, cfg.d_model, dtype=self.dtype,
             embedding_init=nn.initializers.normal(0.02), name="embed",
         )
-        x = embed(tokens)
+        if cfg.onehot_embed:
+            # Same param tree, same forward bits, scatter-free backward —
+            # see the config field's comment.
+            onehot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=self.dtype)
+            x = jnp.einsum(
+                "bsv,vd->bsd", onehot, embed.embedding.astype(self.dtype)
+            )
+        else:
+            x = embed(tokens)
         mlp_cls = self.mlp_cls
         if mlp_cls is None and cfg.moe_experts > 0:
             from deeplearning_mpi_tpu.models.moe import mlp_cls_from_config
 
             mlp_cls = mlp_cls_from_config(cfg)
-        block_cls = nn.remat(Block) if self.remat else Block
+        block_cls = _remat_block(self.remat)
         for i in range(cfg.num_layers):
             x = block_cls(
                 cfg.num_heads, cfg.head_dim, cfg.d_ff, self.dtype,

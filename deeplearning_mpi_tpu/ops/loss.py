@@ -45,6 +45,24 @@ def softmax_cross_entropy(
     return masked_mean(_token_nll(logits, labels), where)
 
 
+def bce_per_image(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-image mean binary cross-entropy on logits, shape [B].
+
+    The pre-reduction form of :func:`sigmoid_binary_cross_entropy`; exposed so
+    data-parallel schedules that need the batch mean in explicit
+    sum-over-shards form (``parallel.zero``'s overlapped step) share these
+    exact per-image values with the GSPMD loss path.
+    """
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    per_elem = (
+        jnp.maximum(logits, 0.0)
+        - logits * targets
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+    return jnp.mean(per_elem, axis=tuple(range(1, per_elem.ndim)))
+
+
 def sigmoid_binary_cross_entropy(
     logits: jax.Array, targets: jax.Array, where: jax.Array | None = None
 ) -> jax.Array:
@@ -57,15 +75,25 @@ def sigmoid_binary_cross_entropy(
     excludes wrap-padded eval rows (equal-sized images ⇒ the all-elements
     mean equals the mean of per-image means).
     """
-    logits = logits.astype(jnp.float32)
+    return masked_mean(bce_per_image(logits, targets), where)
+
+
+def dice_per_image(
+    logits: jax.Array, targets: jax.Array, *, eps: float = 1e-8
+) -> jax.Array:
+    """Per-image soft Dice loss (1 - soft Dice coefficient), shape [B].
+
+    The pre-reduction form of :func:`dice_loss`, exposed for the same reason
+    as :func:`bce_per_image` — Dice is per-image before the batch mean, so
+    the data-parallel sum-over-shards form needs exactly these values.
+    """
+    probs = jax.nn.sigmoid(logits.astype(jnp.float32))
     targets = targets.astype(jnp.float32)
-    per_elem = (
-        jnp.maximum(logits, 0.0)
-        - logits * targets
-        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-    )
-    per_image = jnp.mean(per_elem, axis=tuple(range(1, per_elem.ndim)))
-    return masked_mean(per_image, where)
+    reduce_axes = tuple(range(1, logits.ndim))
+    intersection = jnp.sum(probs * targets, axis=reduce_axes)
+    union = jnp.sum(probs, axis=reduce_axes) + jnp.sum(targets, axis=reduce_axes)
+    dice = (2.0 * intersection + eps) / (union + eps)
+    return 1.0 - dice
 
 
 def dice_loss(
@@ -83,13 +111,7 @@ def dice_loss(
     the same ``eps`` smoothing as the reference's metric. ``where`` ([B],
     1 = real example) excludes wrap-padded eval rows, like the other losses.
     """
-    probs = jax.nn.sigmoid(logits.astype(jnp.float32))
-    targets = targets.astype(jnp.float32)
-    reduce_axes = tuple(range(1, logits.ndim))
-    intersection = jnp.sum(probs * targets, axis=reduce_axes)
-    union = jnp.sum(probs, axis=reduce_axes) + jnp.sum(targets, axis=reduce_axes)
-    dice = (2.0 * intersection + eps) / (union + eps)
-    return masked_mean(1.0 - dice, where)
+    return masked_mean(dice_per_image(logits, targets, eps=eps), where)
 
 
 def lm_cross_entropy(

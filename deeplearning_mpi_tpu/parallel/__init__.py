@@ -30,3 +30,9 @@ from deeplearning_mpi_tpu.parallel.ulysses import (  # noqa: F401
     make_ulysses_attention_fn,
     ulysses_attention,
 )
+from deeplearning_mpi_tpu.parallel.zero import (  # noqa: F401
+    OverlapUnsupported,
+    make_overlapped_train_step,
+    plan_buckets,
+    zero1_spec,
+)

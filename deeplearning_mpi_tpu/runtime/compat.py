@@ -28,10 +28,46 @@ from jax import lax
 __all__ = [
     "axis_size",
     "buffer_donation_supported",
+    "enable_latency_hiding",
+    "LATENCY_HIDING_FLAGS",
     "pcast",
     "shard_map",
     "tpu_compiler_params",
 ]
+
+#: XLA flags that let the scheduler slide the explicit ZeRO-1 collectives
+#: (parallel.zero.make_overlapped_train_step's per-bucket reduce-scatters
+#: and tail all-gathers) under independent compute. No-ops on CPU.
+LATENCY_HIDING_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+)
+
+
+def enable_latency_hiding(flags: tuple[str, ...] = LATENCY_HIDING_FLAGS) -> bool:
+    """Merge latency-hiding-scheduler flags into ``XLA_FLAGS``.
+
+    Same merge idiom as ``runtime.bootstrap.set_virtual_cpu_devices``: any
+    existing setting of the same flag key is replaced, everything else in
+    ``XLA_FLAGS`` is preserved. Must run before the first backend use to
+    affect this process (XLA reads the env at backend init); it is still
+    worth calling late for the benefit of spawned workers, so the return
+    value reports whether the backend had already initialized (False =
+    too late for this process). Best-effort by design — callers never gate
+    correctness on it.
+    """
+    import os
+
+    existing = os.environ.get("XLA_FLAGS", "").split()
+    keys = {f.split("=", 1)[0] for f in flags}
+    kept = [f for f in existing if f.split("=", 1)[0] not in keys]
+    os.environ["XLA_FLAGS"] = " ".join(kept + list(flags))
+    try:
+        from jax._src import xla_bridge
+
+        return not xla_bridge._backends  # noqa: SLF001 — introspection only
+    except Exception:  # noqa: BLE001 — unknown JAX internals: assume in time
+        return True
 
 
 def buffer_donation_supported() -> bool:

@@ -48,6 +48,23 @@ PEAK_FLOPS: dict[str, float] = {
 #: CPU-mesh MFU is non-null and comparable run-to-run on one host.
 CPU_NOMINAL_PEAK_FLOPS = 200e9
 
+#: Aggregate ICI bandwidth per chip in bytes/s (public per-chip interconnect
+#: specs, bits/8). Nominal: real achievable bandwidth depends on topology and
+#: collective — these set the scale for the overlap estimate, and
+#: ``DMT_LINK_BANDWIDTH`` overrides with a calibrated number.
+LINK_BANDWIDTH: dict[str, float] = {
+    "v2": 62e9,
+    "v3": 82e9,
+    "v4": 300e9,
+    "v5e": 200e9,
+    "v5p": 600e9,
+    "v6e": 448e9,
+}
+
+#: Nominal CPU "interconnect" (shared-memory transfers between virtual
+#: devices) — same convention as CPU_NOMINAL_PEAK_FLOPS: stable, not real.
+CPU_NOMINAL_LINK_BANDWIDTH = 10e9
+
 
 def device_peak_flops(device: Any | None = None) -> float:
     """Peak FLOPs/s for ``device`` (default: first local device).
@@ -67,6 +84,63 @@ def device_peak_flops(device: Any | None = None) -> float:
     if getattr(device, "platform", "") == "tpu":
         return PEAK_FLOPS["v4"]  # unknown TPU: assume mid-generation
     return CPU_NOMINAL_PEAK_FLOPS
+
+
+def device_link_bandwidth(device: Any | None = None) -> float:
+    """Nominal interconnect bytes/s for ``device`` (default: first local).
+
+    Resolution order mirrors :func:`device_peak_flops`:
+    ``DMT_LINK_BANDWIDTH`` env var → TPU generation table → CPU nominal.
+    """
+    env = os.environ.get("DMT_LINK_BANDWIDTH")
+    if env:
+        return float(env)
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for gen, bw in LINK_BANDWIDTH.items():
+        if gen in kind.replace(" ", ""):
+            return bw
+    if getattr(device, "platform", "") == "tpu":
+        return LINK_BANDWIDTH["v4"]
+    return CPU_NOMINAL_LINK_BANDWIDTH
+
+
+def overlap_fraction(
+    comm_bytes_per_step: float,
+    issued_flops_per_step: float,
+    *,
+    n_devices: int | None = None,
+    peak_flops_per_device: float | None = None,
+    link_bandwidth_per_device: float | None = None,
+) -> float | None:
+    """Estimated fraction of per-step collective time hideable under compute.
+
+    Roofline-style: compute time ≈ issued FLOPs / (n · peak), collective
+    time ≈ per-device wire bytes / link bandwidth. When compute covers the
+    comms entirely the scheduler *can* hide them (fraction 1.0 — whether it
+    *does* is what ``mfu_gap`` and the profiler answer); when comms exceed
+    compute, at most compute/comm of them can hide and the step is
+    communication-bound. None on degenerate inputs; 1.0 when there are no
+    collective bytes to hide.
+    """
+    if not issued_flops_per_step or issued_flops_per_step <= 0:
+        return None
+    if comm_bytes_per_step is None or comm_bytes_per_step < 0:
+        return None
+    if not comm_bytes_per_step:
+        return 1.0
+    if n_devices is None:
+        n_devices = jax.device_count()
+    if peak_flops_per_device is None:
+        peak_flops_per_device = device_peak_flops()
+    if link_bandwidth_per_device is None:
+        link_bandwidth_per_device = device_link_bandwidth()
+    compute_s = issued_flops_per_step / (n_devices * peak_flops_per_device)
+    comm_s = (comm_bytes_per_step / n_devices) / link_bandwidth_per_device
+    if comm_s <= 0:
+        return 1.0
+    return min(1.0, compute_s / comm_s)
 
 
 def xla_cost_analysis(compiled: Any) -> dict[str, float]:
@@ -171,6 +245,52 @@ def transformer_fwd_flops(config: Any, batch: int, seq_len: int) -> float:
 
 def transformer_train_flops(config: Any, batch: int, seq_len: int) -> float:
     return 3.0 * transformer_fwd_flops(config, batch, seq_len)
+
+
+def transformer_remat_flops(
+    config: Any, batch: int, seq_len: int, *, remat: Any = "none"
+) -> float:
+    """Extra matmul FLOPs one train step RECOMPUTES under rematerialization.
+
+    These are issued by the hardware but are not model FLOPs — MFU's
+    definition excludes them, so they belong on the issued side of the
+    ledger (:func:`transformer_issued_flops`), where ``mfu_gap`` makes the
+    overhead visible instead of silently inflating utilization.
+
+    Policies (``TransformerLM.remat``):
+
+    - ``"none"``/``False``: nothing recomputed — 0.
+    - ``"dots"`` (``jax.checkpoint_policies.checkpoint_dots``): matmul
+      *outputs* are saved; only the elementwise glue between them is
+      recomputed, which this module counts as O(d) noise everywhere — 0
+      extra matmul FLOPs, at ~the activation memory of the dots.
+    - ``"full"``/``True``: every block's forward is re-executed inside the
+      backward pass — one extra forward's worth of block FLOPs. The LM head
+      is outside the remat boundary (``nn.remat`` wraps ``Block``) and is
+      not recomputed.
+    """
+    if isinstance(remat, str):
+        remat = remat.lower()
+    if remat in ("none", "", None, False):
+        return 0.0
+    if remat == "dots":
+        return 0.0
+    if remat in ("full", True):
+        head = 2.0 * config.d_model * config.vocab_size * batch * seq_len
+        return transformer_fwd_flops(config, batch, seq_len) - head
+    raise ValueError(f"unknown remat policy {remat!r}")
+
+
+def transformer_issued_flops(
+    config: Any, batch: int, seq_len: int, *, remat: Any = "none"
+) -> float:
+    """FLOPs the hardware issues per train step: model train FLOPs plus
+    remat recompute. Feed this to ``Trainer(issued_flops_per_step=...)`` /
+    ``mfu`` to get ``mfu_issued``; the difference from plain ``mfu`` is the
+    remat tax."""
+    return transformer_train_flops(config, batch, seq_len) + (
+        transformer_remat_flops(config, batch, seq_len, remat=remat)
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,7 @@ from typing import Any, Callable, Iterable
 
 import jax
 import jax.numpy as jnp
+import jax.tree_util as jtu
 import optax
 from jax.sharding import Mesh
 
@@ -244,15 +245,19 @@ def make_train_step(
                 state.batch_stats, batch
             )
         else:
-            def split(x):
+            def split(path, x):
                 if x.shape[0] % grad_accum:
+                    # Name the offending leaf and its full shape — with mixed
+                    # pytrees (tokens + mask + labels) "batch size N" alone
+                    # doesn't say which input the loader mis-sized.
                     raise ValueError(
-                        f"batch size {x.shape[0]} not divisible by "
-                        f"grad_accum {grad_accum}"
+                        f"per-device batch dim of batch[{jtu.keystr(path)!r}] "
+                        f"(shape {tuple(x.shape)}) not divisible by "
+                        f"grad_accum={grad_accum}"
                     )
                 return x.reshape(grad_accum, x.shape[0] // grad_accum, *x.shape[1:])
 
-            chunks = jax.tree.map(split, batch)
+            chunks = jtu.tree_map_with_path(split, batch)
 
             # Total valid-element weight over the FULL batch, known before
             # the scan (chunks partition axis 0), so each chunk's scale is
@@ -525,9 +530,12 @@ class Trainer:
         heartbeat: Any = None,  # train.resilience.Heartbeat; liveness progress
         time_steps: bool = True,  # per-step latency percentiles (BASELINE.md metric)
         zero: bool = False,  # ZeRO-1: shard optimizer state over the data axis
+        overlap: bool = False,  # ZeRO-1 via the explicit bucketed schedule
+        clip_norm: float | None = None,  # grad-clip the overlapped schedule mirrors
         metrics: Any = None,  # telemetry.MetricsRegistry (one is built if None)
         metrics_every: int = 1,  # record every Nth step's scalars (0 = off)
         flops_per_step: float | None = None,  # analytic train FLOPs -> MFU
+        issued_flops_per_step: float | None = None,  # model + remat recompute FLOPs
         comm_bytes_per_step: float | None = None,  # static collective bytes
         chaos: Any = None,  # resilience.ChaosInjector; injects planned faults
         shutdown: Any = None,  # resilience.GracefulShutdown; batch-boundary stop
@@ -547,6 +555,8 @@ class Trainer:
         self.heartbeat = heartbeat
         self.time_steps = time_steps
         self.zero = zero
+        self.overlap = overlap
+        self.clip_norm = clip_norm
         # One registry per trainer, always: every metrics record — step,
         # epoch, eval — flows through MetricsRegistry.emit, so there is one
         # canonical record shape. A logger with log_metrics becomes a sink
@@ -559,6 +569,7 @@ class Trainer:
             self.metrics.add_sink(LoggerSink(logger))
         self.metrics_every = metrics_every
         self.flops_per_step = flops_per_step
+        self.issued_flops_per_step = issued_flops_per_step
         self.comm_bytes_per_step = comm_bytes_per_step
         self.chaos = chaos
         self.shutdown = shutdown
@@ -619,6 +630,11 @@ class Trainer:
             self.metrics.gauge("xla_flops_per_step").set(prog.flops)
             if not self.flops_per_step:
                 self.flops_per_step = prog.flops
+            if not self.issued_flops_per_step:
+                # XLA's count is what the hardware will EXECUTE — remat
+                # recompute and padding included — so it backfills the
+                # issued side of the MFU gap, never the model side.
+                self.issued_flops_per_step = prog.flops
         if prog.bytes_accessed:
             self.metrics.gauge("xla_bytes_per_step").set(prog.bytes_accessed)
         self.train_step = aot.WarmProgram(prog, self.train_step)
@@ -747,15 +763,40 @@ class Trainer:
         # collective bytes, live HBM high-water marks (None on CPU — the
         # keys are then simply absent, never faked).
         step_seconds = duration / n_batches
+        n_devices = int(self.mesh.devices.size)
         if self.flops_per_step:
             from deeplearning_mpi_tpu.telemetry.flops import mfu
 
             stats["mfu"] = mfu(
-                self.flops_per_step, step_seconds,
-                n_devices=int(self.mesh.devices.size),
+                self.flops_per_step, step_seconds, n_devices=n_devices,
             )
+        if self.issued_flops_per_step:
+            from deeplearning_mpi_tpu.telemetry.flops import mfu
+
+            # Issued = model FLOPs + remat recompute (+ padding when the
+            # number came from XLA's cost analysis). The gap between the
+            # two utilizations is the overhead MFU deliberately excludes —
+            # mfu_hlo_counted minus mfu in bench.py's terms.
+            issued = mfu(
+                self.issued_flops_per_step, step_seconds, n_devices=n_devices,
+            )
+            if issued is not None:
+                stats["mfu_issued"] = issued
+                if "mfu" in stats and stats["mfu"] is not None:
+                    stats["mfu_gap"] = issued - stats["mfu"]
         if self.comm_bytes_per_step is not None:
             stats["comm_bytes_per_step"] = float(self.comm_bytes_per_step)
+            if self.issued_flops_per_step:
+                from deeplearning_mpi_tpu.telemetry.flops import (
+                    overlap_fraction,
+                )
+
+                frac = overlap_fraction(
+                    self.comm_bytes_per_step, self.issued_flops_per_step,
+                    n_devices=n_devices,
+                )
+                if frac is not None:
+                    stats["overlap_fraction"] = frac
         from deeplearning_mpi_tpu.telemetry.memory import hbm_usage
 
         hbm = hbm_usage()
@@ -911,6 +952,15 @@ class Trainer:
         step is rebuilt with its output pinned to this placement — see
         ``make_train_step(state_shardings=...)`` for why letting GSPMD
         propagation choose drifts the state and double-compiles.
+
+        ``overlap=True`` (with ``zero``) swaps in the explicit bucketed
+        ZeRO-1 schedule (``parallel.zero.make_overlapped_train_step`` —
+        reduce-scattered gradient buckets, 1/dp optimizer update, all-gather
+        overlapped by the latency-hiding scheduler). The overlapped schedule
+        is bit-identical to the GSPMD step where it applies; configurations
+        it does not cover (``OverlapUnsupported``: dp=1, non-data axes,
+        aux/chunked losses, batch_stats, non-mirroring optimizers) fall back
+        to the GSPMD step with a logged reason — never an error.
         """
         from deeplearning_mpi_tpu.parallel import shard_state
         from deeplearning_mpi_tpu.parallel.tensor_parallel import (
@@ -918,6 +968,23 @@ class Trainer:
         )
 
         self.state = shard_state(self.state, self.mesh, zero=self.zero)
+        if self.zero and self.overlap:
+            from deeplearning_mpi_tpu.parallel.zero import (
+                OverlapUnsupported,
+                make_overlapped_train_step,
+            )
+
+            try:
+                self.train_step = make_overlapped_train_step(
+                    self.task, self.state, self.mesh,
+                    clip_norm=self.clip_norm, **self._step_kwargs,
+                )
+                self._log("overlap: explicit bucketed ZeRO-1 schedule active")
+                return
+            except OverlapUnsupported as err:
+                self._log(
+                    f"overlap unsupported ({err}); falling back to GSPMD ZeRO-1"
+                )
         if self.zero or any(
             self.mesh.shape[a] > 1 for a in self.mesh.axis_names if a != "data"
         ):
@@ -928,6 +995,58 @@ class Trainer:
                 ),
                 **self._step_kwargs,
             )
+
+    def apply_tuned_step(
+        self,
+        db: Any = None,
+        *,
+        model: str,
+        batch_size: int,
+        seq_len: int,
+        dtype: Any = jnp.float32,
+    ) -> dict[str, Any] | None:
+        """Adopt a tuned whole-step schedule (``tools/autotune.py --step``)
+        for this trainer's mesh, if the tuning DB has one.
+
+        Consults the ``step|<model>|<batch>x<seq>|<mesh>|<dtype>|<backend>``
+        entry (``db`` may be a TuningDB, a path, or None for the process
+        default) and applies what the trainer controls: ``grad_accum`` and
+        the overlapped-vs-GSPMD ZeRO-1 schedule choice. The remat policy is
+        a MODEL property — it is returned in the params for the caller
+        (the CLIs apply it when building the model) but cannot be changed
+        on a live ``apply_fn``.
+
+        Never raises and never degrades: a missing, corrupt, or
+        entry-less DB leaves every current setting untouched and returns
+        None — tuning is an overlay, not a requirement. On a hit the step
+        is rebuilt; call BEFORE :meth:`place_state` (placement re-derives
+        the step from the updated settings).
+        """
+        from deeplearning_mpi_tpu.compiler.autotune import (
+            TuningDB,
+            tuned_step_schedule,
+        )
+
+        try:
+            if db is not None and not isinstance(db, TuningDB):
+                db = TuningDB.load(db)
+            params = tuned_step_schedule(
+                model, (batch_size, seq_len), self.mesh, dtype, db=db
+            )
+        except Exception:
+            return None
+        if not params:
+            return None
+        if params.get("grad_accum"):
+            self._step_kwargs["grad_accum"] = int(params["grad_accum"])
+        if "overlap" in params:
+            self.overlap = bool(params["overlap"])
+        self.train_step = make_train_step(self.task, **self._step_kwargs)
+        self._log(
+            "tuned step schedule applied: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(params.items()))
+        )
+        return params
 
     # Back-compat alias for the DP-only name.
     replicate_state = place_state

@@ -28,6 +28,8 @@ def add_topology_flags(parser: argparse.ArgumentParser) -> None:
     group.add_argument("--sp", type=int, default=1, help="sequence-parallel degree (seq axis; ring/ulysses attention)")
     group.add_argument("--ep", type=int, default=1, help="expert-parallel degree (expert axis; MoE)")
     group.add_argument("--zero", action="store_true", help="ZeRO-1: shard optimizer state over the data axis (moments drop to 1/dp per device)")
+    group.add_argument("--zero_overlap", action="store_true", help="with --zero: use the explicit bucketed ZeRO-1 schedule (reduce-scattered grad buckets, 1/dp optimizer update, overlapped param all-gather); bit-identical to the GSPMD step where supported, logged fallback otherwise")
+    group.add_argument("--tuned_step", default=None, metavar="DB", help="tuning DB (tools/autotune.py --step) whose step|... entry, if present for this model/shape/mesh/dtype, sets remat/grad_accum/overlap; missing or corrupt DB silently keeps the flag defaults")
 
 
 def ema_decay(value: str) -> float:
@@ -374,6 +376,7 @@ def build_observability(
     trainer,
     *,
     flops_per_step: float | None = None,
+    issued_flops_per_step: float | None = None,
     comm_bytes_per_step: float | None = None,
 ) -> None:
     """Attach profiler + heartbeat + telemetry from the shared flags.
@@ -431,6 +434,11 @@ def build_observability(
     trainer.metrics_every = getattr(args, "metrics_every", trainer.metrics_every)
     if flops_per_step is not None:
         trainer.flops_per_step = flops_per_step
+    if issued_flops_per_step is not None:
+        # Model FLOPs + remat recompute: feeds mfu_issued/mfu_gap (and the
+        # overlap-fraction estimate) in the epoch stats. MFU itself stays
+        # defined over model FLOPs only (telemetry/flops.py docstring).
+        trainer.issued_flops_per_step = issued_flops_per_step
     if comm_bytes_per_step is None and trainer.comm_bytes_per_step is None:
         from deeplearning_mpi_tpu.telemetry import comms
 

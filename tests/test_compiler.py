@@ -176,6 +176,106 @@ class TestAutotune:
             autotune.set_default_db(None)
 
 
+# -- whole-step schedule tuner (`step|...` key space) -------------------------
+
+class TestStepTuning:
+    def test_key_canonical_across_mesh_forms(self):
+        key = autotune.step_tuning_key(
+            "lm", (8, 16), {"data": 2}, F32, backend="cpu"
+        )
+        assert key == "step|lm|8x16|data2|float32|cpu"
+        # Size-1 axes carry no sharding: a MeshSpec that materializes every
+        # axis and a hand-built data-only Mesh must agree on the key.
+        assert autotune.step_tuning_key(
+            "lm", (8, 16), {"data": 2, "pipe": 1, "model": 1}, F32,
+            backend="cpu",
+        ) == key
+        assert autotune.step_tuning_key(
+            "lm", (8, 16), "data2", F32, backend="cpu"
+        ) == key
+        # All-size-1 mesh canonicalizes to "1", not an empty field.
+        assert autotune.step_tuning_key(
+            "lm", (8, 16), {"data": 1}, F32, backend="cpu"
+        ) == "step|lm|8x16|1|float32|cpu"
+
+    def test_step_candidates_space(self):
+        flat = autotune.step_candidates(1)
+        assert flat and all(not c["overlap"] for c in flat)
+        dp = autotune.step_candidates(2)
+        assert any(c["overlap"] for c in dp)
+        assert {c["remat"] for c in dp} == set(autotune.STEP_REMAT_CANDIDATES)
+        # Overlap doubles the space; nothing else changes.
+        assert len(dp) == 2 * len(flat)
+
+    def test_tune_persists_verified_winner_and_round_trips(self, tmp_path):
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        db = autotune.TuningDB(tmp_path / "t.json")
+        params = autotune.tune_step_schedule(
+            "lm", batch_size=8, seq_len=16, db=db,
+            candidates=[
+                {"remat": "none", "grad_accum": 1, "donate": False,
+                 "overlap": False},
+                {"remat": "dots", "grad_accum": 2, "donate": False,
+                 "overlap": False},
+                # 8 % 3 != 0 — must be recorded rejected, not attempted.
+                {"remat": "none", "grad_accum": 3, "donate": False,
+                 "overlap": False},
+            ],
+            steps=3, repeats=1,
+        )
+        assert set(params) == {"remat", "grad_accum", "donate", "overlap"}
+        db.save()
+        text = (tmp_path / "t.json").read_text()
+        assert '"rejected": "unsupported"' in text  # the ga=3 candidate
+        # Round-trip through a freshly loaded DB, consulting with the same
+        # (default) mesh the tuner keyed on.
+        back = autotune.TuningDB.load(tmp_path / "t.json")
+        mesh = create_mesh(MeshSpec(data=len(jax.devices())))
+        got = autotune.tuned_step_schedule("lm", (8, 16), mesh, F32, db=back)
+        assert got == params
+        # The consult is logged for bench provenance (key + recorded median).
+        assert back.consulted and back.consulted[0]["params"] == params
+        assert back.consulted[0]["key"].startswith("step|lm|8x16|")
+        assert back.consulted[0]["best_seconds"] > 0
+
+    def test_tuned_step_schedule_never_raises(self, tmp_path):
+        mesh = {"data": 2}
+        # Empty DB and corrupt-file DB: miss, not exception.
+        assert autotune.tuned_step_schedule(
+            "lm", (8, 16), mesh, F32, db=autotune.TuningDB()
+        ) is None
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        assert autotune.tuned_step_schedule(
+            "lm", (8, 16), mesh, F32, db=autotune.TuningDB.load(p)
+        ) is None
+
+        class Broken:
+            def lookup_key(self, *a, **k):
+                raise RuntimeError("boom")
+
+        # A poisoned DB object — passed explicitly or installed as the
+        # process default — degrades to None, never into the training run.
+        assert autotune.tuned_step_schedule(
+            "lm", (8, 16), mesh, F32, db=Broken()
+        ) is None
+        autotune._default_db = Broken()
+        try:
+            assert autotune.tuned_step_schedule("lm", (8, 16), mesh, F32) is None
+        finally:
+            autotune.set_default_db(None)
+
+    def test_non_lm_model_rejected(self, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError, match="lm"):
+            autotune.tune_step_schedule(
+                "classification", batch_size=8, seq_len=16,
+                db=autotune.TuningDB(tmp_path / "t.json"), steps=1, repeats=1,
+            )
+
+
 # -- donation veto policy (regression: XLA:CPU heap corruption) ---------------
 
 class TestDonationPolicy:

@@ -408,6 +408,71 @@ class TestFlopsAndMfu:
         monkeypatch.setenv("DMT_PEAK_FLOPS", "123e9")
         assert flops.device_peak_flops() == 123e9
 
+    def test_remat_flops_pinned(self):
+        """Pin the remat-aware per-step FLOP accounting to exact literals
+        (same tiny config as test_transformer_flops_match_hand_computation,
+        batch 2 x seq 16). 'full' re-runs every block forward in the
+        backward pass — one extra forward MINUS the head (the loss head is
+        outside the remat'd blocks); 'dots' only saves matmul outputs, so
+        its recompute is ~free and counted as 0; issued = train + recompute.
+        A change to any of these numbers is a change to what mfu_issued and
+        mfu_gap report and must be deliberate."""
+        from deeplearning_mpi_tpu.models import TransformerConfig
+        from deeplearning_mpi_tpu.telemetry.flops import (
+            transformer_issued_flops,
+            transformer_remat_flops,
+            transformer_train_flops,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=256, num_layers=2, num_heads=4, head_dim=8,
+            d_model=32, d_ff=64,
+        )
+        batch, seq = 2, 16
+        assert transformer_train_flops(cfg, batch, seq) == 5701632.0
+        assert transformer_remat_flops(cfg, batch, seq, remat="none") == 0.0
+        assert transformer_remat_flops(cfg, batch, seq, remat="dots") == 0.0
+        assert transformer_remat_flops(cfg, batch, seq, remat="full") == 1376256.0
+        # bool spellings map to the same policies as the model flag.
+        assert transformer_remat_flops(cfg, batch, seq, remat=True) == 1376256.0
+        assert transformer_remat_flops(cfg, batch, seq, remat=False) == 0.0
+        assert transformer_issued_flops(cfg, batch, seq, remat="none") == 5701632.0
+        assert transformer_issued_flops(cfg, batch, seq, remat="full") == 7077888.0
+        with pytest.raises(ValueError, match="remat"):
+            transformer_remat_flops(cfg, batch, seq, remat="sometimes")
+
+    def test_overlap_fraction_roofline(self):
+        from deeplearning_mpi_tpu.telemetry.flops import overlap_fraction
+
+        # Compute-bound: compute_s = 2e9/(2*1e12) = 1 ms dwarfs comm_s =
+        # (1e6/2)/1e10 = 50 us -> everything hideable, capped at 1.0.
+        assert overlap_fraction(
+            1e6, 2e9, n_devices=2, peak_flops_per_device=1e12,
+            link_bandwidth_per_device=1e10,
+        ) == 1.0
+        # Comm-bound: comm_s = 50 ms vs compute_s = 1 ms -> 2% hideable.
+        assert overlap_fraction(
+            1e9, 2e9, n_devices=2, peak_flops_per_device=1e12,
+            link_bandwidth_per_device=1e10,
+        ) == pytest.approx(0.02)
+        # No collective bytes: nothing to hide, trivially 1.0.
+        assert overlap_fraction(0.0, 2e9, n_devices=2) == 1.0
+        # Degenerate inputs: None, not a fake number.
+        assert overlap_fraction(1e6, 0.0) is None
+        assert overlap_fraction(None, 2e9) is None
+        assert overlap_fraction(-1.0, 2e9) is None
+
+    def test_link_bandwidth_env_override(self, monkeypatch):
+        from deeplearning_mpi_tpu.telemetry import flops
+
+        monkeypatch.setenv("DMT_LINK_BANDWIDTH", "42e9")
+        assert flops.device_link_bandwidth() == 42e9
+        monkeypatch.delenv("DMT_LINK_BANDWIDTH")
+        # CPU test devices fall through the TPU table to the nominal figure.
+        assert flops.device_link_bandwidth() == (
+            flops.CPU_NOMINAL_LINK_BANDWIDTH
+        )
+
 
 class TestCommsAccounting:
     def test_collective_byte_formulas(self):
@@ -552,6 +617,48 @@ class TestTrainerTelemetry:
         assert epoch_rec["mfu"] is not None and epoch_rec["mfu"] > 0
         assert epoch_rec["comm_bytes_per_step"] == 2048.0
         assert "ts" in epoch_rec
+
+    def test_trainer_emits_mfu_gap_and_overlap_fraction(self, mesh):
+        """With issued FLOPs configured, the epoch stats must carry the
+        remat-aware companions: mfu_issued (recompute priced in), their
+        difference mfu_gap, and the roofline overlap_fraction estimate —
+        the columns tools/metrics_report.py renders."""
+        from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+        from deeplearning_mpi_tpu.train import Trainer, create_train_state
+        from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+        model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+        tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+        state = create_train_state(
+            model, jax.random.key(0), jnp.zeros((1, 16), jnp.int32), tx
+        )
+
+        class FakeLoader:
+            def epoch(self, epoch):
+                rng = np.random.default_rng(epoch)
+                for _ in range(2):
+                    yield {
+                        "tokens": jnp.asarray(
+                            rng.integers(0, 256, (8, 16)), jnp.int32
+                        )
+                    }
+
+        trainer = Trainer(
+            state, "lm", mesh, flops_per_step=1e6,
+            issued_flops_per_step=1.3e6, comm_bytes_per_step=2048.0,
+        )
+        stats = trainer.run_epoch(FakeLoader(), epoch=0)
+        assert stats["mfu"] > 0
+        assert stats["mfu_issued"] == pytest.approx(1.3 * stats["mfu"])
+        assert stats["mfu_gap"] == pytest.approx(
+            stats["mfu_issued"] - stats["mfu"]
+        )
+        assert 0.0 < stats["overlap_fraction"] <= 1.0
+        # Without issued FLOPs, none of the companions appear — no fake 0s.
+        plain = Trainer(state, "lm", mesh, flops_per_step=1e6)
+        stats2 = plain.run_epoch(FakeLoader(), epoch=0)
+        assert "mfu_issued" not in stats2 and "mfu_gap" not in stats2
+        assert "overlap_fraction" not in stats2
 
     def test_metrics_every_thins_step_records(self, mesh):
         from deeplearning_mpi_tpu.telemetry import InMemorySink

@@ -9,7 +9,7 @@ from jax.sharding import PartitionSpec as P
 
 from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
 from deeplearning_mpi_tpu.parallel import shard_state
-from deeplearning_mpi_tpu.parallel.zero import zero1_spec
+from deeplearning_mpi_tpu.parallel.zero import MIN_SIZE, zero1_dim, zero1_spec
 from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, batch_sharding, create_mesh
 from deeplearning_mpi_tpu.train import create_train_state, make_train_step
 from deeplearning_mpi_tpu.train.trainer import build_optimizer
@@ -42,6 +42,36 @@ class TestZero1Spec:
     def test_indivisible_stays(self):
         leaf = jnp.zeros((63, 129, 3))
         assert zero1_spec(leaf, P(), 8, min_size=1) == P()
+
+    def test_min_size_boundary(self):
+        # size < MIN_SIZE stays replicated; size == MIN_SIZE shards.
+        assert zero1_spec(jnp.zeros((MIN_SIZE // 2, 1)), P(), 2) == P()
+        assert zero1_spec(jnp.zeros((MIN_SIZE, 1)), P(), 2) == P("data", None)
+
+    def test_tie_breaking_deterministic(self):
+        # Equal-size dims: the FIRST largest wins, every time — the explicit
+        # schedule (plan_buckets) and the GSPMD annotation must agree on the
+        # shard dim, so the choice is a pure function of the shape.
+        leaf = jnp.zeros((128, 128))
+        assert zero1_spec(leaf, P(), 2) == P("data", None)
+        assert all(zero1_spec(leaf, P(), 2) == P("data", None) for _ in range(8))
+        assert zero1_dim(leaf, P(), 2) == 0
+        # With dim 0 taken, the tie is gone: dim 1 is the largest free dim.
+        assert zero1_spec(leaf, P("model"), 2) == P("model", "data")
+
+    def test_no_free_dim_stays(self):
+        leaf = jnp.zeros((128, 128))
+        assert zero1_spec(leaf, P("model", "expert"), 2) == P("model", "expert")
+
+    def test_zero1_dim_matches_spec(self):
+        for shape in [(256, 64), (8,), (63, 3), (128, 128), (2, 8192)]:
+            leaf = jnp.zeros(shape)
+            d = zero1_dim(leaf, P(), 4)
+            spec = zero1_spec(leaf, P(), 4)
+            if d is None:
+                assert spec == P()
+            else:
+                assert spec[d] == "data"
 
 
 class TestZeroSharding:

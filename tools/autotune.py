@@ -6,17 +6,24 @@
     python tools/autotune.py --db tuned.json \
         --attn_shape 4x4096x8x64 --decode_shape 8x2048x8x64
 
+    # tune the whole TRAIN STEP schedule (remat policy, grad-accum
+    # chunking, donation, overlapped-vs-GSPMD ZeRO-1) for a training shape
+    # on this machine's mesh; Trainer consumes it via --tuned_step
+    python tools/autotune.py --db tuned.json --step 8x2048
+
     # consume it
     python -m deeplearning_mpi_tpu.cli.serve_lm --tuning_db tuned.json ...
     DMT_TUNING_DB=tuned.json python -m deeplearning_mpi_tpu.cli.train_lm ...
 
     python tools/autotune.py --selftest   # CI gate (`make tune-smoke`)
 
-Shapes are ``BxSxHxD`` for attention (the BSHD call layout) and
-``BxLxHkvxD`` for the decode KV buffer. Every candidate is verified
-against the dense oracle before it may win, so the DB can only ever make
-things faster, never wrong (``deeplearning_mpi_tpu/compiler/autotune.py``;
-docs/COMPILATION.md).
+Shapes are ``BxSxHxD`` for attention (the BSHD call layout),
+``BxLxHkvxD`` for the decode KV buffer, and ``BxS`` for step tuning.
+Every candidate is verified against its oracle before it may win — kernel
+candidates against the dense math, step candidates against the untuned
+step's per-step LOSS TRAJECTORY — so the DB can only ever make things
+faster, never different (``deeplearning_mpi_tpu/compiler/autotune.py``;
+docs/COMPILATION.md; docs/PERF_ANALYSIS.md for the step-tuning workflow).
 
 ``--selftest`` runs the full acceptance loop on tiny CPU shapes: tune both
 kernels, round-trip the DB, check tuned kernels match the defaults
@@ -36,14 +43,16 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def _parse_shape(spec: str, what: str) -> tuple[int, int, int, int]:
+def _parse_shape(
+    spec: str, what: str, ndims: int = 4, example: str = "4x4096x8x64"
+) -> tuple[int, ...]:
     try:
         dims = tuple(int(d) for d in spec.lower().split("x"))
-        if len(dims) != 4 or any(d <= 0 for d in dims):
+        if len(dims) != ndims or any(d <= 0 for d in dims):
             raise ValueError
     except ValueError:
         raise SystemExit(
-            f"bad {what} '{spec}': want 4 positive dims like 4x4096x8x64"
+            f"bad {what} '{spec}': want {ndims} positive dims like {example}"
         )
     return dims
 
@@ -72,6 +81,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: the module's search space)")
     parser.add_argument("--repeats", type=int, default=3,
                         help="timing repeats per candidate (median wins)")
+    parser.add_argument("--step", action="append", default=[],
+                        metavar="BxS",
+                        help="LM train-step shape (global batch x seq) to "
+                        "tune the whole-step schedule for (repeatable)")
+    parser.add_argument("--step_model", default="lm",
+                        help="model family for --step entries")
+    parser.add_argument("--grad_accums", default="1,2",
+                        help="comma-separated grad-accum factors for the "
+                        "--step search space")
+    parser.add_argument("--verify_steps", type=int, default=5,
+                        help="optimizer steps per --step candidate for the "
+                        "loss-trajectory oracle check")
+    parser.add_argument("--virtual_devices", type=int, default=0,
+                        help="CPU only: split the host into N virtual "
+                        "devices before tuning (exercises dp>1 schedules "
+                        "like the overlapped ZeRO-1 step)")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     parser.add_argument("--selftest", action="store_true",
                         help="tiny-shape end-to-end check: tune, round-trip "
@@ -206,6 +231,40 @@ def selftest() -> int:
             )
             ccache._reset_backend_cache()  # un-pin the tmp dir
 
+        # 5. Whole-step schedule tuning: two candidates, oracle-first loss
+        # verification, persisted winner, never-raise consult semantics.
+        step_params = autotune.tune_step_schedule(
+            "lm", batch_size=4, seq_len=16, db=db,
+            candidates=[
+                {"remat": "none", "grad_accum": 1,
+                 "donate": True, "overlap": False},
+                {"remat": "dots", "grad_accum": 2,
+                 "donate": True, "overlap": False},
+            ],
+            steps=3, repeats=1,
+        )
+        check(
+            step_params.get("remat") in ("none", "dots"),
+            f"step schedule tuned: {step_params}",
+        )
+        db.save()
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        step_mesh = create_mesh(MeshSpec(data=len(jax.devices())))
+        back = autotune.tuned_step_schedule(
+            "lm", (4, 16), step_mesh, db=autotune.TuningDB.load(db_path)
+        )
+        check(back == step_params, f"step entry round-trips: {back}")
+        corrupt = Path(td) / "corrupt.json"
+        corrupt.write_text("{not json")
+        check(
+            autotune.tuned_step_schedule(
+                "lm", (4, 16), step_mesh,
+                db=autotune.TuningDB.load(corrupt),
+            ) is None,
+            "corrupt DB consult degrades to None, never raises",
+        )
+
     print("tune-smoke " + ("OK" if ok else "FAILED"), file=sys.stderr)
     return 0 if ok else 1
 
@@ -216,11 +275,16 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.virtual_devices:
+        # Must precede first backend use — bootstrap refuses otherwise.
+        from deeplearning_mpi_tpu.runtime import bootstrap
+
+        bootstrap.set_virtual_cpu_devices(args.virtual_devices)
     if args.selftest:
         return selftest()
-    if not args.attn_shape and not args.decode_shape:
-        print("nothing to tune: pass --attn_shape and/or --decode_shape "
-              "(or --selftest)", file=sys.stderr)
+    if not args.attn_shape and not args.decode_shape and not args.step:
+        print("nothing to tune: pass --attn_shape, --decode_shape, and/or "
+              "--step (or --selftest)", file=sys.stderr)
         return 1
 
     import jax
@@ -249,6 +313,19 @@ def main(argv: list[str] | None = None) -> int:
             repeats=args.repeats,
         )
         print(f"flash_decode {spec}: {params}", file=sys.stderr)
+    for spec in args.step:
+        batch, seq = _parse_shape(spec, "--step", ndims=2, example="8x2048")
+        grad_accums = tuple(int(g) for g in args.grad_accums.split(","))
+        dp = len(jax.devices())
+        params = autotune.tune_step_schedule(
+            args.step_model, batch_size=batch, seq_len=seq, dtype=dtype,
+            db=db, candidates=autotune.step_candidates(
+                dp, grad_accums=grad_accums
+            ),
+            steps=args.verify_steps, repeats=args.repeats,
+        )
+        print(f"step {args.step_model} {spec}: "
+              f"{params or 'no viable candidate'}", file=sys.stderr)
     db.save()
     print(f"wrote {args.db}: {len(db)} entries", file=sys.stderr)
     return 0

@@ -11,7 +11,9 @@ Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
 
 - ``step``   — count, loss first→last, step-rate, per-step collective bytes;
 - ``epoch``  — loss trajectory, images/sec, step-latency p50/p95 (StepTimer
-  keys when present), MFU, HBM high-water marks;
+  keys when present), MFU (plus the remat-aware ``mfu_issued``/``mfu_gap``
+  and the roofline ``overlap_fraction`` when the trainer emits them — see
+  docs/PERF_ANALYSIS.md), HBM high-water marks;
 - ``eval`` kinds — last record's metric columns verbatim.
 """
 
@@ -134,6 +136,20 @@ def summarize(records: list[dict]) -> str:
                 if isinstance(r.get("mfu"), (int, float))]
         if mfus:
             rows.append(("MFU (mean)", f"{sum(mfus) / len(mfus):.2%}"))
+        # Remat-aware companion columns (telemetry/flops.py): mfu_issued
+        # prices the recompute FLOPs the hardware actually executed,
+        # mfu_gap = mfu_issued - mfu is the remat overhead, and
+        # overlap_fraction is the roofline comm/compute overlap estimate.
+        for key, label in (("mfu_issued", "MFU issued (mean)"),
+                           ("mfu_gap", "MFU gap: issued - model (mean)")):
+            vals = [r[key] for r in epochs
+                    if isinstance(r.get(key), (int, float))]
+            if vals:
+                rows.append((label, f"{sum(vals) / len(vals):.2%}"))
+        ovl = [r["overlap_fraction"] for r in epochs
+               if isinstance(r.get("overlap_fraction"), (int, float))]
+        if ovl:
+            rows.append(("overlap fraction (est., last)", f"{ovl[-1]:.2%}"))
         comm = [r["comm_bytes_per_step"] for r in epochs
                 if isinstance(r.get("comm_bytes_per_step"), (int, float))]
         if comm:
@@ -170,7 +186,7 @@ def _selftest() -> int:
     """Synthesize a run through the real registry, render it, and assert the
     acceptance columns come out non-null."""
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-    from deeplearning_mpi_tpu.telemetry.flops import mfu
+    from deeplearning_mpi_tpu.telemetry.flops import mfu, overlap_fraction
     from deeplearning_mpi_tpu.telemetry.registry import JsonlSink, MetricsRegistry
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -179,17 +195,26 @@ def _selftest() -> int:
         for step in range(8):
             reg.record_step(step, {"loss": 2.0 - 0.1 * step, "finite": 1.0})
         reg.flush_steps(extra={"epoch": 0, "comm_bytes": 1.5e6})
+        model_mfu = mfu(1e9, 0.5, n_devices=1, peak_flops_per_device=200e9)
+        issued_mfu = mfu(1.3e9, 0.5, n_devices=1, peak_flops_per_device=200e9)
         reg.emit("epoch", {
             "epoch": 0, "loss": 1.65, "duration_s": 4.0, "images_per_s": 64.0,
             "step_ms_p50": 480.0, "step_ms_p95": 520.0,
-            "mfu": mfu(1e9, 0.5, n_devices=1, peak_flops_per_device=200e9),
+            "mfu": model_mfu,
+            "mfu_issued": issued_mfu,
+            "mfu_gap": issued_mfu - model_mfu,
+            "overlap_fraction": overlap_fraction(
+                1.5e6, 1.3e9, n_devices=1,
+                peak_flops_per_device=200e9, link_bandwidth_per_device=10e9,
+            ),
             "comm_bytes_per_step": 1.5e6,
         })
         reg.emit("final_eval", {"epoch": 0, "eval_loss": 1.6, "eval_accuracy": 0.41})
         reg.close()
         report = summarize(load_records(path))
         print(report)
-        for needle in ("images/s", "p50", "p95", "MFU", "collective bytes"):
+        for needle in ("images/s", "p50", "p95", "MFU", "collective bytes",
+                       "MFU issued", "MFU gap", "overlap fraction"):
             if needle not in report:
                 print(f"selftest FAILED: '{needle}' missing from report",
                       file=sys.stderr)

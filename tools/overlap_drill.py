@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Overlap smoke: overlapped bucketed ZeRO-1 must be BIT-identical to GSPMD.
+
+Runs the same tiny TransformerLM for 5 optimizer steps at dp=2 (two virtual
+CPU devices) through both train-step constructions:
+
+- GSPMD ZeRO-1 — ``make_train_step`` with ``infer_state_sharding(zero=True)``
+  (the compiler schedules the gradient reduce-scatter / param all-gather);
+- overlapped   — ``make_overlapped_train_step``'s explicit bucketed schedule
+  (``shard_map`` + ``psum_scatter``; ``parallel/zero.py``).
+
+Every per-step loss AND every leaf of the final optimizer state and params
+must be bit-equal (``np.array_equal`` on the raw arrays — no tolerance).
+This is the property the overlapped path is allowed to exist on: it
+reorders communication, never arithmetic. The model config pins the known
+bit-equality requirements (``onehot_embed=True`` so the embedding backward
+is a deterministic dot-general + all-reduce; ``tied_embeddings=False`` to
+avoid the tied-head scatter-add ordering); the optimizer includes grad-clip
+(global-norm psum) to exercise the cross-bucket reduction.
+
+Exit 0 and print ``overlap-smoke OK`` on success; exit 1 with the first
+mismatching leaf otherwise. Invoked by ``make overlap-smoke`` (gating
+``make verify``); mirrored in-suite by ``tests/test_overlap.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning_mpi_tpu.runtime import bootstrap  # noqa: E402
+
+bootstrap.set_virtual_cpu_devices(2)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import jax.tree_util as jtu  # noqa: E402
+import numpy as np  # noqa: E402
+
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM  # noqa: E402
+from deeplearning_mpi_tpu.parallel import (  # noqa: E402
+    make_overlapped_train_step,
+    shard_state,
+)
+from deeplearning_mpi_tpu.parallel.tensor_parallel import infer_state_sharding  # noqa: E402
+from deeplearning_mpi_tpu.runtime.mesh import (  # noqa: E402
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+)
+from deeplearning_mpi_tpu.train import create_train_state, make_train_step  # noqa: E402
+from deeplearning_mpi_tpu.train.trainer import build_optimizer  # noqa: E402
+
+CLIP = 1.0
+STEPS = 5
+
+
+def _fresh_state():
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, head_dim=32,
+        d_model=64, d_ff=256, tied_embeddings=False, onehot_embed=True,
+    )
+    model = TransformerLM(config=cfg, dtype=jnp.float32)
+    tx = build_optimizer("adam", 1e-2, clip_norm=CLIP)
+    return create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, 8), jnp.int32), tx
+    )
+
+
+def main() -> int:
+    if jax.device_count() < 2:
+        print("overlap-smoke SKIP: need 2 devices", file=sys.stderr)
+        return 1
+
+    mesh = create_mesh(MeshSpec(data=2))
+    state_g = shard_state(_fresh_state(), mesh, zero=True)
+    state_o = shard_state(_fresh_state(), mesh, zero=True)
+
+    step_g = make_train_step(
+        "lm", donate=False,
+        state_shardings=infer_state_sharding(state_g, mesh, zero=True),
+    )
+    step_o = make_overlapped_train_step(
+        "lm", state_o, mesh, donate=False, clip_norm=CLIP,
+    )
+    plan = step_o.bucket_plan
+    print(f"bucket plan: {len(plan.buckets)} buckets, "
+          f"{len(plan.replicated)} replicated leaves")
+
+    ok = True
+    rng = np.random.default_rng(0)
+    for i in range(STEPS):
+        tokens = jnp.asarray(rng.integers(0, 256, (8, 16)), jnp.int32)
+        mask = jnp.asarray(rng.integers(0, 2, (8, 16)), jnp.float32)
+        batch = {
+            "tokens": jax.device_put(tokens, batch_sharding(mesh, ndim=2)),
+            "mask": jax.device_put(mask, batch_sharding(mesh, ndim=2)),
+        }
+        state_g, m_g = step_g(state_g, batch)
+        state_o, m_o = step_o(state_o, batch)
+        lg, lo = float(m_g["loss"]), float(m_o["loss"])
+        print(f"step {i}: gspmd={lg!r} overlapped={lo!r}")
+        if lg != lo:
+            print(f"LOSS MISMATCH at step {i}", file=sys.stderr)
+            ok = False
+
+    for name, tg, to in (
+        ("opt_state", state_g.opt_state, state_o.opt_state),
+        ("params", state_g.params, state_o.params),
+    ):
+        for (kp, a), (_, b) in zip(
+            jtu.tree_flatten_with_path(tg)[0],
+            jtu.tree_flatten_with_path(to)[0],
+        ):
+            a, b = np.asarray(a), np.asarray(b)
+            if not np.array_equal(a, b):
+                diff = float(np.max(np.abs(a - b)))
+                print(f"STATE MISMATCH {name}{jtu.keystr(kp)} shape "
+                      f"{a.shape} maxdiff {diff}", file=sys.stderr)
+                ok = False
+
+    if int(state_g.step) != STEPS or int(state_o.step) != STEPS:
+        print(f"step counter mismatch: gspmd={int(state_g.step)} "
+              f"overlapped={int(state_o.step)}", file=sys.stderr)
+        ok = False
+
+    if not ok:
+        print("overlap-smoke FAILED", file=sys.stderr)
+        return 1
+    print(f"{STEPS} steps bit-identical (losses, optimizer state, params)")
+    print("overlap-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
