@@ -4,15 +4,16 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
+.PHONY: verify selftest check smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
-# serve-smoke, chaos-smoke, tune-smoke, pod-smoke, and overlap-smoke
-# prerequisites gate the tier-1 run on the serving engine's end-to-end
-# parity selftest, the fault-injection recovery drill, the autotune loop,
-# the elastic-pod rank-failure drill, and the overlapped-ZeRO-1
-# bit-equality drill without touching the ROADMAP command itself.
-verify: serve-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
+# serve-smoke, spec-smoke, chaos-smoke, tune-smoke, pod-smoke, and
+# overlap-smoke prerequisites gate the tier-1 run on the serving engine's
+# end-to-end parity selftest, the speculative-decode parity/reconciliation
+# drill, the fault-injection recovery drill, the autotune loop, the
+# elastic-pod rank-failure drill, and the overlapped-ZeRO-1 bit-equality
+# drill without touching the ROADMAP command itself.
+verify: serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -31,6 +32,21 @@ serve-smoke:
 		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
 		--max_slots 3 --block_size 8 --num_blocks 32 \
 		--max_blocks_per_seq 6 --prefill_chunk 8
+
+# Speculative decoding end-to-end: same trace as serve-smoke but with a
+# 1-layer self-draft proposing 3 tokens/step and bucketed decode-batch
+# formation. The selftest asserts bit-identical greedy parity (the
+# exact-match acceptance rule means the draft can never change output),
+# counter reconciliation (proposed == accepted + rolled back), and a
+# nonzero acceptance rate (docs/SERVING.md "Speculative decoding").
+spec-smoke:
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.serve_lm \
+		--selftest --num_layers 2 --num_heads 2 --head_dim 16 \
+		--d_model 64 --d_ff 128 --num_requests 8 --rate 100 \
+		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
+		--max_slots 3 --block_size 8 --num_blocks 32 \
+		--max_blocks_per_seq 6 --prefill_chunk 8 \
+		--spec_k 3 --draft_layers 1 --decode_buckets 2,3
 
 # Overlapped-ZeRO-1 bit-equality drill (docs/PERF_ANALYSIS.md): 5 training
 # steps at dp=2 (two virtual CPU devices) through the explicit bucketed

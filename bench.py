@@ -26,7 +26,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import threading
+import signal
+import subprocess
+import sys
 import time
 
 # Single-A100 ResNet-50 mixed-precision throughput stand-in. Public anchor:
@@ -37,6 +39,17 @@ import time
 A100_RESNET50_224_IMG_PER_S = 1500.0
 
 V5E_PEAK_BF16_TFLOPS = 197.0  # nominal; tools/profile_resnet.py measured 187
+
+# Round-4 single-stream decode harness result (tools/bench_decode.py
+# bench_e2e: ~110M LM, one request at a time, blended prefill+decode
+# positions/s). BENCH_r04.json's details record it was measured on **TPU
+# v5 lite**, while every round since runs on CPU — so a raw ratio against
+# this constant compares chips, not code. The speculative+batched engine's
+# >=5x target is therefore judged on the SAME harness: bench_spec_decode
+# re-runs the r04 single-stream recipe fresh in the same process
+# (speedup_vs_single_stream) and reports vs_r04 against this constant only
+# as the cross-round anchor. See docs/PERF_ANALYSIS.md §12.
+R04_SINGLE_STREAM_POSITIONS_PER_S = 1341.0
 
 # Analytic forward FLOPs per image for ResNet-50 (2*MACs over convs+fc), by
 # input size; training step ≈ 3x forward. This is the community MFU
@@ -339,6 +352,212 @@ def bench_decode(
     return result
 
 
+def bench_spec_decode(
+    context: int = 128,
+    new_tokens: int = 96,
+    batch: int = 32,
+    spec_k: int = 1,
+    draft_layers: int = 1,
+) -> dict:
+    """Speculative + large-batch serving vs the round-4 decode harness.
+
+    Three arms on the SAME ~110M model (byte vocab, the bench_e2e shape):
+
+    - ``single_stream_positions_per_s`` — the r04 harness re-measured in
+      this process: ``generate_jit``, one request at a time, blended
+      prefill+decode positions/s (the 1,341 baseline's exact recipe, on
+      whatever chip THIS round runs on — see R04_SINGLE_STREAM note);
+    - ``spec_positions_per_s`` — the paged engine serving ``batch``
+      concurrent copies of the workload with chunked prefill, bucketed
+      decode batching, and a ``draft_layers``-layer self-draft proposing
+      ``spec_k`` tokens per sequence per verify step;
+    - ``plain_positions_per_s`` — the same engine with speculation OFF
+      (the k=0 candidate ``tools/autotune.py --spec_k`` always races).
+
+    The headline ``positions_per_s`` is the better engine arm — the
+    configuration a deploy would pick, and the field measurement of the
+    k-vs-0 question ``tune_spec_k`` answers offline (``deployed_spec_k``
+    says which won; on a compute-bound CPU host expect 0 — the verify
+    step re-spends arithmetic that batching already saturated, see
+    docs/PERF_ANALYSIS.md §12). Greedy parity means all arms emit
+    identical streams, so the ratios are pure throughput comparisons;
+    the measured ``acceptance_rate`` and the proposed/accepted/rollback
+    reconciliation ride the details regardless of which arm wins. Engine
+    arms are AOT-warmed first (``ServingEngine.warmup``) so the timed
+    windows contain zero compiles — same discipline as every bench here.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.compiler import autotune
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.generate import generate_jit
+    from deeplearning_mpi_tpu.models.transformer import (
+        draft_config,
+        truncate_lm_params,
+    )
+    from deeplearning_mpi_tpu.serving import EngineConfig, ServingEngine
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+    from deeplearning_mpi_tpu.utils.profiling import host_sync
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=12, num_heads=12, head_dim=64,
+        d_model=768, d_ff=3072,
+    )
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dt)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt_len = context - new_tokens
+
+    # The engine arms: the paged engine, batch concurrent requests — once
+    # with the self-draft proposing (spec), once with speculation off
+    # (plain: the k=0 candidate the spec-k tuner always keeps in the
+    # field). Identical pool geometry so both consult the same tuned
+    # decode-bucket entries.
+    block_size = 32
+    blocks_per_seq = (context + spec_k) // block_size + 2
+    # Feed the per-(batch, context)-bucket decode schedule through the
+    # tuning DB. With --tuning_db the installed DB is consulted as-is;
+    # without one, tune THIS pool shape's live context buckets inline
+    # (repeats=1 — enough to pick a schedule and stamp provenance), so
+    # the engine's per-step consults hit either way and
+    # details.tuning_provenance records which entries drove the run.
+    db = autotune.default_db()
+    if db is None:
+        db = autotune.set_default_db(autotune.TuningDB())
+    max_seq_len = blocks_per_seq * block_size
+    pool_shape = (
+        batch, max_seq_len,
+        cfg.num_kv_heads or cfg.num_heads, cfg.head_dim,
+    )
+    autotune.tune_decode_buckets(
+        pool_shape, dt, db=db,
+        batch_buckets=(batch,),
+        context_buckets=tuple(sorted({
+            autotune.pow2_bucket(c, cap=max_seq_len)
+            for c in (context // 2, context, context + spec_k + 1)
+        })),
+        blocks=(max_seq_len,),
+        repeats=1,
+    )
+    base_cfg = dict(
+        max_slots=batch,
+        block_size=block_size,
+        num_blocks=batch * blocks_per_seq + 8,
+        max_blocks_per_seq=blocks_per_seq,
+        # One chunk covers the whole (short, decode-dominated workload)
+        # prompt; a wider fixed-shape chunk would pad-and-waste.
+        prefill_chunk=min(64, prompt_len),
+        max_queue=2 * batch,
+        # A DB is always installed by this point, so defer the
+        # kernel-vs-einsum choice to its per-bucket winners every step.
+        use_kernel=None,
+        decode_buckets=(batch // 2, batch) if batch >= 2 else (),
+    )
+
+    def run_engine(k: int) -> dict:
+        registry = MetricsRegistry()
+        draft = dict(
+            draft_config=draft_config(cfg, draft_layers),
+            draft_params=truncate_lm_params(params, draft_layers),
+        ) if k else {}
+        engine = ServingEngine(
+            cfg, params, EngineConfig(spec_k=k, **base_cfg),
+            dtype=dt, registry=registry, **draft,
+        )
+        engine.warmup()
+        nrng = np.random.default_rng(0)
+        for _ in range(batch):
+            engine.submit(
+                nrng.integers(
+                    1, cfg.vocab_size, size=prompt_len
+                ).astype(np.int32),
+                new_tokens,
+            )
+        t0 = time.perf_counter()
+        finished = engine.run_until_idle()
+        wall = time.perf_counter() - t0
+        positions = sum(r.prompt_len + len(r.generated) for r in finished)
+        tokens = sum(len(r.generated) for r in finished)
+        return {
+            "wall": wall,
+            "pps": positions / wall,
+            "tokens": tokens,
+            "finished": len(finished),
+            "snap": registry.snapshot(),
+        }
+
+    spec = run_engine(spec_k)
+    plain = run_engine(0)
+    best = spec if spec["pps"] >= plain["pps"] else plain
+
+    # The baseline arm: the r04 harness, verbatim recipe
+    # (tools/bench_decode.py bench_e2e): one stream, jitted generate,
+    # blended positions/s. Measured LAST, directly adjacent to the engine
+    # arms' timed windows — minutes of sustained load separate process
+    # start from here, and measuring the baseline in the cold-turbo window
+    # while the engine arms run thermally throttled would bias the ratio
+    # AGAINST the engine (observed ~25% single-stream swing on the CPU
+    # rig between the first and last minutes of this entry).
+    fn = generate_jit(model, max_new_tokens=new_tokens, temperature=0.0)
+    rng = jax.random.key(0)
+    prompts = [
+        jax.random.randint(
+            jax.random.key(s), (1, prompt_len), 0, cfg.vocab_size, jnp.int32
+        )
+        for s in range(4)
+    ]
+    host_sync(fn(params, prompts[0], rng).ravel()[:1])  # compile
+    times = []
+    for p in prompts[1:]:
+        t0 = time.perf_counter()
+        host_sync(fn(params, p, rng).ravel()[:1])
+        times.append(time.perf_counter() - t0)
+    single_dt = min(times)
+    single_pps = context / single_dt
+
+    snap = spec["snap"]
+    proposed = snap.get("spec_proposed_total", 0)
+    accepted = snap.get("spec_accepted_total", 0)
+    rollback = snap.get("spec_rollback_total", 0)
+    engine_pps = best["pps"]
+    result = {
+        "context": context,
+        "new_tokens": new_tokens,
+        "batch": batch,
+        "spec_k": spec_k,
+        "draft_layers": draft_layers,
+        "requests_finished": best["finished"],
+        "single_stream_positions_per_s": round(single_pps, 1),
+        "positions_per_s": round(engine_pps, 1),
+        "spec_positions_per_s": round(spec["pps"], 1),
+        "plain_positions_per_s": round(plain["pps"], 1),
+        "deployed_spec_k": spec_k if best is spec else 0,
+        "speedup_vs_single_stream": round(engine_pps / single_pps, 2),
+        "vs_r04": round(engine_pps / R04_SINGLE_STREAM_POSITIONS_PER_S, 2),
+        "r04_note": (
+            "r04's 1341 positions/s was measured on TPU v5 lite; "
+            "speedup_vs_single_stream re-runs that recipe on THIS host"
+        ),
+        "generated_tokens_per_s": round(best["tokens"] / best["wall"], 1),
+        "accepted_tokens_per_s": round(accepted / spec["wall"], 1),
+        "acceptance_rate": round(accepted / proposed, 3) if proposed else None,
+        "spec_proposed": int(proposed),
+        "spec_accepted": int(accepted),
+        "spec_rollback": int(rollback),
+        "spec_reconciled": proposed == accepted + rollback,
+        "decode_steps": best["snap"].get("serve_decode_steps", 0),
+        "device": str(jax.devices()[0].device_kind),
+    }
+    db = autotune.default_db()
+    if db is not None and db.consulted:
+        result["tuning_provenance"] = db.consulted
+    return result
+
+
 def bench_allreduce() -> dict:
     """Gradient-sized all-reduce latency over the data axis — the BASELINE.md
     'DDP all-reduce step latency' metric (the reference's unmeasured hot path,
@@ -351,28 +570,49 @@ def bench_allreduce() -> dict:
     return measure_collective_latency(create_mesh(), num_floats=25_600_000)
 
 
-def _device_responsive(timeout_s: float = 120.0) -> str | None:
-    """Probe the accelerator in a subprocess; return an error string if it
-    hangs or fails.
+def _kill_group(proc) -> None:
+    """SIGKILL a child's whole process group, then reap it. The child may
+    spawn helpers (tunnel client) that inherit the pipes; killing only the
+    child would leave communicate() blocked on pipe EOF — the hang guard
+    must not hang."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    proc.communicate()
+
+
+def _device_responsive(
+    workload: str, timeout_s: float = 120.0, platform: str | None = None
+) -> str | None:
+    """Probe the accelerator in a subprocess before ONE workload; return an
+    error string if the probe hangs or fails.
 
     A wedged axon tunnel makes the first JAX op block forever (observed
     2026-07-30: a killed remote compile left the tunnel unresponsive for
-    hours — even ``jax.devices()`` hung). JAX calls can't be interrupted
-    in-process, so the probe runs in a child that can be killed; without
-    this, a dead tunnel turns the whole bench into a silent hang instead of
-    one diagnosable JSON line.
-    """
-    import os
-    import signal
-    import subprocess
-    import sys
+    hours — even ``jax.devices()`` hung; rounds r03/r05 lost their ENTIRE
+    bench output to a single 120 s probe hang at startup). JAX calls can't
+    be interrupted in-process, so the probe runs in a child that can be
+    killed — and it runs per WORKLOAD, so a wedge costs one ``failed``
+    entry, not the round: later workloads re-probe and still report if the
+    tunnel recovers (or fail individually if it doesn't).
 
+    ``DMT_BENCH_WEDGE_PROBE=<workload key or "all">`` substitutes a child
+    that sleeps forever — the wedge drill ``tests/test_bench.py`` runs to
+    pin the salvage behavior. A CPU run normally skips the probe (no
+    tunnel to wedge) but still honors the simulation so the drill doesn't
+    need a TPU.
+    """
+    wedge = os.environ.get("DMT_BENCH_WEDGE_PROBE", "")
+    wedged = wedge in (workload, "all") if wedge else False
+    if platform == "cpu" and not wedged:
+        return None
     # jax.devices() alone detects the wedge (it hung too) without paying a
     # remote compile on every healthy run.
-    code = "import jax; print(jax.devices())"
-    # start_new_session + killpg: the child may spawn helpers (tunnel client)
-    # that inherit the pipes; killing only the child would leave
-    # communicate() blocked on pipe EOF — the hang guard must not hang.
+    code = (
+        "import time; time.sleep(1000000)" if wedged
+        else "import jax; print(jax.devices())"
+    )
     proc = subprocess.Popen(
         [sys.executable, "-c", code],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -381,12 +621,11 @@ def _device_responsive(timeout_s: float = 120.0) -> str | None:
     try:
         _, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        proc.communicate()
-        return f"device probe hung for {timeout_s:.0f}s (tunnel/backend unresponsive)"
+        _kill_group(proc)
+        return (
+            f"device probe hung for {timeout_s:.0f}s "
+            "(tunnel/backend unresponsive)"
+        )
     if proc.returncode != 0:
         return f"device probe failed: {stderr.strip()[-300:]}"
     return None
@@ -394,14 +633,16 @@ def _device_responsive(timeout_s: float = 120.0) -> str | None:
 
 def _combined_line(details: dict, error: str | None = None) -> str:
     """The ONE final JSON line the driver parses, derived purely from
-    ``details`` so both the normal exit and the hang watchdog can emit it
-    with whatever sub-benches completed."""
+    ``details`` so it can always be emitted with whatever sub-benches
+    completed — a failed workload contributes a ``{"failed": ...}`` entry
+    whose headline values degrade to null, never a missing line."""
     r224 = details.get("imagenet_224px") or {}
     r32 = details.get("cifar_32px") or {}
     value = r224.get("images_per_s_per_chip") or r32.get("images_per_s_per_chip")
     lm = details.get("transformer_lm_2k_flash") or {}
     unet = details.get("unet2d_512px") or {}
     serving = (details.get("lm_serving_2k") or {}).get("per_batch", {})
+    spec = details.get("lm_spec_decode") or {}
     allreduce = details.get("allreduce") or {}
     out = {
         "metric": "resnet50_bf16_images_per_sec_per_chip",
@@ -429,6 +670,15 @@ def _combined_line(details: dict, error: str | None = None) -> str:
         "decode_tokens_per_s_b32": (serving.get("32") or {}).get(
             "decode_tokens_per_s"
         ),
+        # Speculative + large-batch serving headline (ISSUE 7): blended
+        # positions/s at batch >= 8 against the single-stream r04 harness
+        # re-measured in the same process, plus the measured draft
+        # acceptance rate.
+        "spec_decode_positions_per_s": spec.get("positions_per_s"),
+        "spec_speedup_vs_single_stream": spec.get(
+            "speedup_vs_single_stream"
+        ),
+        "spec_acceptance_rate": spec.get("acceptance_rate"),
         "allreduce_latency_ms": allreduce.get("all_reduce_ms_mean"),
         "details": details,
     }
@@ -437,69 +687,7 @@ def _combined_line(details: dict, error: str | None = None) -> str:
     return json.dumps(out)
 
 
-class _HangWatchdog:
-    """Per-workload wall-clock bound that cannot be defeated by a wedged
-    tunnel: a JAX call blocked inside a remote-compile RPC ignores signals
-    and can never be interrupted in-process (observed 2026-07-31: one UNet
-    compile sat >25 min, the outer timeout killed the whole bench, and the
-    final combined line — with three good numbers already in hand — was
-    never printed). The only reliable salvage is a daemon thread that, when
-    a workload overruns its budget, prints the combined line from the
-    results collected so far and ``os._exit``s — the stuck main thread is
-    unrecoverable either way; the captured numbers need not be.
-    """
-
-    def __init__(self, details: dict, budget_s: float):
-        self._details = details
-        self._budget = budget_s
-        self._armed_budget = budget_s
-        self._deadline: float | None = None
-        self._label: str | None = None
-        self._lock = threading.Lock()
-        threading.Thread(target=self._loop, daemon=True).start()
-
-    def arm(self, label: str, budget_s: float | None = None) -> None:
-        with self._lock:
-            self._label = label
-            self._armed_budget = budget_s or self._budget
-            self._deadline = time.perf_counter() + self._armed_budget
-
-    def disarm(self) -> None:
-        with self._lock:
-            self._deadline = None
-
-    def _loop(self) -> None:
-        while True:
-            time.sleep(5)
-            with self._lock:
-                # Claiming the deadline under the lock closes the finish-at-
-                # the-boundary race: a workload whose disarm() won the lock
-                # first is no longer expired, and a fire observed here can't
-                # be un-fired by a late disarm.
-                expired = (
-                    self._deadline is not None
-                    and time.perf_counter() > self._deadline
-                )
-                if expired:
-                    self._deadline = None
-                label, budget = self._label, self._armed_budget
-            if expired:
-                # dict() is a single C-level (GIL-atomic) copy; json.dumps
-                # iterates in Python steps and would race a concurrent
-                # `details[key] = r` on the main thread.
-                snapshot = dict(self._details)
-                print(
-                    _combined_line(
-                        snapshot,
-                        error=f"workload '{label}' exceeded {budget:.0f}s "
-                        "(likely wedged tunnel); partial results",
-                    ),
-                    flush=True,
-                )
-                os._exit(0)  # exit code irrelevant: the last line carries the result
-
-
-def main() -> None:
+def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_224", type=int, default=128)
     parser.add_argument("--batch_32", type=int, default=1024)
@@ -508,15 +696,24 @@ def main() -> None:
     parser.add_argument("--skip_lm", action="store_true")
     parser.add_argument("--skip_unet", action="store_true")
     parser.add_argument("--skip_decode", action="store_true")
+    parser.add_argument("--skip_spec", action="store_true",
+                        help="skip the speculative+batched serving workload")
+    parser.add_argument("--spec_batch", type=int, default=32,
+                        help="concurrent requests in the lm_spec_decode "
+                        "engine arm (the >=5x target holds for 8-32)")
     parser.add_argument("--long_context", action="store_true",
                         help="add the 32k flash+remat AND 64k "
                         "flash+remat+chunked-loss LM entries (each a "
                         "multi-minute compile; see their call sites)")
     parser.add_argument("--workload_timeout", type=float, default=600.0,
-                        help="per-workload wall-clock budget (s); on overrun "
-                        "the final combined line is emitted with the results "
-                        "so far and the process exits (healthy compile+timing "
-                        "is <=~3 min/workload through the tunnel)")
+                        help="per-workload wall-clock budget (s); an "
+                        "overrunning workload's child process group is "
+                        "killed and recorded as a failed entry — the other "
+                        "workloads and the final combined line still run "
+                        "(healthy compile+timing is <=~3 min/workload "
+                        "through the tunnel)")
+    parser.add_argument("--probe_timeout", type=float, default=120.0,
+                        help="per-workload device-probe budget (s)")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"),
                         help="force JAX platform (debug; default = real TPU)")
     parser.add_argument("--tuning_db", default=None, metavar="PATH",
@@ -524,8 +721,19 @@ def main() -> None:
                         "install process-wide; every kernel and step|... "
                         "entry consulted during the run is recorded into the "
                         "final line's details.tuning_provenance")
-    args = parser.parse_args()
+    parser.add_argument("--only", default=None, metavar="WORKLOAD",
+                        help="child mode (internal): run exactly this "
+                        "workload in-process and print its detail dict as "
+                        "the final JSON line")
+    return parser
 
+
+def _child_main(args) -> int:
+    """``--only`` mode: run ONE workload in this process and print its
+    detail dict as the LAST stdout line. The parent owns isolation (budget,
+    process-group kill); this process just computes. JAX is imported only
+    here — the parent stays JAX-free so a wedged backend can never hang
+    the orchestrator itself."""
     if args.platform:
         import jax
 
@@ -534,58 +742,140 @@ def main() -> None:
         from deeplearning_mpi_tpu.compiler import autotune
 
         autotune.set_default_db(args.tuning_db)
-    if args.platform != "cpu":  # default and explicit tpu both hit the device
-        probe_error = _device_responsive()
-        if probe_error is not None:
-            # Same schema as the success line (null values + error field) so
-            # single-line consumers never KeyError on the failure path.
-            print(_combined_line({}, error=probe_error))
-            return
 
+    key = args.only
+    if key == "cifar_32px":
+        detail = bench_train_step(32, args.batch_32, args.steps)
+    elif key == "imagenet_224px":
+        detail = bench_train_step(224, args.batch_224, args.steps)
+    elif key == "transformer_lm_2k_flash":
+        detail = bench_lm(steps=max(args.steps // 2, 5))
+    elif key == "transformer_lm_32k_flash_remat":
+        detail = bench_lm(seq_len=32768, batch_size=1, steps=3, remat=True)
+    elif key == "transformer_lm_64k_flash_remat_chunked":
+        detail = bench_lm(seq_len=65536, batch_size=1, steps=3, remat=True,
+                          loss_chunk=2048)
+    elif key == "unet2d_512px":
+        detail = bench_unet(steps=max(args.steps // 2, 5))
+    elif key == "lm_serving_2k":
+        detail = bench_decode()
+    elif key == "lm_spec_decode":
+        detail = bench_spec_decode(batch=args.spec_batch)
+    elif key == "allreduce":
+        detail = bench_allreduce()
+    else:
+        print(f"unknown workload '{key}'", file=sys.stderr)
+        return 2
+
+    # Per-child tuning provenance rides the sentinel so the parent can
+    # aggregate consults across isolated processes.
+    from deeplearning_mpi_tpu.compiler import autotune
+
+    db = autotune.default_db()
+    if db is not None and db.consulted and "tuning_provenance" not in detail:
+        detail["tuning_provenance"] = db.consulted
+    print(json.dumps({"workload": key, "detail": detail}), flush=True)
+    return 0
+
+
+def _run_isolated(key: str, argv: list[str], budget_s: float) -> dict:
+    """Run one workload as ``bench.py --only <key>`` in its own process
+    group under a wall-clock budget.
+
+    This is the salvage mechanism the old in-process watchdog approximated:
+    a JAX call blocked inside a remote-compile RPC ignores signals and can
+    never be interrupted in-process (observed 2026-07-31: one UNet compile
+    sat >25 min and took the whole bench down with it). A child process
+    group CAN always be killed, so an overrun costs exactly one
+    ``{"failed": ...}`` entry and the remaining workloads still run.
+    Returns the workload's detail dict, or the failed entry.
+    """
+    cmd = [sys.executable, os.path.abspath(__file__), "--only", key, *argv]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True, start_new_session=True,
+    )  # stderr inherits: compile/progress noise stays live on the console
+    try:
+        stdout, _ = proc.communicate(timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        _kill_group(proc)
+        return {
+            "failed": f"workload exceeded {budget_s:.0f}s budget "
+            "(likely wedged tunnel); child process group killed",
+        }
+    lines = [ln for ln in (stdout or "").splitlines() if ln.strip()]
+    sentinel = None
+    if lines:
+        try:
+            parsed = json.loads(lines[-1])
+            if parsed.get("workload") == key:
+                sentinel = parsed["detail"]
+                lines = lines[:-1]
+        except (json.JSONDecodeError, AttributeError):
+            pass
+    for ln in lines:  # re-emit the child's progress lines in order
+        print(ln, flush=True)
+    if proc.returncode != 0 or sentinel is None:
+        return {
+            "failed": f"workload exited {proc.returncode} without a "
+            "result line",
+        }
+    return sentinel
+
+
+def main() -> None:
+    args = _build_parser().parse_args()
+    if args.only:
+        raise SystemExit(_child_main(args))
+
+    # The parent is a pure orchestrator: it never imports JAX, so no wedge
+    # can reach it. Per workload: probe the device (subprocess, killable),
+    # then run the workload itself in an isolated child under its budget.
     # One JSON line per workload as it completes (progress stays visible
     # even if a later stage hangs the tunnel), then ONE final combined line
     # — the driver parses the LAST line, so every headline number (ResNet,
     # LM, UNet, allreduce) rides it at TOP level: the LM flagship must not
     # be buried inside `details` (round-3 verdict weak #1).
+    child_argv = sys.argv[1:]
     details: dict = {}
-    watchdog = _HangWatchdog(details, args.workload_timeout)
 
-    def run(key: str, fn, *fargs, metric: str, unit: str, value_key: str,
-            budget_s: float | None = None, **fkw):
-        watchdog.arm(key, budget_s)
-        try:
-            r = fn(*fargs, **fkw)
-            details[key] = r
-            print(json.dumps(
-                {"metric": metric, "value": r.get(value_key), "unit": unit}
-            ), flush=True)
-            return r
-        except Exception as e:  # noqa: BLE001 — one failed sub-bench must not kill the rest
-            details[f"{key}_error"] = repr(e)
+    def run(key: str, *, metric: str, unit: str, value_key: str,
+            budget_s: float | None = None):
+        probe_error = _device_responsive(
+            key, args.probe_timeout, args.platform
+        )
+        if probe_error is not None:
+            details[key] = {"failed": probe_error}
             print(json.dumps({"metric": metric, "value": None, "unit": unit,
-                              "error": repr(e)[:300]}), flush=True)
+                              "error": probe_error}), flush=True)
             return None
-        finally:
-            watchdog.disarm()
+        r = _run_isolated(key, child_argv, budget_s or args.workload_timeout)
+        details[key] = r
+        if "failed" in r:
+            print(json.dumps({"metric": metric, "value": None, "unit": unit,
+                              "error": r["failed"]}), flush=True)
+            return None
+        print(json.dumps(
+            {"metric": metric, "value": r.get(value_key), "unit": unit}
+        ), flush=True)
+        return r
 
     run(
-        "cifar_32px", bench_train_step, 32, args.batch_32, args.steps,
+        "cifar_32px",
         metric="resnet50_bf16_cifar32_images_per_sec_per_chip",
         unit="images/s/chip", value_key="images_per_s_per_chip",
     )
     if not args.skip_224:
         run(
-            "imagenet_224px", bench_train_step, 224, args.batch_224, args.steps,
+            "imagenet_224px",
             metric="resnet50_bf16_224px_images_per_sec_per_chip",
             unit="images/s/chip", value_key="images_per_s_per_chip",
         )
 
     if not args.skip_lm:
         run(
-            "transformer_lm_2k_flash", bench_lm,
+            "transformer_lm_2k_flash",
             metric="transformer_lm_110m_2k_flash_tokens_per_sec_per_chip",
             unit="tokens/s/chip", value_key="tokens_per_s_per_chip",
-            steps=max(args.steps // 2, 5),
         )
 
     if args.long_context:
@@ -596,38 +886,34 @@ def main() -> None:
         # minutes through the axon remote-compile tunnel, which would
         # push the default bench past the driver's window. Measured on
         # v5e: 2,090 ms/step = 15.7k tokens/s/chip (16k seq: 26.9k).
+        # Opt-in AND known-slow: the default per-workload budget would
+        # kill a healthy 32k/64k compile as a "wedge".
         run(
-            "transformer_lm_32k_flash_remat", bench_lm,
+            "transformer_lm_32k_flash_remat",
             metric="transformer_lm_110m_32k_flash_remat_tokens_per_sec_per_chip",
             unit="tokens/s/chip", value_key="tokens_per_s_per_chip",
-            seq_len=32768, batch_size=1, steps=3, remat=True,
-            # Opt-in AND known-slow: the 32k compile alone takes many
-            # minutes, so the default per-workload budget would kill a
-            # healthy run as a "wedge".
             budget_s=max(args.workload_timeout, 2400.0),
         )
         # 64k: all three walls at once (flash + remat + chunked head+loss).
         # Measured 2026-07-31: 8.6k tok/s, 7.59 s/step (32k vocab; the
         # byte-vocab CLI variant of the same shape runs 11.0k).
         run(
-            "transformer_lm_64k_flash_remat_chunked", bench_lm,
+            "transformer_lm_64k_flash_remat_chunked",
             metric="transformer_lm_110m_64k_flash_remat_chunk_tokens_per_sec_per_chip",
             unit="tokens/s/chip", value_key="tokens_per_s_per_chip",
-            seq_len=65536, batch_size=1, steps=3, remat=True, loss_chunk=2048,
             budget_s=max(args.workload_timeout, 2400.0),
         )
 
     if not args.skip_unet:
         run(
-            "unet2d_512px", bench_unet,
+            "unet2d_512px",
             metric="unet2d_512px_images_per_sec_per_chip",
             unit="images/s/chip", value_key="images_per_s_per_chip",
-            steps=max(args.steps // 2, 5),
         )
 
     if not args.skip_decode:
         r = run(
-            "lm_serving_2k", bench_decode,
+            "lm_serving_2k",
             metric="lm_110m_serving_split", unit="tokens/s",
             value_key="new_tokens",  # progress line only; real values below
             # 3 batch sizes x 2 compiles each through the tunnel.
@@ -647,20 +933,36 @@ def main() -> None:
                 "unit": "tokens/s by batch",
             }), flush=True)
 
+    if not args.skip_spec:
+        run(
+            "lm_spec_decode",
+            metric="lm_110m_spec_decode_positions_per_sec",
+            unit="positions/s", value_key="positions_per_s",
+            # Engine warmup + two arms' compiles through the tunnel.
+            budget_s=max(args.workload_timeout, 1800.0),
+        )
+
     run(
-        "allreduce", bench_allreduce,
-        metric="allreduce_latency_ms", unit="ms", value_key="all_reduce_ms_mean",
+        "allreduce",
+        metric="allreduce_latency_ms", unit="ms",
+        value_key="all_reduce_ms_mean",
     )
 
-    # Which tuning-DB entries the run actually consulted (kernel block
-    # shapes, step|... schedules), each with the stored params and recorded
-    # median seconds — so a BENCH_*.json number can be traced back to the
-    # autotune results that shaped it.
-    from deeplearning_mpi_tpu.compiler import autotune
-
-    db = autotune.default_db()
-    if db is not None and db.consulted:
-        details["tuning_provenance"] = db.consulted
+    # Which tuning-DB entries the children actually consulted (kernel block
+    # shapes, decode buckets, step|... schedules), each with the stored
+    # params — so a BENCH_*.json number can be traced back to the autotune
+    # results that shaped it. Children report their own consults in their
+    # sentinel lines; the parent (JAX-free) only aggregates.
+    provenance: list = []
+    seen: set = set()
+    for r in details.values():
+        if isinstance(r, dict):
+            for rec in r.get("tuning_provenance") or []:
+                if rec.get("key") not in seen:
+                    seen.add(rec.get("key"))
+                    provenance.append(rec)
+    if provenance:
+        details["tuning_provenance"] = provenance
 
     print(_combined_line(details))
 

@@ -81,6 +81,37 @@ def build_parser() -> argparse.ArgumentParser:
                      "before accepting traffic (compiler/aot.py): first-"
                      "request latency contains zero compiles, and "
                      "compile-cache hit/miss counters land in the registry")
+    eng.add_argument("--decode_buckets", default="",
+                     help="comma-separated decode batch buckets, e.g. "
+                     "'8,16,32': the scheduler briefly holds the decode "
+                     "phase while enough supply exists to reach a larger "
+                     "bucket, so verify/decode steps run at batched widths")
+    eng.add_argument("--max_hold_steps", type=int, default=4,
+                     help="max consecutive engine steps the scheduler may "
+                     "hold decode while forming a larger batch bucket")
+    spec = parser.add_argument_group(
+        "speculative decoding (exact-greedy-match acceptance: output "
+        "streams stay bit-identical to offline greedy regardless of "
+        "draft quality)"
+    )
+    spec.add_argument("--spec_k", type=int, default=0,
+                      help="draft tokens proposed per sequence per engine "
+                      "step (0 = off; -1 = consult the tuning DB's "
+                      "spec_k winner for this model/draft pair)")
+    spec.add_argument("--draft_layers", type=int, default=0,
+                      help="self-speculative draft: truncate the target to "
+                      "its first N layers (tied embeddings reuse the "
+                      "target's logit projection); required when spec_k "
+                      "is nonzero")
+    spec.add_argument("--draft_d_model", type=int, default=None,
+                      help="custom draft width (random-init draft instead "
+                      "of layer truncation; parity still holds — the "
+                      "draft only proposes, the target decides)")
+    spec.add_argument("--draft_d_ff", type=int, default=None)
+    spec.add_argument("--draft_heads", type=int, default=None)
+    spec.add_argument("--draft_head_dim", type=int, default=None)
+    spec.add_argument("--draft_seed", type=int, default=0,
+                      help="init seed for a custom-width draft")
     trace = parser.add_argument_group("trace")
     trace.add_argument("--trace", default=None,
                        help="JSONL request trace (see module docstring); "
@@ -233,9 +264,24 @@ def _report(reqs, wall_s, registry, out=sys.stderr):
         )
     print(
         f"engine: {snap.get('serve_decode_steps', 0):.0f} decode steps, "
-        f"{snap.get('serve_prefill_chunks', 0):.0f} prefill chunks",
+        f"{snap.get('serve_prefill_chunks', 0):.0f} prefill chunks"
+        + (
+            f", {snap['serve_decode_held_steps']:.0f} held for batching"
+            if snap.get("serve_decode_held_steps") else ""
+        ),
         file=out,
     )
+    prop = snap.get("spec_proposed_total", 0)
+    if prop:
+        acc = snap.get("spec_accepted_total", 0)
+        rb = snap.get("spec_rollback_total", 0)
+        print(
+            f"speculative: {prop:.0f} proposed, {acc:.0f} accepted "
+            f"({acc / prop:.1%}), {rb:.0f} rolled back "
+            f"({snap.get('spec_blocks_rolled_back_total', 0):.0f} KV "
+            f"blocks) | accepted draft tokens/s: {acc / wall_s:.1f}",
+            file=out,
+        )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -339,6 +385,55 @@ def main(argv: list[str] | None = None) -> int:
     # schedule choice itself (kernel vs einsum) defers to the DB's winner
     # (use_kernel=None); otherwise the einsum default stands.
     use_kernel = True if args.use_kernel else (None if args.tuning_db else False)
+
+    spec_k = args.spec_k
+    if spec_k and args.draft_layers < 1:
+        print("--spec_k needs a draft model: pass --draft_layers N "
+              "(self-speculative layer truncation)", file=sys.stderr)
+        return 1
+    if spec_k == -1:
+        from deeplearning_mpi_tpu.compiler import autotune
+
+        tuned = autotune.tuned_spec_k(cfg, args.draft_layers, dtype)
+        spec_k = tuned["spec_k"] if tuned else 0
+        print(
+            f"spec_k from tuning DB: {spec_k}"
+            + (f" (tuned accept_rate {tuned['accept_rate']:.2f})" if tuned
+               else " (no spec_k entry for this model/draft — disabled)"),
+            file=sys.stderr,
+        )
+    draft_cfg = draft_params = None
+    if spec_k > 0:
+        from deeplearning_mpi_tpu.models import draft_config, truncate_lm_params
+
+        overrides = {
+            k: v for k, v in (
+                ("d_model", args.draft_d_model),
+                ("d_ff", args.draft_d_ff),
+                ("num_heads", args.draft_heads),
+                ("head_dim", args.draft_head_dim),
+            ) if v is not None
+        }
+        draft_cfg = draft_config(cfg, args.draft_layers, **overrides)
+        if overrides:
+            # Width changed: target arrays can't be reused. Random init —
+            # acceptance will be poor until the draft is trained, but the
+            # exact-match rule keeps outputs correct regardless.
+            draft_model = TransformerLM(config=draft_cfg, dtype=dtype)
+            draft_params = draft_model.init(
+                jax.random.key(args.draft_seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        else:
+            draft_params = truncate_lm_params(params, args.draft_layers)
+
+    try:
+        decode_buckets = tuple(
+            int(b) for b in args.decode_buckets.split(",") if b.strip()
+        )
+    except ValueError:
+        print(f"bad --decode_buckets {args.decode_buckets!r}: expected "
+              "comma-separated integers like '8,16,32'", file=sys.stderr)
+        return 1
     engine = ServingEngine(
         cfg, params,
         EngineConfig(
@@ -349,8 +444,12 @@ def main(argv: list[str] | None = None) -> int:
             prefill_chunk=args.prefill_chunk,
             max_queue=args.max_queue,
             use_kernel=use_kernel,
+            spec_k=spec_k,
+            decode_buckets=decode_buckets,
+            max_hold_steps=args.max_hold_steps,
         ),
         dtype=dtype, eos_id=eos_id, registry=registry, chaos=chaos,
+        draft_config=draft_cfg, draft_params=draft_params,
     )
     if args.warmup:
         t_warm = time.monotonic()
@@ -424,6 +523,24 @@ def main(argv: list[str] | None = None) -> int:
         print(f"selftest FAILED: {mismatched}/{len(done)} request(s) "
               "diverged", file=sys.stderr)
         return 1
+    if spec_k > 0:
+        snap = registry.snapshot()
+        prop = snap.get("spec_proposed_total", 0)
+        acc = snap.get("spec_accepted_total", 0)
+        rb = snap.get("spec_rollback_total", 0)
+        if prop != acc + rb:
+            print(f"selftest FAILED: speculative counters do not "
+                  f"reconcile: proposed {prop:.0f} != accepted {acc:.0f} "
+                  f"+ rolled back {rb:.0f}", file=sys.stderr)
+            return 1
+        if not prop or not acc:
+            print(f"selftest FAILED: speculative path inert (proposed "
+                  f"{prop:.0f}, accepted {acc:.0f}) — the draft should "
+                  "land at least some exact matches", file=sys.stderr)
+            return 1
+        print(f"selftest speculative: {prop:.0f} proposed = {acc:.0f} "
+              f"accepted + {rb:.0f} rolled back (rate {acc / prop:.1%})",
+              file=sys.stderr)
     print(
         f"selftest OK: {len(done)} requests bit-identical to offline "
         f"greedy decode ({engine.pool.total_allocated} block allocations, "

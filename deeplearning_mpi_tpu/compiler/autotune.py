@@ -25,6 +25,7 @@ and median-of-repeats timing. Same machine, same DB.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import statistics
@@ -40,17 +41,26 @@ from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
 __all__ = [
     "ATTENTION_BLOCK_CANDIDATES",
     "DECODE_BLOCK_CANDIDATES",
+    "SPEC_K_CANDIDATES",
     "STEP_REMAT_CANDIDATES",
     "TuningDB",
+    "decode_bucket_key",
     "default_db",
+    "expected_tokens_per_step",
+    "pow2_bucket",
     "set_default_db",
+    "spec_k_key",
     "step_candidates",
     "step_tuning_key",
+    "tune_decode_buckets",
     "tune_flash_attention",
     "tune_flash_decode",
+    "tune_spec_k",
     "tune_step_schedule",
     "tuned_attention_blocks",
+    "tuned_decode_bucket",
     "tuned_decode_schedule",
+    "tuned_spec_k",
     "tuned_step_schedule",
     "tuning_key",
 ]
@@ -64,6 +74,9 @@ ENV_DB = "DMT_TUNING_DB"
 ATTENTION_BLOCK_CANDIDATES = (1024, 512, 256, 128)
 #: Default search space for the flash-decode KV block.
 DECODE_BLOCK_CANDIDATES = (2048, 1024, 512, 256)
+#: Default search space for the speculative proposal depth (0 = plain
+#: decode; always a candidate so a hostile draft can lose to no-draft).
+SPEC_K_CANDIDATES = (0, 1, 2, 4)
 
 
 def tuning_key(
@@ -311,6 +324,128 @@ def tuned_decode_schedule(
     return params
 
 
+# -- decode (batch, context) buckets ------------------------------------------
+
+def pow2_bucket(n: int, cap: int | None = None) -> int:
+    """Round ``n`` up to the next power of two, clamped to ``cap``. The
+    canonical bucketing for live decode (batch, context) values: a serving
+    step's exact batch/fill pair almost never recurs, but its bucket does,
+    so per-bucket entries get consulted instead of missing forever."""
+    n = max(int(n), 1)
+    b = 1
+    while b < n:
+        b *= 2
+    if cap is not None:
+        b = min(b, int(cap))
+    return b
+
+
+def _pow2_buckets(limit: int) -> tuple[int, ...]:
+    """Every value :func:`pow2_bucket` can emit under ``cap=limit`` — the
+    default enumeration the bucket tuner sweeps."""
+    out = []
+    b = 1
+    while b < limit:
+        out.append(b)
+        b *= 2
+    out.append(int(limit))
+    return tuple(out)
+
+
+def decode_bucket_key(
+    batch_bucket: int,
+    context_bucket: int,
+    shape: tuple[int, ...],
+    dtype: Any,
+    backend: str | None = None,
+) -> str:
+    """Key for one decode (batch, context) bucket over a ``[S, L, Hkv, D]``
+    gathered-pool shape:
+    ``decode_bucket|b<batch>xc<context>|<dims>|<dtype>|<backend>``.
+
+    The plain ``flash_decode`` entry keys on the buffer shape alone, which
+    collapses every live condition a serving step can be in to ONE
+    schedule; the bucket key space splits it by how many slots are live
+    and how deep they are — the two variables the kernel-vs-einsum
+    crossover actually moves with.
+    """
+    backend = backend or jax.default_backend()
+    dims = "x".join(str(int(s)) for s in shape)
+    return (
+        f"decode_bucket|b{int(batch_bucket)}xc{int(context_bucket)}|"
+        f"{dims}|{jnp.dtype(dtype).name}|{backend}"
+    )
+
+
+def tuned_decode_bucket(
+    batch: int, context: int, shape: tuple[int, ...], dtype: Any
+) -> dict[str, Any] | None:
+    """The tuned decode schedule for LIVE (batch, context) values — both
+    bucketed here, batch capped at the slot count and context at the
+    gathered length — or None when untuned. Never raises (call-site
+    consult: the serving hot loop hits this every step)."""
+    try:
+        db = default_db()
+        if db is None:
+            return None
+        bb = pow2_bucket(batch, cap=int(shape[0]))
+        cb = pow2_bucket(context, cap=int(shape[1]))
+        params = db.lookup_key(decode_bucket_key(bb, cb, tuple(shape), dtype))
+        if not params or params.get("schedule") not in ("kernel", "einsum"):
+            return None
+        return params
+    except Exception:
+        return None
+
+
+# -- speculative proposal depth -----------------------------------------------
+
+def spec_k_key(
+    config: Any, draft_layers: int, dtype: Any, backend: str | None = None
+) -> str:
+    """Key for a tuned speculative depth:
+    ``spec_k|<layers>x<heads>x<head_dim>x<d_model>|draft<N>|<dtype>|<backend>``.
+    The winner depends on the target/draft cost ratio and the acceptance
+    rate — all functions of the two architectures, so the key carries the
+    target dims and the draft depth."""
+    backend = backend or jax.default_backend()
+    dims = (
+        f"{config.num_layers}x{config.num_heads}x{config.head_dim}"
+        f"x{config.d_model}"
+    )
+    return f"spec_k|{dims}|draft{int(draft_layers)}|{jnp.dtype(dtype).name}|{backend}"
+
+
+def tuned_spec_k(
+    config: Any, draft_layers: int, dtype: Any
+) -> dict[str, Any] | None:
+    """The tuned ``{"spec_k": int, "accept_rate": float}`` for this
+    target/draft pair, or None when untuned — never raises."""
+    try:
+        db = default_db()
+        if db is None:
+            return None
+        params = db.lookup_key(spec_k_key(config, draft_layers, dtype))
+        if not params or not isinstance(params.get("spec_k"), int):
+            return None
+        return params
+    except Exception:
+        return None
+
+
+def expected_tokens_per_step(accept_rate: float, k: int) -> float:
+    """Expected emitted tokens per verify step under per-proposal
+    acceptance probability ``a``: ``E = (1 - a^(k+1)) / (1 - a)`` (the
+    truncated geometric series — each extra proposal only pays off if the
+    whole prefix before it matched). The analytic half of the spec-k
+    tradeoff; :func:`tune_spec_k` measures the other half (draft + verify
+    step costs) empirically."""
+    a = min(max(float(accept_rate), 0.0), 1.0)
+    if a >= 1.0:
+        return float(k + 1)
+    return (1.0 - a ** (k + 1)) / (1.0 - a)
+
+
 # -- measurement -------------------------------------------------------------
 
 def measure(
@@ -504,6 +639,224 @@ def tune_flash_decode(
             best_seconds=best["seconds"], candidates=results,
         )
     return params
+
+
+def tune_decode_buckets(
+    shape: tuple[int, int, int, int],
+    dtype: Any = jnp.float32,
+    *,
+    heads: int | None = None,
+    db: TuningDB | None = None,
+    batch_buckets: tuple[int, ...] | None = None,
+    context_buckets: tuple[int, ...] | None = None,
+    blocks: tuple[int, ...] | None = None,
+    repeats: int = 3,
+    interpret: bool | None = None,
+) -> dict[str, dict[str, Any]]:
+    """Search the decode schedule PER (batch, context) bucket for one
+    ``[S, L, Hkv, D]`` gathered-pool shape.
+
+    :func:`tune_flash_decode` answers "what schedule for this buffer?"
+    once; a serving engine's buffer shape never changes, but its live
+    conditions do — 2 slots at depth 100 and 32 slots at depth 4000 want
+    different schedules. For every (batch bucket, context bucket) pair
+    this synthesizes the matching live condition on the SAME full-shape
+    buffers (the first ``bb`` rows filled to a spread just under ``cb``,
+    the rest inactive with index −1, exactly how the engine marks empty
+    slots), then runs the einsum-oracle-first schedule search and records
+    the winner under its :func:`decode_bucket_key`. Returns
+    ``{key: params}`` for every bucket tuned.
+    """
+    from deeplearning_mpi_tpu.ops.attention import batched_decode_attention
+    from deeplearning_mpi_tpu.ops.pallas.flash_decode import (
+        decode_block_fits,
+        flash_decode,
+    )
+
+    batch, length, kv_heads, head_dim = shape
+    heads = heads or kv_heads
+    batch_buckets = tuple(batch_buckets or _pow2_buckets(batch))
+    context_buckets = tuple(context_buckets or _pow2_buckets(length))
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, (batch, 1, heads, head_dim), dtype)
+    k_buf = jax.random.normal(kk, shape, dtype)
+    v_buf = jax.random.normal(kv, shape, dtype)
+
+    einsum_fn = jax.jit(
+        lambda q, k_buf, v_buf, index: batched_decode_attention(
+            q, k_buf, v_buf, index, use_kernel=False
+        )
+    )
+
+    tuned: dict[str, dict[str, Any]] = {}
+    for bb in batch_buckets:
+        bb = min(int(bb), batch)
+        for cb in context_buckets:
+            cb = min(int(cb), length)
+            # Live rows spread over [cb/2, cb) — the engine's continuous-
+            # batching regime for this bucket; idle rows are index -1.
+            index = jnp.asarray(
+                [
+                    cb - 1 - (i * (cb // 2)) // max(bb - 1, 1)
+                    if i < bb else -1
+                    for i in range(batch)
+                ],
+                jnp.int32,
+            )
+            oracle = einsum_fn(q, k_buf, v_buf, index)
+            results = [{
+                "schedule": "einsum", "block": None,
+                "seconds": measure(einsum_fn, q, k_buf, v_buf, index,
+                                   repeats=repeats),
+            }]
+            best = results[0]
+            seen: set[int] = set()
+            for want in sorted(
+                set(blocks or DECODE_BLOCK_CANDIDATES), reverse=True
+            ):
+                fitted = decode_block_fits(want, length)
+                if fitted is None or fitted in seen:
+                    continue
+                seen.add(fitted)
+                fn = jax.jit(
+                    lambda q, k_buf, v_buf, index, b=fitted: jnp.where(
+                        (index >= 0)[:, None, None, None],
+                        flash_decode(
+                            q, k_buf, v_buf, jnp.maximum(index, 0),
+                            block=b, interpret=interpret,
+                        ),
+                        0.0,
+                    )
+                )
+                if not _allclose(fn(q, k_buf, v_buf, index), oracle, dtype):
+                    results.append(
+                        {"schedule": "kernel", "block": fitted,
+                         "rejected": "numerics"}
+                    )
+                    continue
+                secs = measure(fn, q, k_buf, v_buf, index, repeats=repeats)
+                entry = {"schedule": "kernel", "block": fitted,
+                         "seconds": secs}
+                results.append(entry)
+                if secs < best["seconds"]:
+                    best = entry
+            params = {"schedule": best["schedule"], "block": best["block"]}
+            key = decode_bucket_key(bb, cb, shape, dtype)
+            if db is not None:
+                db.record_key(
+                    key, params,
+                    best_seconds=best["seconds"], candidates=results,
+                    kernel="decode_bucket",
+                    shape=[int(s) for s in shape],
+                    batch_bucket=bb, context_bucket=cb,
+                    dtype=jnp.dtype(dtype).name,
+                    backend=jax.default_backend(),
+                )
+            tuned[key] = params
+    return tuned
+
+
+# -- speculative depth search -------------------------------------------------
+
+def tune_spec_k(
+    config: Any = None,
+    *,
+    draft_layers: int = 1,
+    dtype: Any = jnp.float32,
+    db: TuningDB | None = None,
+    candidates: tuple[int, ...] | None = None,
+    num_requests: int = 6,
+    prompt_len: int = 8,
+    max_new_tokens: int = 16,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Search the speculative proposal depth for one target/draft pair.
+
+    Analytic models of speculative decoding need the acceptance rate —
+    which is a property of the two REAL models on REAL token streams, not
+    something to assume. So this tuner measures end to end: for each
+    candidate ``k`` (0 = plain decode, always in the field) it builds a
+    serving engine with the self-draft (the target's first
+    ``draft_layers`` layers via ``truncate_lm_params``), replays the same
+    deterministic request set, and scores emitted tokens per wall-second.
+    The per-``k`` measured acceptance rate rides along in the candidate
+    record, and the winner (with its acceptance rate) is persisted under
+    :func:`spec_k_key`. Greedy parity makes every candidate emit
+    identical streams, so this is a pure throughput race.
+    """
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.transformer import (
+        draft_config,
+        truncate_lm_params,
+    )
+    from deeplearning_mpi_tpu.serving import EngineConfig, ServingEngine
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+    cfg = config or TransformerConfig.tiny()
+    model = TransformerLM(config=cfg, dtype=dtype)
+    params = model.init(
+        jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    d_cfg = draft_config(cfg, draft_layers)
+    d_params = truncate_lm_params(params, draft_layers)
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+        for _ in range(num_requests)
+    ]
+    max_k = max(candidates or SPEC_K_CANDIDATES)
+    base = EngineConfig(
+        max_slots=max(num_requests // 2, 1), block_size=8,
+        num_blocks=4 * num_requests * ((prompt_len + max_new_tokens) // 8 + 2),
+        max_blocks_per_seq=(prompt_len + max_new_tokens + max_k) // 8 + 2,
+        prefill_chunk=8,
+    )
+
+    results: list[dict[str, Any]] = []
+    best: dict[str, Any] | None = None
+    for k in sorted(set(candidates or SPEC_K_CANDIDATES)):
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg, params,
+            dataclasses.replace(base, spec_k=k),
+            dtype=dtype, registry=registry,
+            draft_config=d_cfg if k else None,
+            draft_params=d_params if k else None,
+        )
+        for p in prompts:
+            engine.submit(p, max_new_tokens)
+        # Absorb compiles outside the timed window: one step compiles
+        # prefill, and the requests finish over the remaining steps.
+        engine.step()
+        t0 = time.perf_counter()
+        finished = engine.run_until_idle()
+        wall = time.perf_counter() - t0
+        tokens = sum(len(r.generated) for r in finished)
+        snap = registry.snapshot()
+        proposed = snap.get("spec_proposed_total", 0)
+        accepted = snap.get("spec_accepted_total", 0)
+        entry = {
+            "spec_k": int(k),
+            "tokens_per_s": tokens / wall if wall > 0 else 0.0,
+            "seconds": wall,
+            "accept_rate": accepted / proposed if proposed else None,
+        }
+        results.append(entry)
+        if best is None or entry["tokens_per_s"] > best["tokens_per_s"]:
+            best = entry
+    params_out = {
+        "spec_k": best["spec_k"], "accept_rate": best["accept_rate"],
+    }
+    if db is not None:
+        db.record_key(
+            spec_k_key(cfg, draft_layers, dtype), params_out,
+            best_seconds=best["seconds"], candidates=results,
+            kernel="spec_k", draft_layers=int(draft_layers),
+            dtype=jnp.dtype(dtype).name, backend=jax.default_backend(),
+        )
+    return params_out
 
 
 # -- whole-step schedule ------------------------------------------------------

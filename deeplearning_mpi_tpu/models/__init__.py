@@ -35,6 +35,8 @@ from deeplearning_mpi_tpu.models.moe import (  # noqa: F401
 from deeplearning_mpi_tpu.models.transformer import (  # noqa: F401
     TransformerConfig,
     TransformerLM,
+    draft_config,
+    truncate_lm_params,
 )
 from deeplearning_mpi_tpu.models.unet import UNet  # noqa: F401
 from deeplearning_mpi_tpu.models.vit import ViT, vit_small, vit_tiny  # noqa: F401

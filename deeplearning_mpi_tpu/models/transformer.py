@@ -472,6 +472,49 @@ class TransformerConfig:
         return dataclasses.replace(TransformerConfig.tiny(), moe_experts=num_experts)
 
 
+def draft_config(
+    config: "TransformerConfig", num_layers: int, **overrides: Any
+) -> "TransformerConfig":
+    """A draft-model config derived from a target's: same vocab (the one
+    hard requirement of speculative decoding — draft and target must share
+    a tokenizer), fewer layers, any width knobs overridable. The default
+    (depth-only truncation) pairs with :func:`truncate_lm_params` to make
+    a zero-training "self-draft" from the target's own weights."""
+    if not 1 <= num_layers <= config.num_layers:
+        raise ValueError(
+            f"draft num_layers must be in [1, {config.num_layers}], "
+            f"got {num_layers}"
+        )
+    if config.moe_experts > 0:
+        raise ValueError("draft models must be dense (no MoE)")
+    return dataclasses.replace(config, num_layers=num_layers, **overrides)
+
+
+def truncate_lm_params(params: Any, num_layers: int) -> Any:
+    """Self-draft params: the target's embedding, first ``num_layers``
+    blocks, final norm, and (untied) LM head, referenced — not copied —
+    from the target tree.
+
+    Layer truncation is the cheapest useful draft: early blocks carry most
+    of next-token prediction for easy continuations, the tied embedding
+    doubles as the draft's output head ("logit reuse" — draft and target
+    argmax over the SAME output geometry, which is what makes a truncated
+    draft agree with its target far more often than an independently
+    initialized model of the same size), and no extra training or storage
+    is needed. The exact-greedy-match verify step makes draft quality a
+    throughput knob, never a correctness one. Use with
+    :func:`draft_config`'s depth-only truncation — width overrides need
+    independently shaped (and trained) draft weights."""
+    keep = {"embed", "final_norm"} | {f"layer_{i}" for i in range(num_layers)}
+    if f"layer_{num_layers - 1}" not in params:
+        raise ValueError(
+            f"target params hold fewer than {num_layers} layers"
+        )
+    if "lm_head" in params:
+        keep.add("lm_head")
+    return {k: params[k] for k in params if k in keep}
+
+
 def _remat_block(policy: bool | str) -> type[nn.Module]:
     """Resolve a remat policy name to the (possibly wrapped) Block class."""
     if isinstance(policy, str):

@@ -6,7 +6,11 @@ Entry points: :class:`~deeplearning_mpi_tpu.serving.engine.ServingEngine`
 is ``deeplearning_mpi_tpu.cli.serve_lm``. Design doc: ``docs/SERVING.md``.
 """
 
-from deeplearning_mpi_tpu.serving.engine import EngineConfig, ServingEngine
+from deeplearning_mpi_tpu.serving.engine import (
+    EngineConfig,
+    PagedForward,
+    ServingEngine,
+)
 from deeplearning_mpi_tpu.serving.kv_pool import (
     SCRATCH_BLOCK,
     PagedKVPool,
@@ -17,14 +21,17 @@ from deeplearning_mpi_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from deeplearning_mpi_tpu.serving.speculative import SpeculativeDecoder
 
 __all__ = [
     "EngineConfig",
+    "PagedForward",
     "PagedKVPool",
     "Request",
     "RequestState",
     "SCRATCH_BLOCK",
     "Scheduler",
     "ServingEngine",
+    "SpeculativeDecoder",
     "init_kv_buffers",
 ]

@@ -1,33 +1,47 @@
-"""Serving engine: one fixed-shape jitted step, host-swapped sequences.
+"""Serving engine: fixed-shape jitted steps, host-swapped sequences.
 
 The offline path (``models/generate``) compiles one program per batch whose
 cache is sized ``prompt + max_new`` and whose rows march in lockstep. A
 serving engine inverts every one of those assumptions: requests arrive and
-finish independently, so the engine compiles TWO programs once — a batched
-decode step over ``max_slots`` rows and a per-slot prefill chunk — and a
-host-side loop swaps finished sequences out of slots between steps. Every
-jitted shape is static (slot count, gathered KV length, chunk width), so
-admission, completion, and eviction never trigger recompilation; the only
-thing that changes step to step is the *contents* of the slot-indexed
-arrays (block tables, fill levels, last tokens, active mask).
+finish independently, so the engine compiles a small fixed set of programs
+once — a batched decode step over ``max_slots`` rows, a per-slot prefill
+chunk, and (with speculative decoding on) a batched multi-token verify
+step — and a host-side loop swaps finished sequences out of slots between
+steps. Every jitted shape is static (slot count, gathered KV length, chunk
+width, speculation width), so admission, completion, and eviction never
+trigger recompilation; the only thing that changes step to step is the
+*contents* of the slot-indexed arrays (block tables, fill levels, last
+tokens, active mask).
 
 Layer map (see ``docs/SERVING.md`` for the full walkthrough):
 
 - :mod:`~deeplearning_mpi_tpu.serving.kv_pool` owns block accounting and
   the ``[num_layers, num_blocks, block_size, Hkv, D]`` device pools;
 - :mod:`~deeplearning_mpi_tpu.serving.scheduler` owns policy (admission,
-  deadlines, oldest-first eviction under KV pressure);
-- this module owns compute: the decode step scatters each slot's new K/V
-  through its block table (inactive slots write to the scratch block),
-  gathers each slot's pages back into a ``[S, L, Hkv, D]`` view, and runs
+  deadlines, oldest-first eviction under KV pressure, bucketed decode-batch
+  formation);
+- :mod:`~deeplearning_mpi_tpu.serving.speculative` owns the draft model:
+  its own (smaller) KV pools written through the SAME block tables, so one
+  allocation serves both models;
+- this module owns target-model compute, factored into
+  :class:`PagedForward` so the draft model reuses the identical programs at
+  its own dimensions. The decode step scatters each slot's new K/V through
+  its block table (inactive slots write to the scratch block), gathers each
+  slot's pages back into a ``[S, L, Hkv, D]`` view, and runs
   :func:`~deeplearning_mpi_tpu.ops.attention.batched_decode_attention` —
-  the per-row-fill-level twin of the CLI's decode schedule, kernel-
-  dispatchable to ``ops.pallas.flash_decode`` (which takes the ``[B]``
-  index vector natively). Prefill is chunked: each PREFILL slot advances
-  one ``prefill_chunk``-wide causal forward per engine step
-  (``dense_attention`` with ``q_offset`` over the gathered pages), so a
-  long prompt cannot stall decode for every other slot — the continuous-
-  batching half of chunked prefill.
+  kernel-dispatchable to ``ops.pallas.flash_decode``, with the
+  kernel-vs-einsum choice resolvable per (batch, context) bucket through
+  the autotuner DB (``compiler.autotune.tuned_decode_bucket``). Prefill is
+  chunked: each PREFILL slot advances one ``prefill_chunk``-wide causal
+  forward per engine step, so a long prompt cannot stall decode for every
+  other slot. The verify step is a width-``spec_k + 1`` extension of the
+  prefill chunk, batched over slots with PER-ROW query offsets: row ``s``
+  feeds its last known token plus ``spec_k`` draft proposals at absolute
+  positions ``lengths[s]-1 ..``, and the returned argmaxes are the target
+  model's greedy continuation at every one of those positions — accepting
+  the longest proposal prefix that matches them is what keeps speculative
+  output bit-identical to offline greedy decode regardless of draft
+  quality.
 
 The forward mirrors ``models.transformer.TransformerLM`` numerics exactly
 (dtype-cast matmuls on f32 params, f32 norm/softmax accumulation, tied or
@@ -36,7 +50,7 @@ the flax ``Attention`` cache carries ONE scalar ``cache_index`` for the
 whole batch — the lockstep assumption this engine exists to break — so the
 cached-attention module cannot express per-slot fill levels. Parity with
 the offline path is pinned by ``tests/test_serving.py`` (greedy outputs
-identical per request).
+identical per request, speculative and plain).
 
 Greedy-only, dense models only: MoE routing makes a token's output depend
 on which OTHER tokens share its batch (capacity contention), which would
@@ -47,6 +61,7 @@ must never change your completion.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Callable, Optional
 
@@ -60,6 +75,7 @@ from deeplearning_mpi_tpu.models.transformer import (
     apply_rope,
 )
 from deeplearning_mpi_tpu.ops.attention import (
+    NEG_INF,
     batched_decode_attention,
     dense_attention,
     repeat_kv,
@@ -75,12 +91,12 @@ from deeplearning_mpi_tpu.serving.scheduler import (
     Scheduler,
 )
 
-__all__ = ["EngineConfig", "ServingEngine"]
+__all__ = ["EngineConfig", "PagedForward", "ServingEngine"]
 
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
-    """Static shape/policy knobs — all of them baked into the two compiled
+    """Static shape/policy knobs — all of them baked into the compiled
     programs, none of them changeable without a (deliberate) recompile."""
 
     #: decode rows per jitted step; also the number of concurrent sequences
@@ -98,407 +114,62 @@ class EngineConfig:
     max_queue: int = 64
     #: dispatch batched decode attention to the Pallas flash_decode kernel
     #: (which consumes the per-row index vector natively); False = the
-    #: dense einsum schedule; None = consult the autotuner's tuning DB for
-    #: the gathered-buffer shape (``compiler/autotune.py`` — a recorded
-    #: ``flash_decode`` winner picks the schedule and block, untuned shapes
-    #: keep the einsum)
+    #: dense einsum schedule; None = consult the autotuner's tuning DB —
+    #: first the per-(batch, context)-bucket ``decode_bucket|...`` entries
+    #: for this step's live bucket, then the single gathered-buffer
+    #: ``flash_decode`` entry (``compiler/autotune.py``); untuned shapes
+    #: keep the einsum
     use_kernel: bool | None = False
+    #: draft proposals verified per sequence per engine step (0 = plain
+    #: decode). With ``spec_k > 0`` the engine needs a draft model
+    #: (``ServingEngine(draft_config=..., draft_params=...)``) and every
+    #: decode iteration becomes one draft propose loop + ONE jitted verify
+    #: step emitting up to ``spec_k + 1`` tokens per sequence.
+    spec_k: int = 0
+    #: decode-batch formation buckets (ascending, e.g. ``(8, 16, 32)``):
+    #: the scheduler HOLDS the decode phase for up to ``max_hold_steps``
+    #: engine steps while queued/prefilling supply could still grow the
+    #: decode batch toward the next bucket — so batches of 8-32 actually
+    #: form under load instead of trickling in at 1-4. Empty = decode
+    #: every step (the pre-bucketing behavior). Holding only delays
+    #: decode, so completions stay bit-identical.
+    decode_buckets: tuple[int, ...] = ()
+    #: hold budget (engine steps) for decode-batch formation; the budget
+    #: resets every time a decode step actually runs, so decode is never
+    #: deferred more than this many consecutive steps
+    max_hold_steps: int = 4
 
     @property
     def max_seq_len(self) -> int:
         return self.max_blocks_per_seq * self.block_size
 
 
-class ServingEngine:
-    """Continuous-batching engine over a ``TransformerLM`` param tree.
+class PagedForward:
+    """``TransformerLM`` numerics over paged KV block tables.
 
-    ``clock`` is injectable (tests drive a fake one); ``registry`` is an
-    optional ``telemetry.MetricsRegistry`` the engine keeps live serving
-    instruments in (queue depth, slot occupancy, KV blocks in use, shed
-    count, TTFT/TPOT histograms).
+    One instance per model: the engine builds one for the target and
+    ``serving.speculative.SpeculativeDecoder`` builds one for the draft —
+    same programs, same block geometry (``engine.block_size`` /
+    ``max_blocks_per_seq``), different model dims and KV pools. ``tick``
+    is called at TRACE time of every program (the engine wires it to the
+    ``serve_compile_total`` counter so "zero compiles on the first
+    request" stays an assertable counter delta).
     """
 
     def __init__(
         self,
         config: TransformerConfig,
-        params: Any,
-        engine: EngineConfig | None = None,
+        engine: EngineConfig,
+        dtype: Any,
         *,
-        dtype: Any = jnp.bfloat16,
-        eos_id: Optional[int] = None,
-        clock: Callable[[], float] = time.monotonic,
-        registry: Any = None,
-        chaos: Any = None,
+        tick: Callable[[], None] | None = None,
     ) -> None:
-        engine = engine or EngineConfig()
-        if config.moe_experts > 0:
-            raise NotImplementedError(
-                "serving engine is dense-MLP only: MoE capacity routing "
-                "makes a token's output depend on co-batched strangers, "
-                "which breaks the engine's request-independence contract"
-            )
-        if "kernel" not in params["layer_0"]["attn"]["q_proj"]:
-            raise NotImplementedError(
-                "serving engine takes the raw f32 param tree (quantized "
-                "trees from ops.quant are not supported)"
-            )
-        if engine.num_blocks - 1 < engine.max_blocks_per_seq:
-            raise ValueError(
-                f"pool capacity ({engine.num_blocks - 1} blocks) below "
-                f"max_blocks_per_seq ({engine.max_blocks_per_seq}): a "
-                "maximum-length request could never be admitted"
-            )
         self.config = config
         self.engine = engine
-        self.params = params
         self.dtype = dtype
-        self.eos_id = eos_id
-        self._clock = clock
-        self.chaos = chaos
-        self.pool = PagedKVPool(engine.num_blocks, engine.block_size)
-        self.scheduler = Scheduler(
-            self.pool,
-            max_slots=engine.max_slots,
-            max_seq_len=engine.max_seq_len,
-            max_queue=engine.max_queue,
-            registry=registry,
-        )
-        self._k, self._v = init_kv_buffers(
-            config.num_layers, engine.num_blocks, engine.block_size,
-            config.num_kv_heads or config.num_heads, config.head_dim, dtype,
-        )
-        self._next_rid = 0
-        self.steps = 0
-        self._metrics = registry
-        if registry is not None:
-            for name in (
-                "serve_requests_submitted", "serve_requests_admitted",
-                "serve_requests_completed", "serve_requests_shed",
-                "serve_tokens_generated", "serve_prefill_chunks",
-                "serve_decode_steps", "serve_requeued_total",
-                "serve_tokens_discarded_total",
-            ):
-                registry.counter(name)
-            for name in (
-                "serve_queue_depth", "serve_slots_active",
-                "serve_kv_blocks_in_use",
-            ):
-                registry.gauge(name)
-            registry.histogram("serve_ttft_s")
-            registry.histogram("serve_tpot_s")
-            registry.histogram("serve_compile_seconds")
-            registry.counter("serve_compile_total")
-        # KV-cache donation, vetoed where unsafe (XLA:CPU + persistent
-        # compile cache — compiler.cache.donation_safe, reached through the
-        # compat shim): the engine restores weights from disk and then runs
-        # these jitted steps, the exact restore-then-execute sequence that
-        # corrupts the heap with donated cache-deserialized executables.
-        kv_donate = (1, 2) if buffer_donation_supported() else ()
-        self._decode_jit = jax.jit(self._decode_step, donate_argnums=kv_donate)
-        self._prefill_jit = jax.jit(self._prefill_chunk, donate_argnums=kv_donate)
-        # Lazily-compiling entry points until warmup() swaps in the AOT
-        # executables; the wrappers record first-call (= compile) wall time
-        # into serve_compile_seconds.
-        self._decode_fn = self._timed_first_call(self._decode_jit)
-        self._prefill_fn = self._timed_first_call(self._prefill_jit)
+        self._tick = tick or (lambda: None)
 
-    def _timed_first_call(self, jitted: Callable[..., Any]) -> Callable[..., Any]:
-        """Wrap a jitted program so its first dispatch — the one that pays
-        tracing + XLA compilation — lands in ``serve_compile_seconds``. A
-        warmed engine replaces this wrapper entirely, so the histogram then
-        holds warmup's compile times instead."""
-        state = {"first": True}
-
-        def call(*args: Any) -> Any:
-            if not state["first"]:
-                return jitted(*args)
-            state["first"] = False
-            t0 = time.perf_counter()
-            out = jitted(*args)
-            if self._metrics is not None:
-                self._metrics.histogram("serve_compile_seconds").observe(
-                    time.perf_counter() - t0
-                )
-            return out
-
-        return call
-
-    def warmup(self, *, cache: Any = None) -> dict[str, Any]:
-        """AOT-compile both serving programs before traffic.
-
-        Lowers and compiles the batched decode step and the chunked-prefill
-        program at their exact serving shapes (every jitted shape is static
-        by design — see the module docstring — so warmup's avals are the
-        only avals the engine will ever call with), then swaps the compiled
-        executables into the hot path wrapped in
-        :class:`~deeplearning_mpi_tpu.compiler.aot.WarmProgram`. A compiled
-        executable never retraces, so a warmed engine performs ZERO
-        compiles on its first request — asserted by the
-        ``serve_compile_total`` trace counter in ``tests/test_compiler.py``
-        and the ``tools/autotune.py --selftest`` acceptance check.
-
-        ``cache`` is an optional
-        :class:`~deeplearning_mpi_tpu.compiler.cache.CompileCache`; under a
-        persistent cache directory a restarted engine's warmup
-        deserializes instead of compiling (``compile_cache_hit_total``).
-        Compile wall time lands in ``serve_compile_seconds``. Returns the
-        compiled programs by name.
-        """
-        from deeplearning_mpi_tpu.compiler import aot
-
-        e = self.engine
-        reg = aot.WarmupRegistry(registry=self._metrics, cache=cache)
-        slots_i32 = jnp.zeros((e.max_slots,), jnp.int32)
-        reg.register(
-            "serve_decode_step", self._decode_jit,
-            self.params, self._k, self._v,
-            jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
-            slots_i32, slots_i32, jnp.zeros((e.max_slots,), bool),
-        )
-        reg.register(
-            "serve_prefill_chunk", self._prefill_jit,
-            self.params, self._k, self._v,
-            jnp.zeros((e.max_blocks_per_seq,), jnp.int32),
-            jnp.zeros((e.prefill_chunk,), jnp.int32),
-            jnp.int32(0), jnp.int32(1),
-        )
-        programs = reg.warm_all()
-        if self._metrics is not None:
-            for prog in programs.values():
-                self._metrics.histogram("serve_compile_seconds").observe(
-                    prog.lower_seconds + prog.compile_seconds
-                )
-        self._decode_fn = aot.WarmProgram(
-            programs["serve_decode_step"], self._decode_jit
-        )
-        self._prefill_fn = aot.WarmProgram(
-            programs["serve_prefill_chunk"], self._prefill_jit
-        )
-        return programs
-
-    # -- public API ---------------------------------------------------------
-    def submit(
-        self,
-        prompt: Any,
-        max_new_tokens: int,
-        *,
-        deadline: Optional[float] = None,
-    ) -> Request:
-        """Enqueue one request (or shed it at the door — check
-        ``req.state``). ``prompt`` is a 1-D int sequence."""
-        if max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {max_new_tokens}"
-            )
-        req = Request(
-            rid=self._next_rid,
-            prompt=np.asarray(prompt, np.int32).reshape(-1),
-            max_new_tokens=max_new_tokens,
-            arrival=self._clock(),
-            deadline=deadline,
-        )
-        self._next_rid += 1
-        self._inc("serve_requests_submitted")
-        if not self.scheduler.submit(req):
-            self._inc("serve_requests_shed")
-        return req
-
-    def step(self) -> list[Request]:
-        """One engine iteration: shed expired → admit → one prefill chunk
-        per PREFILL slot → grow/evict for KV pressure → one batched decode
-        step → retire finished sequences. Returns the requests that
-        FINISHED this step (their freed blocks are already back in the
-        pool, ready for the next admission)."""
-        now = self._clock()
-        finished: list[Request] = []
-        for _ in self.scheduler.shed_expired(now):
-            self._inc("serve_requests_shed")
-        admitted = self.scheduler.admit(now)
-        self._inc("serve_requests_admitted", len(admitted))
-
-        for req in list(self.scheduler.running()):
-            if req.state is RequestState.PREFILL:
-                self._prefill_one(req, finished)
-
-        if self.chaos is not None:
-            # Mid-step, after prefill has already mutated host + device
-            # state — the nastiest crash point: admitted requests hold
-            # blocks, partial prefills sit in the KV pool, the step never
-            # completes. recover() must untangle exactly this.
-            self.chaos.check_serve_crash(step=self.steps)
-
-        # Feeding a token at position length-1 writes its K/V there, so a
-        # slot needs blocks_for(length) blocks BEFORE the step; growth is
-        # where OOM pressure surfaces and the scheduler may evict.
-        for req in list(self.scheduler.running()):
-            if req.state is not RequestState.DECODE:
-                continue
-            while len(req.blocks) < self.pool.blocks_for(req.length):
-                if not self.scheduler.grow(req):
-                    self._inc("serve_requests_shed")
-                    break
-        # grow() may have evicted requests from the snapshot above.
-        decoding = [
-            r for r in self.scheduler.running()
-            if r.state is RequestState.DECODE
-        ]
-        if decoding:
-            e = self.engine
-            tables = np.zeros((e.max_slots, e.max_blocks_per_seq), np.int32)
-            lengths = np.zeros((e.max_slots,), np.int32)
-            tokens = np.zeros((e.max_slots,), np.int32)
-            active = np.zeros((e.max_slots,), bool)
-            for req in decoding:
-                s = req.slot
-                tables[s, : len(req.blocks)] = req.blocks
-                lengths[s] = req.length
-                tokens[s] = req.generated[-1]
-                active[s] = True
-            self._k, self._v, next_tok = self._decode_fn(
-                self.params, self._k, self._v,
-                jnp.asarray(tables), jnp.asarray(lengths),
-                jnp.asarray(tokens), jnp.asarray(active),
-            )
-            self._inc("serve_decode_steps")
-            next_np = np.asarray(jax.device_get(next_tok))
-            now = self._clock()
-            for req in decoding:
-                tok = int(next_np[req.slot])
-                req.generated.append(tok)
-                self._inc("serve_tokens_generated")
-                if self._done(req, tok):
-                    self._finish(req, now, finished)
-        self.steps += 1
-        self._set_gauges()
-        return finished
-
-    def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
-        """Step until queue and slots drain; returns everything finished.
-
-        Injected crashes (:class:`~..resilience.faults.InjectedFault`) are
-        recovered in place and the loop continues — each planned fault
-        fires exactly once, so this cannot spin. Requests that FINISHED
-        during the crashed step stay finished on their own objects (the
-        step's return value was lost with the exception; callers assert on
-        request state, not on this list, for those).
-        """
-        from deeplearning_mpi_tpu.resilience.faults import InjectedFault
-
-        finished: list[Request] = []
-        steps = 0
-        while not self.scheduler.idle():
-            try:
-                finished.extend(self.step())
-            except InjectedFault as err:
-                print(f"serving: {err} — recovering")
-                self.recover()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(
-                    f"engine did not drain within {max_steps} steps"
-                )
-        return finished
-
-    def recover(self) -> dict[str, int]:
-        """Crash recovery: requeue every in-flight sequence and rebuild the
-        KV pool's free list against scheduler ground truth.
-
-        In-flight (PREFILL or DECODE) sequences restart from their prompt:
-        after a mid-step crash the engine cannot prove which KV writes
-        landed, and re-prefilling from scratch is the only state that is
-        both trustworthy and deterministic — it keeps recovered greedy
-        completions bit-identical to offline decode. Already-generated
-        tokens are discarded (counted in ``serve_tokens_discarded_total``).
-        Stale KV rows left by the crashed step are harmless once the pool
-        is reconciled: re-prefill overwrites its own pages, and recycled
-        blocks' leftover rows sit past every valid position, causally
-        masked (the same argument as normal block reuse).
-
-        Requeue order preserves FCFS: running requests (admitted earlier
-        than anything still queued) are pushed to the queue front,
-        newest-arrival first, so the front ends up oldest-first.
-        """
-        inflight = sorted(self.scheduler.running(), key=lambda r: (r.arrival, r.rid))
-        discarded = sum(len(r.generated) for r in inflight)
-        for req in reversed(inflight):
-            self.scheduler.requeue(req)
-        # No sequence owns verified blocks after requeue — free everything.
-        stats = self.pool.reconcile(())
-        self.pool.check()
-        self._inc("serve_requeued_total", len(inflight))
-        self._inc("serve_tokens_discarded_total", discarded)
-        if self.chaos is not None:
-            self.chaos.record_recovery("serve_crash")
-        self._set_gauges()
-        out = {"requeued": len(inflight), "tokens_discarded": discarded, **stats}
-        print(
-            f"serving: recovered — requeued {out['requeued']} in-flight "
-            f"request(s), reclaimed {stats['reclaimed']} KV block(s), "
-            f"discarded {discarded} token(s)"
-        )
-        return out
-
-    # -- prefill ------------------------------------------------------------
-    def _prefill_one(self, req: Request, finished: list[Request]) -> None:
-        e = self.engine
-        start = req.prefilled
-        n_valid = min(e.prefill_chunk, req.prompt_len - start)
-        chunk = np.zeros((e.prefill_chunk,), np.int32)
-        chunk[:n_valid] = req.prompt[start : start + n_valid]
-        table = np.zeros((e.max_blocks_per_seq,), np.int32)
-        table[: len(req.blocks)] = req.blocks
-        self._k, self._v, last_logits = self._prefill_fn(
-            self.params, self._k, self._v,
-            jnp.asarray(table), jnp.asarray(chunk),
-            jnp.int32(start), jnp.int32(n_valid),
-        )
-        self._inc("serve_prefill_chunks")
-        req.prefilled += n_valid
-        if req.prefilled < req.prompt_len:
-            return
-        # Prompt fully ingested: the first generated token comes straight
-        # from the prefill's last-position logits (same seed-step split as
-        # models.generate.first_token).
-        tok = int(jax.device_get(jnp.argmax(last_logits)))
-        req.state = RequestState.DECODE
-        req.generated.append(tok)
-        req.t_first_token = self._clock()
-        self._inc("serve_tokens_generated")
-        if self._metrics is not None and req.ttft is not None:
-            self._metrics.histogram("serve_ttft_s").observe(req.ttft)
-        if self._done(req, tok):
-            self._finish(req, req.t_first_token, finished)
-
-    # -- retirement ---------------------------------------------------------
-    def _done(self, req: Request, tok: int) -> bool:
-        if self.eos_id is not None and tok == self.eos_id:
-            return True
-        return len(req.generated) >= req.max_new_tokens
-
-    def _finish(self, req: Request, now: float, finished: list[Request]) -> None:
-        self.scheduler.finish(req, now)
-        finished.append(req)
-        self._inc("serve_requests_completed")
-        if self._metrics is not None and req.tpot is not None:
-            self._metrics.histogram("serve_tpot_s").observe(req.tpot)
-
-    # -- telemetry ----------------------------------------------------------
-    def _inc(self, name: str, amount: float = 1.0) -> None:
-        if self._metrics is not None and amount:
-            self._metrics.counter(name).inc(amount)
-
-    def _set_gauges(self) -> None:
-        if self._metrics is None:
-            return
-        self._metrics.gauge("serve_queue_depth").set(
-            self.scheduler.queue_depth()
-        )
-        self._metrics.gauge("serve_slots_active").set(
-            self.scheduler.slots_active()
-        )
-        self._metrics.gauge("serve_kv_blocks_in_use").set(self.pool.in_use)
-
-    # -- forward building blocks (mirror TransformerLM numerics) ------------
+    # -- building blocks (mirror TransformerLM numerics) ---------------------
     def _lin(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
         # flax nn.Dense(use_bias=False, dtype=d): both operands cast to the
         # compute dtype, f32 params untouched in the tree.
@@ -544,7 +215,7 @@ class ServingEngine:
         return x + self._lin(hidden, lp["mlp"]["down_proj"]["kernel"])
 
     # -- jitted decode step --------------------------------------------------
-    def _decode_step(
+    def decode_step(
         self,
         params: Any,
         k_pool: jax.Array,
@@ -553,14 +224,23 @@ class ServingEngine:
         lengths: jax.Array,  # [S] int32 known tokens (prompt + generated)
         tokens: jax.Array,   # [S] int32 token fed this step (position len-1)
         active: jax.Array,   # [S] bool
+        *,
+        use_kernel: bool | None = False,
+        block: int | None = None,
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
         # Host side effect at TRACE time only: one tick per compilation of
         # this program. A warmed engine calls the AOT executable directly
         # (never retraces), so "zero compiles on the first request" is an
         # assertable counter delta, not a timing heuristic.
-        self._inc("serve_compile_total")
+        self._tick()
         cfg, e = self.config, self.engine
-        S, MB, BS = e.max_slots, e.max_blocks_per_seq, e.block_size
+        S, BS = e.max_slots, e.block_size
+        # Static gather width from the TABLE shape, not the engine ceiling:
+        # the host slices the block tables to this step's live bucket
+        # (ServingEngine._gather_width), so a batch of shallow sequences
+        # streams O(bucket) KV per layer instead of always paying the full
+        # max_blocks_per_seq-wide gather. One compile per distinct width.
+        MB = tables.shape[1]
         L = MB * BS
         kv_heads = cfg.num_kv_heads or cfg.num_heads
         emb = params["embed"]["embedding"]
@@ -591,7 +271,8 @@ class ServingEngine:
             v_seq = v_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim)
             ctx = batched_decode_attention(
                 q, k_seq, v_seq, idx, window=window,
-                use_kernel=e.use_kernel,
+                use_kernel=use_kernel,
+                **({"block": block} if block else {}),
             )
             x = x + self._lin(
                 ctx.reshape(S, 1, cfg.num_heads * cfg.head_dim),
@@ -603,7 +284,7 @@ class ServingEngine:
         return k_pool, v_pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # -- jitted prefill chunk ------------------------------------------------
-    def _prefill_chunk(
+    def prefill_chunk(
         self,
         params: Any,
         k_pool: jax.Array,
@@ -613,8 +294,8 @@ class ServingEngine:
         start: jax.Array,   # scalar int32: absolute position of tokens[0]
         n_valid: jax.Array,  # scalar int32: real rows in the chunk
     ) -> tuple[jax.Array, jax.Array, jax.Array]:
-        # Trace-time compile tick — see _decode_step.
-        self._inc("serve_compile_total")
+        # Trace-time compile tick — see decode_step.
+        self._tick()
         cfg, e = self.config, self.engine
         MB, BS, C = e.max_blocks_per_seq, e.block_size, e.prefill_chunk
         L = MB * BS
@@ -656,3 +337,815 @@ class ServingEngine:
         # garbage that is never read and whose K/V went to scratch.
         x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
         return k_pool, v_pool, self._logits(x_last[0, 0], params)
+
+    # -- jitted verify step (speculative decoding) ---------------------------
+    def verify_step(
+        self,
+        params: Any,
+        k_pool: jax.Array,
+        v_pool: jax.Array,
+        tables: jax.Array,   # [S, MB] int32 block ids (0-padded)
+        lengths: jax.Array,  # [S] int32 known tokens before this step
+        tokens: jax.Array,   # [S, W] int32: last known token + proposals
+        n_live: jax.Array,   # [S] int32 fed rows per slot (n_prop + 1)
+        active: jax.Array,   # [S] bool
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """One batched multi-token target forward over the paged KV pools.
+
+        The width-``W = spec_k + 1`` extension of :meth:`prefill_chunk`,
+        batched over slots: row ``s`` feeds ``tokens[s, i]`` at absolute
+        position ``lengths[s] - 1 + i`` (token 0 is the slot's last known
+        token — whose K/V is still unwritten, exactly like a plain decode
+        step — tokens 1.. are the draft's proposals), scattering each
+        position's K/V through the slot's block table and attending the
+        full causal prefix of the gathered pages. The returned
+        ``argmax[s, i]`` is the target's greedy token for position
+        ``lengths[s] + i``: comparing proposals against it IS the
+        exact-greedy-match acceptance rule, and K/V written for positions
+        past the accepted prefix is garbage-by-construction that the next
+        step overwrites before it ever becomes causally visible (same
+        stale-row argument as recycled blocks; docs/SERVING.md).
+
+        Per-row query offsets rule out :func:`dense_attention` (its
+        ``q_offset`` is one scalar for the whole batch), so the causal
+        mask is built inline in absolute coordinates — the numerics
+        otherwise mirror ``dense_attention`` line for line (f32 scores,
+        f32 softmax, all-masked rows zeroed), which is what keeps the
+        verify argmaxes bit-identical to the chunked-prefill/decode path
+        the parity tests pin.
+        """
+        self._tick()
+        cfg, e = self.config, self.engine
+        S, BS = e.max_slots, e.block_size
+        # Width-bucketed gather, same as decode_step: MB is the host-sliced
+        # table width covering this verify batch's deepest row.
+        MB = tables.shape[1]
+        W = tokens.shape[1]
+        L = MB * BS
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        rep = cfg.num_heads // kv_heads
+        scale = cfg.head_dim**-0.5
+        emb = params["embed"]["embedding"]
+        x = emb.astype(self.dtype)[tokens]  # [S, W, d]
+        offs = jnp.arange(W, dtype=jnp.int32)[None]  # [1, W]
+        pos = jnp.maximum(lengths - 1, 0)[:, None] + offs  # [S, W] absolute
+        p = jnp.minimum(pos, L - 1)
+        row_valid = active[:, None] & (offs < n_live[:, None])  # [S, W]
+        bid = jnp.where(
+            row_valid,
+            jnp.take_along_axis(tables, p // BS, axis=1),
+            SCRATCH_BLOCK,
+        )
+        off = p % BS
+        k_pos = jnp.arange(L, dtype=jnp.int32)
+        # [S, 1, W, L] causal mask in absolute coordinates, per-row offsets.
+        valid = (
+            (k_pos[None, None, None, :] <= pos[:, None, :, None])
+            & row_valid[:, None, :, None]
+        )
+        window = cfg.attention_window or None
+        if window is not None:
+            valid &= pos[:, None, :, None] - k_pos[None, None, None, :] < window
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            h = self._rmsnorm(x, lp["attn_norm"]["scale"])
+            q, k, v = self._attn_proj(lp, h, pos)
+            k_pool = k_pool.at[i, bid, off].set(k)
+            v_pool = v_pool.at[i, bid, off].set(v)
+            k_seq = repeat_kv(
+                k_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim), rep
+            )
+            v_seq = repeat_kv(
+                v_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim), rep
+            )
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_seq,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            scores = jnp.where(valid, scores, NEG_INF)
+            weights = jnp.where(
+                jnp.any(valid, axis=-1)[..., None],
+                jax.nn.softmax(scores, axis=-1),
+                0.0,
+            )
+            ctx = jnp.einsum(
+                "bhqk,bkhd->bqhd", weights.astype(v_seq.dtype), v_seq,
+                preferred_element_type=jnp.float32,
+            ).astype(q.dtype)
+            x = x + self._lin(
+                ctx.reshape(S, W, cfg.num_heads * cfg.head_dim),
+                lp["attn"]["out_proj"]["kernel"],
+            )
+            x = self._mlp(lp, x)
+        x = self._rmsnorm(x, params["final_norm"]["scale"])
+        logits = self._logits(x, params)  # [S, W, V] f32
+        return k_pool, v_pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class ServingEngine:
+    """Continuous-batching engine over a ``TransformerLM`` param tree.
+
+    ``clock`` is injectable (tests drive a fake one); ``registry`` is an
+    optional ``telemetry.MetricsRegistry`` the engine keeps live serving
+    instruments in (queue depth, slot occupancy, KV blocks in use, shed
+    count, TTFT/TPOT histograms, speculative acceptance accounting).
+
+    ``draft_config``/``draft_params`` (required iff ``engine.spec_k > 0``)
+    define the draft model for speculative decoding — any dense
+    ``TransformerLM`` sharing the target's vocab; the usual choice is the
+    target's own first N layers (``models.transformer.truncate_lm_params``),
+    which reuses the target's tied embedding for the draft logits.
+    """
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        params: Any,
+        engine: EngineConfig | None = None,
+        *,
+        dtype: Any = jnp.bfloat16,
+        eos_id: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+        registry: Any = None,
+        chaos: Any = None,
+        draft_config: TransformerConfig | None = None,
+        draft_params: Any = None,
+    ) -> None:
+        engine = engine or EngineConfig()
+        if config.moe_experts > 0:
+            raise NotImplementedError(
+                "serving engine is dense-MLP only: MoE capacity routing "
+                "makes a token's output depend on co-batched strangers, "
+                "which breaks the engine's request-independence contract"
+            )
+        if "kernel" not in params["layer_0"]["attn"]["q_proj"]:
+            raise NotImplementedError(
+                "serving engine takes the raw f32 param tree (quantized "
+                "trees from ops.quant are not supported)"
+            )
+        if engine.num_blocks - 1 < engine.max_blocks_per_seq:
+            raise ValueError(
+                f"pool capacity ({engine.num_blocks - 1} blocks) below "
+                f"max_blocks_per_seq ({engine.max_blocks_per_seq}): a "
+                "maximum-length request could never be admitted"
+            )
+        if engine.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {engine.spec_k}")
+        if engine.spec_k > 0 and (draft_config is None or draft_params is None):
+            raise ValueError(
+                "spec_k > 0 needs a draft model: pass draft_config + "
+                "draft_params (models.transformer.truncate_lm_params builds "
+                "a self-draft from the target's own first N layers)"
+            )
+        self.config = config
+        self.engine = engine
+        self.params = params
+        self.dtype = dtype
+        self.eos_id = eos_id
+        self._clock = clock
+        self.chaos = chaos
+        self.pool = PagedKVPool(engine.num_blocks, engine.block_size)
+        self.scheduler = Scheduler(
+            self.pool,
+            max_slots=engine.max_slots,
+            max_seq_len=engine.max_seq_len,
+            max_queue=engine.max_queue,
+            registry=registry,
+            decode_buckets=engine.decode_buckets,
+            max_hold_steps=engine.max_hold_steps,
+        )
+        self._k, self._v = init_kv_buffers(
+            config.num_layers, engine.num_blocks, engine.block_size,
+            config.num_kv_heads or config.num_heads, config.head_dim, dtype,
+        )
+        self._next_rid = 0
+        self.steps = 0
+        self._metrics = registry
+        if registry is not None:
+            for name in (
+                "serve_requests_submitted", "serve_requests_admitted",
+                "serve_requests_completed", "serve_requests_shed",
+                "serve_tokens_generated", "serve_prefill_chunks",
+                "serve_decode_steps", "serve_requeued_total",
+                "serve_tokens_discarded_total",
+            ):
+                registry.counter(name)
+            for name in (
+                "serve_queue_depth", "serve_slots_active",
+                "serve_kv_blocks_in_use",
+            ):
+                registry.gauge(name)
+            registry.histogram("serve_ttft_s")
+            registry.histogram("serve_tpot_s")
+            registry.histogram("serve_compile_seconds")
+            registry.counter("serve_compile_total")
+            if engine.decode_buckets:
+                registry.counter("serve_decode_held_steps")
+            if engine.spec_k > 0:
+                # The reconciliation invariant every speculative run must
+                # satisfy: spec_proposed == spec_accepted + spec_rollback.
+                for name in (
+                    "spec_proposed_total", "spec_accepted_total",
+                    "spec_rollback_total", "spec_verify_steps",
+                    "spec_draft_steps", "spec_degraded_total",
+                    "spec_blocks_rolled_back_total",
+                ):
+                    registry.counter(name)
+        self._fwd = PagedForward(
+            config, engine, dtype,
+            tick=lambda: self._inc("serve_compile_total"),
+        )
+        # KV-cache donation, vetoed where unsafe (XLA:CPU + persistent
+        # compile cache — compiler.cache.donation_safe, reached through the
+        # compat shim): the engine restores weights from disk and then runs
+        # these jitted steps, the exact restore-then-execute sequence that
+        # corrupts the heap with donated cache-deserialized executables.
+        self._kv_donate = (1, 2) if buffer_donation_supported() else ()
+        self._decode_jit = jax.jit(
+            functools.partial(self._fwd.decode_step, use_kernel=engine.use_kernel),
+            donate_argnums=self._kv_donate,
+        )
+        self._prefill_jit = jax.jit(
+            self._fwd.prefill_chunk, donate_argnums=self._kv_donate
+        )
+        # Lazily-compiling entry points until warmup() swaps in the AOT
+        # executables; the wrappers record first-call (= compile) wall time
+        # into serve_compile_seconds.
+        self._decode_fn = self._timed_first_call(self._decode_jit)
+        self._prefill_fn = self._timed_first_call(self._prefill_jit)
+        #: tuned per-bucket decode variants, keyed (use_kernel, block) —
+        #: bounded by the number of distinct tuned schedules, each a
+        #: one-time compile at the same static shapes as the default.
+        self._decode_variants: dict[tuple[bool, int | None], Callable[..., Any]] = {}
+        self._spec = None
+        self._verify_fn = None
+        if engine.spec_k > 0:
+            from deeplearning_mpi_tpu.serving.speculative import (
+                SpeculativeDecoder,
+            )
+
+            self._spec = SpeculativeDecoder(
+                draft_config, draft_params,
+                target_config=config, engine=engine, dtype=dtype,
+                tick=lambda: self._inc("serve_compile_total"),
+                donate=self._kv_donate,
+            )
+            self._verify_jit = jax.jit(
+                self._fwd.verify_step, donate_argnums=self._kv_donate
+            )
+            self._verify_fn = self._timed_first_call(self._verify_jit)
+
+    def _timed_first_call(self, jitted: Callable[..., Any]) -> Callable[..., Any]:
+        """Wrap a jitted program so its first dispatch — the one that pays
+        tracing + XLA compilation — lands in ``serve_compile_seconds``. A
+        warmed engine replaces this wrapper entirely, so the histogram then
+        holds warmup's compile times instead."""
+        state = {"first": True}
+
+        def call(*args: Any) -> Any:
+            if not state["first"]:
+                return jitted(*args)
+            state["first"] = False
+            t0 = time.perf_counter()
+            out = jitted(*args)
+            if self._metrics is not None:
+                self._metrics.histogram("serve_compile_seconds").observe(
+                    time.perf_counter() - t0
+                )
+            return out
+
+        return call
+
+    def _is_base_schedule(self, tuned: dict[str, Any], width: int) -> bool:
+        """True when a tuned bucket entry names the very schedule the base
+        decode program (``use_kernel=None``) already resolved at trace time
+        for this gather width — swapping to a variant would lazily compile
+        a byte-identical duplicate, so the caller stays on the warmed base
+        program instead."""
+        from deeplearning_mpi_tpu.compiler import autotune
+
+        base = autotune.tuned_decode_schedule(
+            (
+                self.engine.max_slots, width * self.engine.block_size,
+                self.config.num_kv_heads or self.config.num_heads,
+                self.config.head_dim,
+            ),
+            self.dtype,
+        ) or {"schedule": "einsum", "block": None}
+        return (tuned["schedule"], tuned.get("block")) == (
+            base["schedule"], base.get("block")
+        )
+
+    def _decode_variant(
+        self, use_kernel: bool, block: int | None
+    ) -> Callable[..., Any]:
+        """The decode program for one tuned (schedule, block) bucket entry,
+        compiled on first use and cached — bucket dispatch swaps between a
+        handful of executables, never retraces an existing one."""
+        key = (bool(use_kernel), block)
+        fn = self._decode_variants.get(key)
+        if fn is None:
+            jitted = jax.jit(
+                functools.partial(
+                    self._fwd.decode_step, use_kernel=use_kernel, block=block
+                ),
+                donate_argnums=self._kv_donate,
+            )
+            fn = self._timed_first_call(jitted)
+            self._decode_variants[key] = fn
+        return fn
+
+    def warmup(self, *, cache: Any = None) -> dict[str, Any]:
+        """AOT-compile the serving programs before traffic.
+
+        Lowers and compiles the batched decode step, the chunked-prefill
+        program, and — when speculative decoding is configured — the verify
+        step plus the draft model's decode/prefill programs, all at their
+        exact serving shapes (every jitted shape is static by design — see
+        the module docstring — so warmup's avals are the only avals the
+        engine will ever call with), then swaps the compiled executables
+        into the hot path wrapped in
+        :class:`~deeplearning_mpi_tpu.compiler.aot.WarmProgram`. A compiled
+        executable never retraces, so a warmed engine performs ZERO
+        compiles on its first request — asserted by the
+        ``serve_compile_total`` trace counter in ``tests/test_compiler.py``
+        and the ``tools/autotune.py --selftest`` acceptance check. (Tuned
+        per-bucket decode variants compile lazily on their first dispatch —
+        they are DB-dependent overlays, not part of the zero-compile
+        contract.)
+
+        ``cache`` is an optional
+        :class:`~deeplearning_mpi_tpu.compiler.cache.CompileCache`; under a
+        persistent cache directory a restarted engine's warmup
+        deserializes instead of compiling (``compile_cache_hit_total``).
+        Compile wall time lands in ``serve_compile_seconds``. Returns the
+        compiled programs by name.
+        """
+        from deeplearning_mpi_tpu.compiler import aot
+
+        e = self.engine
+        reg = aot.WarmupRegistry(registry=self._metrics, cache=cache)
+        slots_i32 = jnp.zeros((e.max_slots,), jnp.int32)
+        reg.register(
+            "serve_decode_step", self._decode_jit,
+            self.params, self._k, self._v,
+            jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
+            slots_i32, slots_i32, jnp.zeros((e.max_slots,), bool),
+        )
+        reg.register(
+            "serve_prefill_chunk", self._prefill_jit,
+            self.params, self._k, self._v,
+            jnp.zeros((e.max_blocks_per_seq,), jnp.int32),
+            jnp.zeros((e.prefill_chunk,), jnp.int32),
+            jnp.int32(0), jnp.int32(1),
+        )
+        if self._spec is not None:
+            reg.register(
+                "serve_verify_step", self._verify_jit,
+                self.params, self._k, self._v,
+                jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
+                slots_i32,
+                jnp.zeros((e.max_slots, e.spec_k + 1), jnp.int32),
+                slots_i32, jnp.zeros((e.max_slots,), bool),
+            )
+            self._spec.register_warmup(reg)
+        programs = reg.warm_all()
+        if self._metrics is not None:
+            for prog in programs.values():
+                self._metrics.histogram("serve_compile_seconds").observe(
+                    prog.lower_seconds + prog.compile_seconds
+                )
+        self._decode_fn = aot.WarmProgram(
+            programs["serve_decode_step"], self._decode_jit
+        )
+        self._prefill_fn = aot.WarmProgram(
+            programs["serve_prefill_chunk"], self._prefill_jit
+        )
+        if self._spec is not None:
+            self._verify_fn = aot.WarmProgram(
+                programs["serve_verify_step"], self._verify_jit
+            )
+            self._spec.adopt_warmup(programs)
+        # Pre-trace every narrower gather-width bucket through the jit
+        # fallbacks (WarmProgram covers only the full-width avals): an
+        # all-inactive batch routes its writes to the scratch block and
+        # rebinds the donated pools, so these calls compile + execute
+        # harmlessly and width dispatch never compiles mid-traffic.
+        idle = jnp.zeros((e.max_slots,), jnp.int32)
+        off = jnp.zeros((e.max_slots,), bool)
+        for wb in self._gather_widths()[:-1]:
+            t = jnp.zeros((e.max_slots, wb), jnp.int32)
+            self._k, self._v, _ = self._decode_jit(
+                self.params, self._k, self._v, t, idle, idle, off
+            )
+            if self._spec is not None:
+                self._k, self._v, _ = self._verify_jit(
+                    self.params, self._k, self._v, t, idle,
+                    jnp.zeros((e.max_slots, e.spec_k + 1), jnp.int32),
+                    idle, off,
+                )
+                self._spec.pretrace_width(t, idle, off)
+        return programs
+
+    # -- public API ---------------------------------------------------------
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int,
+        *,
+        deadline: Optional[float] = None,
+    ) -> Request:
+        """Enqueue one request (or shed it at the door — check
+        ``req.state``). ``prompt`` is a 1-D int sequence."""
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        req = Request(
+            rid=self._next_rid,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            arrival=self._clock(),
+            deadline=deadline,
+        )
+        self._next_rid += 1
+        self._inc("serve_requests_submitted")
+        if not self.scheduler.submit(req):
+            self._inc("serve_requests_shed")
+        return req
+
+    def step(self) -> list[Request]:
+        """One engine iteration: shed expired → admit → one prefill chunk
+        per PREFILL slot → grow/evict for KV pressure → one batched decode
+        (or draft-propose + verify) step → retire finished sequences.
+        Returns the requests that FINISHED this step (their freed blocks
+        are already back in the pool, ready for the next admission)."""
+        now = self._clock()
+        finished: list[Request] = []
+        for _ in self.scheduler.shed_expired(now):
+            self._inc("serve_requests_shed")
+        admitted = self.scheduler.admit(now)
+        self._inc("serve_requests_admitted", len(admitted))
+
+        for req in list(self.scheduler.running()):
+            if req.state is RequestState.PREFILL:
+                self._prefill_one(req, finished)
+
+        if self.chaos is not None:
+            # Mid-step, after prefill has already mutated host + device
+            # state — the nastiest crash point: admitted requests hold
+            # blocks, partial prefills sit in the KV pool, the step never
+            # completes. recover() must untangle exactly this.
+            self.chaos.check_serve_crash(step=self.steps)
+
+        # Feeding a token at position length-1 writes its K/V there, so a
+        # slot needs blocks_for(length) blocks BEFORE the step; growth is
+        # where OOM pressure surfaces and the scheduler may evict. In
+        # speculative mode this growth is what assembles the verify batch,
+        # so a pool that cannot serve it sheds the requester under its own
+        # labeled reason ("spec_overflow") instead of the generic eviction.
+        shed_reason = "spec_overflow" if self._spec is not None else "evicted"
+        for req in list(self.scheduler.running()):
+            if req.state is not RequestState.DECODE:
+                continue
+            while len(req.blocks) < self.pool.blocks_for(req.length):
+                if not self.scheduler.grow(req, shed_reason=shed_reason):
+                    self._inc("serve_requests_shed")
+                    break
+        # grow() may have evicted requests from the snapshot above.
+        decoding = [
+            r for r in self.scheduler.running()
+            if r.state is RequestState.DECODE
+        ]
+        if decoding and self.scheduler.hold_decode(len(decoding)):
+            # Bucketed batch formation: prefill/admission supply can still
+            # grow this decode batch toward the next bucket, so spend one
+            # of the hold budget's steps on supply instead of dispatching
+            # a small batch. Holding only DELAYS decode — emitted tokens
+            # are unchanged, so parity is untouched.
+            self._inc("serve_decode_held_steps")
+            decoding = []
+        if decoding:
+            if self._spec is not None:
+                self._spec_decode(decoding, finished)
+            else:
+                self._plain_decode(decoding, finished)
+        self.steps += 1
+        self._set_gauges()
+        return finished
+
+    def _gather_width(self, blocks_held: int) -> int:
+        """Static block-table width for this step's jitted program: the
+        power-of-two bucket (capped at the full table) covering the widest
+        live row. The decode/verify programs' page gather streams O(width)
+        KV per layer — at serving batch sizes that traffic rivals the
+        matmuls — so shallow fills must not pay the full
+        ``max_blocks_per_seq``-wide gather. This is the same (batch,
+        context)-bucket observation the ``decode_bucket|...`` tuning key
+        space encodes, applied to the gather itself; :meth:`warmup`
+        pre-traces every width so a warmed engine never compiles on a
+        bucket transition."""
+        from deeplearning_mpi_tpu.compiler.autotune import pow2_bucket
+
+        return pow2_bucket(
+            max(blocks_held, 1), cap=self.engine.max_blocks_per_seq
+        )
+
+    def _gather_widths(self) -> list[int]:
+        """Every width :meth:`_gather_width` can emit, ascending."""
+        mb = self.engine.max_blocks_per_seq
+        out = []
+        w = 1
+        while w < mb:
+            out.append(w)
+            w *= 2
+        out.append(mb)
+        return out
+
+    def _plain_decode(
+        self, decoding: list[Request], finished: list[Request]
+    ) -> None:
+        e = self.engine
+        tables = np.zeros((e.max_slots, e.max_blocks_per_seq), np.int32)
+        lengths = np.zeros((e.max_slots,), np.int32)
+        tokens = np.zeros((e.max_slots,), np.int32)
+        active = np.zeros((e.max_slots,), bool)
+        for req in decoding:
+            s = req.slot
+            tables[s, : len(req.blocks)] = req.blocks
+            lengths[s] = req.length
+            tokens[s] = req.generated[-1]
+            active[s] = True
+        tables = tables[
+            :, : self._gather_width(max(len(r.blocks) for r in decoding))
+        ]
+        fn = self._decode_fn
+        if e.use_kernel is None:
+            # Per-(batch, context)-bucket schedule: a tuned decode_bucket|...
+            # entry for THIS step's live bucket overrides the single
+            # gathered-shape flash_decode entry the default program consults
+            # at trace time. Miss = default program (never a recompile).
+            from deeplearning_mpi_tpu.compiler import autotune
+
+            tuned = autotune.tuned_decode_bucket(
+                len(decoding), int(lengths.max()),
+                (
+                    e.max_slots, e.max_seq_len,
+                    self.config.num_kv_heads or self.config.num_heads,
+                    self.config.head_dim,
+                ),
+                self.dtype,
+            )
+            if tuned is not None and not self._is_base_schedule(
+                tuned, tables.shape[1]
+            ):
+                fn = self._decode_variant(
+                    tuned["schedule"] == "kernel", tuned.get("block")
+                )
+        self._k, self._v, next_tok = fn(
+            self.params, self._k, self._v,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(tokens), jnp.asarray(active),
+        )
+        self._inc("serve_decode_steps")
+        next_np = np.asarray(jax.device_get(next_tok))
+        now = self._clock()
+        for req in decoding:
+            tok = int(next_np[req.slot])
+            req.generated.append(tok)
+            self._inc("serve_tokens_generated")
+            if self._done(req, tok):
+                self._finish(req, now, finished)
+
+    def _spec_decode(
+        self, decoding: list[Request], finished: list[Request]
+    ) -> None:
+        """One speculative decode iteration: plan per-slot proposal budgets
+        (growing KV cover WITHOUT evicting peers — speculation degrades
+        before it preempts), run the draft propose loop, verify the whole
+        batch in one jitted step, emit the longest exact-greedy-match
+        prefix plus the target's own next token, and roll surplus tail
+        blocks back to the free list."""
+        e = self.engine
+        K, BS = e.spec_k, e.block_size
+        tables = np.zeros((e.max_slots, e.max_blocks_per_seq), np.int32)
+        lengths = np.zeros((e.max_slots,), np.int32)
+        last = np.zeros((e.max_slots,), np.int32)
+        n_prop = np.zeros((e.max_slots,), np.int32)
+        active = np.zeros((e.max_slots,), bool)
+        for req in decoding:
+            s = req.slot
+            # Budget: the step emits up to n+1 tokens; never propose past
+            # the request's remaining generation budget (admission already
+            # bounds prompt + max_new to max_seq_len, so the position
+            # ceiling is subsumed).
+            n = min(K, req.max_new_tokens - len(req.generated) - 1)
+            if n > 0:
+                # Verify writes K/V at positions length-1 .. length-1+n:
+                # take the extra blocks all-or-nothing from the FREE list
+                # only. A speculative tail must never evict a peer (the
+                # mandatory-growth path above handles real pressure);
+                # on a dry pool the budget degrades to what the already-
+                # owned blocks cover.
+                need = self.pool.blocks_for(req.length + n) - len(req.blocks)
+                if need > 0:
+                    got = self.pool.alloc(need)
+                    if got is not None:
+                        req.blocks.extend(got)
+                    else:
+                        n = min(n, len(req.blocks) * BS - req.length)
+                        self._inc("spec_degraded_total")
+            tables[s, : len(req.blocks)] = req.blocks
+            lengths[s] = req.length
+            last[s] = req.generated[-1]
+            n_prop[s] = max(n, 0)
+            active[s] = True
+        tables = tables[
+            :, : self._gather_width(max(len(r.blocks) for r in decoding))
+        ]
+        props, draft_steps = self._spec.propose(
+            tables, lengths, last, n_prop, active
+        )
+        self._inc("spec_draft_steps", draft_steps)
+        W = K + 1
+        tokens = np.zeros((e.max_slots, W), np.int32)
+        tokens[:, 0] = last
+        tokens[:, 1:] = props
+        self._k, self._v, greedy = self._verify_fn(
+            self.params, self._k, self._v,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(tokens), jnp.asarray(n_prop + 1),
+            jnp.asarray(active),
+        )
+        self._inc("serve_decode_steps")
+        self._inc("spec_verify_steps")
+        greedy_np = np.asarray(jax.device_get(greedy))  # [S, W]
+        now = self._clock()
+        for req in decoding:
+            s = req.slot
+            n_p = int(n_prop[s])
+            g = greedy_np[s]
+            # Exact-greedy-match acceptance: the longest proposal prefix
+            # equal to the target's own greedy choices. greedy[i] is the
+            # target's token for position lengths[s]+i, i.e. exactly what
+            # a plain decode step would emit after the first i proposals.
+            n = 0
+            while n < n_p and int(props[s, n]) == int(g[n]):
+                n += 1
+            emitted_props = 0
+            for i in range(n + 1):
+                tok = int(g[i])
+                req.generated.append(tok)
+                self._inc("serve_tokens_generated")
+                if i < n:
+                    emitted_props += 1
+                if self._done(req, tok):
+                    self._finish(req, now, finished)
+                    break
+            self._inc("spec_proposed_total", n_p)
+            self._inc("spec_accepted_total", emitted_props)
+            self._inc("spec_rollback_total", n_p - emitted_props)
+            if req.state is RequestState.DECODE:
+                # Roll back the rejected tail's surplus blocks: keep exactly
+                # the cover the next step's mandatory growth would demand,
+                # return the rest to the free list. K/V content needs no
+                # rollback — garbage past the accepted prefix sits at
+                # positions the next verify step overwrites before they
+                # become causally visible.
+                freed = self.scheduler.shrink(
+                    req, self.pool.blocks_for(req.length)
+                )
+                self._inc("spec_blocks_rolled_back_total", len(freed))
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
+        """Step until queue and slots drain; returns everything finished.
+
+        Injected crashes (:class:`~..resilience.faults.InjectedFault`) are
+        recovered in place and the loop continues — each planned fault
+        fires exactly once, so this cannot spin. Requests that FINISHED
+        during the crashed step stay finished on their own objects (the
+        step's return value was lost with the exception; callers assert on
+        request state, not on this list, for those).
+        """
+        from deeplearning_mpi_tpu.resilience.faults import InjectedFault
+
+        finished: list[Request] = []
+        steps = 0
+        while not self.scheduler.idle():
+            try:
+                finished.extend(self.step())
+            except InjectedFault as err:
+                print(f"serving: {err} — recovering")
+                self.recover()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps"
+                )
+        return finished
+
+    def recover(self) -> dict[str, int]:
+        """Crash recovery: requeue every in-flight sequence and rebuild the
+        KV pool's free list against scheduler ground truth.
+
+        In-flight (PREFILL or DECODE) sequences restart from their prompt:
+        after a mid-step crash the engine cannot prove which KV writes
+        landed, and re-prefilling from scratch is the only state that is
+        both trustworthy and deterministic — it keeps recovered greedy
+        completions bit-identical to offline decode. Already-generated
+        tokens are discarded (counted in ``serve_tokens_discarded_total``).
+        Stale KV rows left by the crashed step are harmless once the pool
+        is reconciled: re-prefill overwrites its own pages, and recycled
+        blocks' leftover rows sit past every valid position, causally
+        masked (the same argument as normal block reuse — and the same one
+        covers the draft model's pools, which re-prefill rewrites through
+        the same block tables).
+
+        Requeue order preserves FCFS: running requests (admitted earlier
+        than anything still queued) are pushed to the queue front,
+        newest-arrival first, so the front ends up oldest-first.
+        """
+        inflight = sorted(self.scheduler.running(), key=lambda r: (r.arrival, r.rid))
+        discarded = sum(len(r.generated) for r in inflight)
+        for req in reversed(inflight):
+            self.scheduler.requeue(req)
+        # No sequence owns verified blocks after requeue — free everything.
+        stats = self.pool.reconcile(())
+        self.pool.check()
+        self._inc("serve_requeued_total", len(inflight))
+        self._inc("serve_tokens_discarded_total", discarded)
+        if self.chaos is not None:
+            self.chaos.record_recovery("serve_crash")
+        self._set_gauges()
+        out = {"requeued": len(inflight), "tokens_discarded": discarded, **stats}
+        print(
+            f"serving: recovered — requeued {out['requeued']} in-flight "
+            f"request(s), reclaimed {stats['reclaimed']} KV block(s), "
+            f"discarded {discarded} token(s)"
+        )
+        return out
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_one(self, req: Request, finished: list[Request]) -> None:
+        e = self.engine
+        start = req.prefilled
+        n_valid = min(e.prefill_chunk, req.prompt_len - start)
+        chunk = np.zeros((e.prefill_chunk,), np.int32)
+        chunk[:n_valid] = req.prompt[start : start + n_valid]
+        table = np.zeros((e.max_blocks_per_seq,), np.int32)
+        table[: len(req.blocks)] = req.blocks
+        self._k, self._v, last_logits = self._prefill_fn(
+            self.params, self._k, self._v,
+            jnp.asarray(table), jnp.asarray(chunk),
+            jnp.int32(start), jnp.int32(n_valid),
+        )
+        if self._spec is not None:
+            # The draft ingests the prompt alongside the target (same
+            # chunk, same table, its own pools) so its propose loop has a
+            # complete prefix from the first decode iteration.
+            self._spec.prefill_chunk(table, chunk, start, n_valid)
+        self._inc("serve_prefill_chunks")
+        req.prefilled += n_valid
+        if req.prefilled < req.prompt_len:
+            return
+        # Prompt fully ingested: the first generated token comes straight
+        # from the prefill's last-position logits (same seed-step split as
+        # models.generate.first_token).
+        tok = int(jax.device_get(jnp.argmax(last_logits)))
+        req.state = RequestState.DECODE
+        req.generated.append(tok)
+        req.t_first_token = self._clock()
+        self._inc("serve_tokens_generated")
+        if self._metrics is not None and req.ttft is not None:
+            self._metrics.histogram("serve_ttft_s").observe(req.ttft)
+        if self._done(req, tok):
+            self._finish(req, req.t_first_token, finished)
+
+    # -- retirement ---------------------------------------------------------
+    def _done(self, req: Request, tok: int) -> bool:
+        if self.eos_id is not None and tok == self.eos_id:
+            return True
+        return len(req.generated) >= req.max_new_tokens
+
+    def _finish(self, req: Request, now: float, finished: list[Request]) -> None:
+        self.scheduler.finish(req, now)
+        finished.append(req)
+        self._inc("serve_requests_completed")
+        if self._metrics is not None and req.tpot is not None:
+            self._metrics.histogram("serve_tpot_s").observe(req.tpot)
+
+    # -- telemetry ----------------------------------------------------------
+    def _inc(self, name: str, amount: float = 1.0) -> None:
+        if self._metrics is not None and amount:
+            self._metrics.counter(name).inc(amount)
+
+    def _set_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.gauge("serve_queue_depth").set(
+            self.scheduler.queue_depth()
+        )
+        self._metrics.gauge("serve_slots_active").set(
+            self.scheduler.slots_active()
+        )
+        self._metrics.gauge("serve_kv_blocks_in_use").set(self.pool.in_use)

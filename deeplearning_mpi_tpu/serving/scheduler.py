@@ -30,6 +30,17 @@ them is exercised by ``tests/test_serving.py`` under a fake clock):
   deterministic, starvation-free choice here: the engine frees the
   largest allocation (oldest ≈ longest), and a fresh request can't be
   starved forever by an earlier long-runner.
+- **Bucketed decode-batch formation** (``decode_buckets``): decode cost
+  per step is dominated by streaming the weights, so a batch of 2 costs
+  nearly what a batch of 16 does — dispatching tiny batches while the
+  queue holds admissible work squanders the step. With buckets
+  configured (e.g. ``(8, 16, 32)``), :meth:`hold_decode` tells the
+  engine to SKIP the decode phase for up to ``max_hold_steps``
+  consecutive steps while admission + prefill supply could still grow
+  the decode batch toward the largest reachable bucket. Holding never
+  changes any request's tokens (decode is delayed, not reordered) and
+  cannot livelock: with no supply in sight the hold ends immediately,
+  and the step budget bounds it otherwise.
 """
 
 from __future__ import annotations
@@ -68,7 +79,8 @@ class Request:
 
     state: RequestState = RequestState.QUEUED
     #: why a SHED request was shed: "queue_full" | "too_long" | "deadline"
-    #: | "evicted"
+    #: | "evicted" | "spec_overflow" (KV pool could not cover the request's
+    #: own next position while assembling a speculative verify batch)
     shed_reason: Optional[str] = None
     slot: Optional[int] = None
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -120,14 +132,21 @@ class Scheduler:
         max_seq_len: int,
         max_queue: int = 64,
         registry: Any = None,
+        decode_buckets: tuple[int, ...] = (),
+        max_hold_steps: int = 4,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if any(b < 1 for b in decode_buckets):
+            raise ValueError(f"decode_buckets must be >= 1: {decode_buckets}")
         self.pool = pool
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.max_queue = max_queue
         self.registry = registry
+        self.decode_buckets = tuple(sorted(decode_buckets))
+        self.max_hold_steps = max_hold_steps
+        self._hold_steps = 0
         self.queue: deque[Request] = deque()
         self.slots: list[Optional[Request]] = [None] * max_slots
         self.shed_count = 0
@@ -186,12 +205,16 @@ class Scheduler:
             admitted.append(req)
         return admitted
 
-    def grow(self, req: Request) -> bool:
+    def grow(self, req: Request, *, shed_reason: str = "evicted") -> bool:
         """Give ``req`` one more KV block, evicting under OOM pressure.
 
         Returns False iff ``req`` itself was shed (it was the oldest, or
         eviction could not free a block) — the caller must drop it from
-        the step.
+        the step. ``shed_reason`` labels THAT self-shed in
+        ``serve_shed_total{reason=...}`` (the speculative engine passes
+        ``"spec_overflow"``: the pool could not cover the request while a
+        verify batch was being assembled); victims evicted on the way are
+        always labeled ``"evicted"``.
         """
         while True:
             blocks = self.pool.alloc(1)
@@ -203,15 +226,54 @@ class Scheduler:
                 # Nothing older to evict: shed the requester. (victim is
                 # req covers the pathological one-slot pool-exhausted
                 # case — self-eviction, not an infinite loop.)
-                self.evict(req)
+                self.evict(req, reason=shed_reason)
                 return False
             self.evict(victim)
 
-    def evict(self, req: Request) -> None:
+    def evict(self, req: Request, *, reason: str = "evicted") -> None:
         """Shed a RUNNING request and reclaim its blocks."""
         self._release(req)
-        self._shed(req, "evicted")
+        self._shed(req, reason)
         self.evicted_count += 1
+
+    def shrink(self, req: Request, keep: int) -> list[int]:
+        """Return ``req``'s tail blocks past the first ``keep`` to the
+        free list and report exactly which ids went back (speculative
+        rollback: surplus blocks allocated for rejected proposals). KV
+        *content* is never rolled back — garbage rows past the accepted
+        prefix sit at positions the next step overwrites before they
+        become causally visible (docs/SERVING.md)."""
+        tail = req.blocks[keep:]
+        if tail:
+            self.pool.free(tail)
+            del req.blocks[keep:]
+        return tail
+
+    def hold_decode(self, n_decoding: int) -> bool:
+        """Should the engine skip this step's decode phase to let a larger
+        batch form? True only while buckets are configured, the current
+        batch is below the largest bucket that admission + prefill supply
+        could still reach, and the consecutive-hold budget
+        (``max_hold_steps``) has not been spent."""
+        if not self.decode_buckets or n_decoding <= 0:
+            self._hold_steps = 0
+            return False
+        free_slots = sum(r is None for r in self.slots)
+        prefilling = sum(
+            r is not None and r.state is RequestState.PREFILL
+            for r in self.slots
+        )
+        # Upper bound on how large the decode batch could grow if the
+        # engine spends steps on supply instead of decode.
+        potential = n_decoding + prefilling + min(len(self.queue), free_slots)
+        feasible = min(potential, self.max_slots)
+        reachable = [b for b in self.decode_buckets if b <= feasible]
+        target = max(reachable) if reachable else feasible
+        if n_decoding >= target or self._hold_steps >= self.max_hold_steps:
+            self._hold_steps = 0
+            return False
+        self._hold_steps += 1
+        return True
 
     def finish(self, req: Request, now: float) -> None:
         req.t_finished = now

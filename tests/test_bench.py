@@ -1,0 +1,76 @@
+"""bench.py survives a wedged device probe (ROADMAP item 4 first-fix).
+
+Rounds r03 and r05 of the bench board died WHOLE: a single 120 s
+device-probe hang at startup zeroed every number in the round (see
+BENCH_r05.json — ``"details": {}``). The fix under test is per-workload
+isolation: the parent orchestrator never imports JAX, every workload runs
+in its own killable process group behind its own probe, and a wedged
+probe records a ``failed`` entry for THAT workload only while the rest of
+the round still reports. ``DMT_BENCH_WEDGE_PROBE`` substitutes a
+sleep-forever probe child so the drill runs without a TPU or a tunnel.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+# Everything slow is skipped: the drill exercises the orchestration
+# (probe -> isolate -> salvage), not the workloads. What remains is
+# cifar_32px (whose probe gets wedged) and allreduce (~0 s on one CPU).
+FAST_FLAGS = [
+    "--platform", "cpu", "--skip_224", "--skip_lm", "--skip_unet",
+    "--skip_decode", "--skip_spec", "--probe_timeout", "3",
+]
+
+
+def _run_bench(wedge: str):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DMT_BENCH_WEDGE_PROBE=wedge)
+    return subprocess.run(
+        [sys.executable, BENCH, *FAST_FLAGS],
+        capture_output=True, text=True, timeout=540, env=env,
+    )
+
+
+class TestWedgedProbe:
+    def test_wedged_probe_fails_one_workload_not_the_round(self):
+        proc = _run_bench("cifar_32px")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = proc.stdout.strip().splitlines()
+        combined = json.loads(lines[-1])
+        details = combined["details"]
+
+        # The wedged workload is marked failed — without ever running its
+        # (expensive) child — and the probe budget is named in the entry.
+        cifar = details["cifar_32px"]
+        assert "probe hung for 3s" in cifar["failed"]
+        assert "images_per_s_per_chip" not in cifar
+
+        # Blast radius stops there: the other workload still reports a
+        # real number into the SAME combined line the driver parses.
+        allreduce = details["allreduce"]
+        assert "failed" not in allreduce
+        assert combined["allreduce_latency_ms"] is not None
+
+        # The per-workload progress line carried the error too.
+        probe_lines = [
+            json.loads(l) for l in lines
+            if l.startswith("{") and "error" in json.loads(l)
+        ]
+        assert any("probe hung" in p["error"] for p in probe_lines)
+
+    def test_all_probes_wedged_still_emits_combined_line(self):
+        """Even the r05 catastrophe — every probe wedged — must produce
+        the final combined line (all values null) with exit 0, so the
+        driver records a failed round instead of a missing one."""
+        proc = _run_bench("all")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        combined = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert combined["value"] is None
+        assert combined["allreduce_latency_ms"] is None
+        for entry in combined["details"].values():
+            if isinstance(entry, dict) and "failed" in entry:
+                assert "probe hung" in entry["failed"]

@@ -176,6 +176,106 @@ class TestAutotune:
             autotune.set_default_db(None)
 
 
+# -- decode bucket tuner (`decode_bucket|...`) + spec-k (`spec_k|...`) --------
+
+class TestDecodeBucketTuning:
+    SHAPE = (2, 64, 2, 16)
+
+    def test_pow2_bucket(self):
+        assert autotune.pow2_bucket(1) == 1
+        assert autotune.pow2_bucket(3) == 4
+        assert autotune.pow2_bucket(8) == 8
+        assert autotune.pow2_bucket(9) == 16
+        # Clamped to the buffer's real extent: a bucket can never name a
+        # condition the gathered pool cannot hold.
+        assert autotune.pow2_bucket(40, cap=32) == 32
+        assert autotune.pow2_bucket(0) == 1
+
+    def test_decode_bucket_key_canonical(self):
+        key = autotune.decode_bucket_key(2, 64, self.SHAPE, F32, backend="cpu")
+        assert key == "decode_bucket|b2xc64|2x64x2x16|float32|cpu"
+        # dtype objects and names collapse to one spelling.
+        assert key == autotune.decode_bucket_key(
+            2, 64, self.SHAPE, "float32", backend="cpu"
+        )
+
+    def test_expected_tokens_per_step(self):
+        # a=0: only the bonus token ever lands. a=1: all k + bonus.
+        assert autotune.expected_tokens_per_step(0.0, 4) == 1.0
+        assert autotune.expected_tokens_per_step(1.0, 4) == 5.0
+        # Truncated geometric series: a=0.5, k=2 -> 1 + .5 + .25 = 1.75.
+        assert autotune.expected_tokens_per_step(0.5, 2) == 1.75
+        # Out-of-range rates clamp instead of exploding.
+        assert autotune.expected_tokens_per_step(2.0, 3) == 4.0
+
+    def test_tune_buckets_round_trip_and_live_consult(self, tmp_path):
+        db = autotune.TuningDB(tmp_path / "b.json")
+        tuned = autotune.tune_decode_buckets(
+            self.SHAPE, F32, db=db,
+            batch_buckets=(1, 2), context_buckets=(32, 64),
+            blocks=(16,), repeats=1,
+        )
+        assert len(tuned) == 4
+        for key, params in tuned.items():
+            assert key.startswith("decode_bucket|")
+            assert params["schedule"] in ("kernel", "einsum")
+        db.save()
+        autotune.set_default_db(autotune.TuningDB.load(db.path))
+        try:
+            # Live values bucket up: batch 2 -> b2, context 40 -> c64.
+            got = autotune.tuned_decode_bucket(2, 40, self.SHAPE, F32)
+            want = tuned[autotune.decode_bucket_key(2, 64, self.SHAPE, F32)]
+            assert got == want
+            # An untuned dtype misses cleanly.
+            assert (
+                autotune.tuned_decode_bucket(2, 40, self.SHAPE, jnp.bfloat16)
+                is None
+            )
+        finally:
+            autotune.set_default_db(None)
+        # No DB installed: consult degrades to None, never raises.
+        assert autotune.tuned_decode_bucket(2, 40, self.SHAPE, F32) is None
+
+    def test_bucket_consult_never_raises(self):
+        class Broken:
+            def lookup_key(self, *a, **k):
+                raise RuntimeError("boom")
+
+        autotune._default_db = Broken()
+        try:
+            assert (
+                autotune.tuned_decode_bucket(2, 40, self.SHAPE, F32) is None
+            )
+            assert (
+                autotune.tuned_spec_k(
+                    __import__(
+                        "deeplearning_mpi_tpu.models", fromlist=["models"]
+                    ).TransformerConfig.tiny(),
+                    1, F32,
+                ) is None
+            )
+        finally:
+            autotune.set_default_db(None)
+
+    def test_tune_spec_k_records_winner(self, tmp_path):
+        from deeplearning_mpi_tpu.models import TransformerConfig
+
+        db = autotune.TuningDB(tmp_path / "s.json")
+        won = autotune.tune_spec_k(
+            draft_layers=1, db=db, candidates=(0, 2),
+            num_requests=2, max_new_tokens=8,
+        )
+        assert isinstance(won["spec_k"], int) and won["spec_k"] in (0, 2)
+        autotune.set_default_db(db)
+        try:
+            got = autotune.tuned_spec_k(TransformerConfig.tiny(), 1, F32)
+            assert got is not None and got["spec_k"] == won["spec_k"]
+            # A different draft depth is a different key: clean miss.
+            assert autotune.tuned_spec_k(TransformerConfig.tiny(), 3, F32) is None
+        finally:
+            autotune.set_default_db(None)
+
+
 # -- whole-step schedule tuner (`step|...` key space) -------------------------
 
 class TestStepTuning:
@@ -477,19 +577,71 @@ class TestWarmedEngine:
         registry = MetricsRegistry()
         engine = self._engine(registry)
         engine.warmup()
-        # Warmup traced each program exactly once (the trace-time tick in
-        # _decode_step/_prefill_chunk).
+        # Warmup traced the two AOT programs once each (the trace-time tick
+        # in _decode_step/_prefill_chunk) plus one decode variant per
+        # narrower gather-width bucket (here widths [1, 2] below MB=4).
         compiles = registry.counter("serve_compile_total").value
-        assert compiles == 2
+        assert compiles == 2 + (len(engine._gather_widths()) - 1)
         req = engine.submit(np.arange(1, 9, dtype=np.int32), 4)
         while not engine.scheduler.idle():
             engine.step()
         assert req.state is RequestState.FINISHED
+        # The actual contract: the first request compiled NOTHING.
         assert registry.counter("serve_compile_total").value == compiles
-        # Both the AOT paths stayed on the executable — the fallback net
-        # was never needed.
-        assert engine._decode_fn.fallback_calls == 0
+        # Prefill stayed on the AOT executable. Decode rows holding fewer
+        # than max_blocks_per_seq blocks dispatch through the pre-traced
+        # narrow-width jit — counted as fallback calls, but the zero
+        # compile-delta above proves those widths were already warm.
         assert engine._prefill_fn.fallback_calls == 0
+
+    def test_tuned_einsum_buckets_stay_on_base_program(self):
+        """A decode_bucket| entry whose winner IS the base program's
+        schedule (einsum, no block) must not spawn a duplicate lazy-compiled
+        variant — the warmed engine stays at zero compiles even with
+        per-bucket consults live (use_kernel=None)."""
+        from deeplearning_mpi_tpu.compiler import autotune
+        from deeplearning_mpi_tpu.models import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from deeplearning_mpi_tpu.serving import (
+            EngineConfig,
+            RequestState,
+            ServingEngine,
+        )
+
+        cfg = TransformerConfig.tiny()
+        params = TransformerLM(config=cfg, dtype=F32).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        ecfg = EngineConfig(max_slots=2, block_size=8, num_blocks=16,
+                            max_blocks_per_seq=4, prefill_chunk=8,
+                            max_queue=8, use_kernel=None)
+        shape = (2, 32, cfg.num_kv_heads or cfg.num_heads, cfg.head_dim)
+        db = autotune.TuningDB()
+        for bb in (1, 2):
+            for cb in (8, 16, 32):
+                db.record_key(
+                    autotune.decode_bucket_key(bb, cb, shape, F32),
+                    {"schedule": "einsum", "block": None},
+                )
+        autotune.set_default_db(db)
+        try:
+            registry = MetricsRegistry()
+            engine = ServingEngine(
+                cfg, params, ecfg, dtype=F32, registry=registry,
+            )
+            engine.warmup()
+            compiles = registry.counter("serve_compile_total").value
+            req = engine.submit(np.arange(1, 9, dtype=np.int32), 4)
+            while not engine.scheduler.idle():
+                engine.step()
+            assert req.state is RequestState.FINISHED
+            assert db.consulted, "bucket entries were never consulted"
+            assert engine._decode_variants == {}
+            assert registry.counter("serve_compile_total").value == compiles
+        finally:
+            autotune.set_default_db(None)
 
     def test_warmed_matches_unwarmed_tokens(self):
         from deeplearning_mpi_tpu.serving import RequestState

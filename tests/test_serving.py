@@ -19,6 +19,8 @@ Three layers, tested at three granularities:
   claims.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -26,6 +28,10 @@ import pytest
 
 from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
 from deeplearning_mpi_tpu.models.generate import generate
+from deeplearning_mpi_tpu.models.transformer import (
+    draft_config,
+    truncate_lm_params,
+)
 from deeplearning_mpi_tpu.serving import (
     SCRATCH_BLOCK,
     EngineConfig,
@@ -242,6 +248,48 @@ class TestScheduler:
         assert sched.idle()
         pool.check()
 
+    def test_shrink_returns_exact_tail_blocks(self):
+        """Speculative rollback contract: ``shrink(req, keep)`` frees and
+        returns EXACTLY the tail beyond ``keep`` — not a recount, not a
+        fresh allocation's worth — so the engine's rolled-back-blocks
+        counter is an identity, not an estimate."""
+        sched, pool = self._sched()
+        req = _req(0, 4)
+        sched.submit(req)
+        sched.admit(now=0.0)
+        assert sched.grow(req) and sched.grow(req)
+        held = list(req.blocks)
+        avail = pool.available
+        freed = sched.shrink(req, 1)
+        assert freed == held[1:]
+        assert req.blocks == held[:1]
+        assert pool.available == avail + 2
+        assert sched.shrink(req, 1) == []  # nothing past keep: no-op
+        pool.check()
+
+    def test_hold_decode_forms_larger_buckets(self):
+        """Bucketed batch formation: with one sequence decoding and another
+        prefilling, the scheduler holds decode (up to max_hold_steps) so
+        the pair can step together at the next bucket."""
+        sched, pool = self._sched(max_slots=2)
+        sched.decode_buckets = (2,)
+        sched.max_hold_steps = 2
+        a, b = _req(0, 4, arrival=0.0), _req(1, 4, arrival=1.0)
+        for r in (a, b):
+            sched.submit(r)
+        sched.admit(now=2.0)  # both PREFILL
+        b.state = RequestState.PREFILL
+        a.state = RequestState.DECODE
+        assert sched.hold_decode(1)      # b's supply can reach bucket 2
+        assert sched.hold_decode(1)
+        assert not sched.hold_decode(1)  # max_hold_steps: stop starving a
+        b.state = RequestState.DECODE
+        assert not sched.hold_decode(2)  # bucket reached: no hold
+
+    def test_hold_decode_without_buckets_is_inert(self):
+        sched, _ = self._sched()
+        assert not sched.hold_decode(1)
+
     def test_finish_releases_slot_and_blocks(self):
         sched, pool = self._sched()
         req = _req(0, 6)
@@ -443,6 +491,248 @@ class TestEngineParity:
         assert req.state is RequestState.SHED
         assert req.shed_reason == "deadline"
         assert engine.scheduler.idle()
+
+
+# -- speculative decoding ----------------------------------------------------
+
+
+def _spec_engine(tiny_lm, *, draft_layers=1, spec_k=3, base_cfg=None, **kw):
+    cfg, _, params = tiny_lm
+    return ServingEngine(
+        cfg, params,
+        dataclasses.replace(base_cfg or ENGINE_CFG, spec_k=spec_k),
+        dtype=jnp.float32,
+        draft_config=draft_config(cfg, draft_layers),
+        draft_params=truncate_lm_params(params, draft_layers),
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_parity_run(tiny_lm):
+    """The staggered parity_run replayed through the SPECULATIVE engine
+    (1-layer truncated draft, k=3): same arrival schedule, same slot churn
+    and mid-run block recycling — now with draft proposals, batched verify
+    steps, and rollback of rejected tails in the mix."""
+    cfg, model, params = tiny_lm
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, 255, size=n).astype(np.int32) for n in PROMPT_LENS
+    ]
+    offline = [_offline_greedy(model, params, p, MAX_NEW) for p in prompts]
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = _spec_engine(tiny_lm, clock=clock, registry=registry)
+    arrive_at_step = {0: [0, 1, 2], 2: [3, 4], 4: [5], 6: [6, 7]}
+    reqs = {}
+    step = 0
+    while step in arrive_at_step or not engine.scheduler.idle():
+        for i in arrive_at_step.get(step, []):
+            reqs[i] = engine.submit(prompts[i], MAX_NEW)
+        engine.step()
+        clock.advance(1.0)
+        step += 1
+        assert step < 500, "engine did not drain"
+    return {
+        "engine": engine, "reqs": [reqs[i] for i in range(len(prompts))],
+        "offline": offline, "snapshot": registry.snapshot(),
+    }
+
+
+class TestSpeculativeDecoding:
+    def test_staggered_parity_bit_identical(self, spec_parity_run):
+        """THE speculative acceptance bar: exact-greedy-match acceptance
+        means the draft can propose anything and every emitted stream is
+        still bit-identical to offline greedy — under the same staggered
+        arrivals and slot churn the plain-engine parity test uses."""
+        for req, expect in zip(spec_parity_run["reqs"],
+                               spec_parity_run["offline"]):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect, (
+                f"rid={req.rid}: spec {req.generated} != offline {expect}"
+            )
+
+    def test_counters_reconcile(self, spec_parity_run):
+        """Every proposed token is accounted for exactly once:
+        proposed == accepted + rolled_back, with the verify/draft step
+        counters live."""
+        snap = spec_parity_run["snapshot"]
+        prop = snap["spec_proposed_total"]
+        assert prop > 0
+        assert prop == snap["spec_accepted_total"] + snap["spec_rollback_total"]
+        assert snap["spec_verify_steps"] > 0
+        assert snap["spec_draft_steps"] > 0
+
+    def test_pool_drained_after_rollbacks(self, spec_parity_run):
+        pool = spec_parity_run["engine"].pool
+        pool.check()
+        assert pool.in_use == 0
+        assert pool.total_allocated == pool.total_freed > 0
+
+    def test_full_self_draft_accepts_everything(self, tiny_lm):
+        """A draft identical to the target (all layers kept) agrees with
+        every verify argmax, so acceptance is 100%, nothing rolls back,
+        and the run takes strictly fewer decode steps than the plain
+        engine on the same workload — the speedup mechanism, isolated."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(3)
+        prompts = [
+            rng.integers(1, 255, size=6).astype(np.int32) for _ in range(4)
+        ]
+        offline = [
+            _offline_greedy(model, params, p, MAX_NEW) for p in prompts
+        ]
+
+        plain_reg = MetricsRegistry()
+        plain = ServingEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32, registry=plain_reg,
+        )
+        for p in prompts:
+            plain.submit(p, MAX_NEW)
+        plain.run_until_idle()
+
+        spec_reg = MetricsRegistry()
+        engine = _spec_engine(
+            tiny_lm, draft_layers=cfg.num_layers, registry=spec_reg,
+        )
+        reqs = [engine.submit(p, MAX_NEW) for p in prompts]
+        engine.run_until_idle()
+
+        for req, expect in zip(reqs, offline):
+            assert req.generated == expect
+        snap = spec_reg.snapshot()
+        assert snap["spec_proposed_total"] > 0
+        assert snap["spec_rollback_total"] == 0
+        assert snap["spec_accepted_total"] == snap["spec_proposed_total"]
+        assert (
+            snap["serve_decode_steps"]
+            < plain_reg.snapshot()["serve_decode_steps"]
+        )
+
+    def test_adversarial_draft_full_rollback_keeps_parity(self, tiny_lm):
+        """Worst-case draft: proposals overridden (the documented test
+        seam) with constant garbage. Throughput collapses; output must
+        not change — and every rejected tail's blocks flow back through
+        shrink, leaving the pool drained and the rolled-back-blocks
+        counter consistent."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(13)
+        prompts = [
+            rng.integers(1, 255, size=n).astype(np.int32) for n in (5, 9, 3)
+        ]
+        offline = [
+            _offline_greedy(model, params, p, MAX_NEW) for p in prompts
+        ]
+        registry = MetricsRegistry()
+        engine = _spec_engine(tiny_lm, registry=registry)
+
+        def garbage_propose(tables, lengths, last, n_prop, active):
+            return np.zeros((len(last), 3), np.int32), 0
+
+        engine._spec.propose = garbage_propose
+        reqs = [engine.submit(p, MAX_NEW) for p in prompts]
+        engine.run_until_idle()
+
+        for req, expect in zip(reqs, offline):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect
+        snap = registry.snapshot()
+        prop = snap["spec_proposed_total"]
+        assert prop > 0
+        assert snap["spec_rollback_total"] > 0
+        assert prop == snap["spec_accepted_total"] + snap["spec_rollback_total"]
+        engine.pool.check()
+        assert engine.pool.in_use == 0
+        assert engine.pool.total_allocated == engine.pool.total_freed
+
+    def test_spec_overflow_shed_reason(self, tiny_lm):
+        """A verify batch that cannot cover its own KV growth self-sheds
+        the oldest (the requester) under the dedicated
+        ``serve_shed_total{reason="spec_overflow"}`` label — overflow is
+        accounting, never a raise — and the survivor still matches
+        offline greedy."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(5)
+        long_p = rng.integers(1, 255, size=8).astype(np.int32)
+        short_p = rng.integers(1, 255, size=7).astype(np.int32)
+        offline_short = _offline_greedy(model, params, short_p, 5)
+        clock = FakeClock()
+        registry = MetricsRegistry()
+        engine = _spec_engine(
+            tiny_lm, clock=clock, registry=registry,
+            base_cfg=EngineConfig(
+                max_slots=2, block_size=4, num_blocks=5,
+                max_blocks_per_seq=4, prefill_chunk=4,
+            ),
+        )
+        a = engine.submit(long_p, 8)   # grows to 4 blocks: whole pool
+        clock.advance(1.0)
+        b = engine.submit(short_p, 5)  # 12 positions: 3 blocks
+        engine.run_until_idle()
+
+        assert a.state is RequestState.SHED
+        assert a.shed_reason == "spec_overflow"
+        assert b.state is RequestState.FINISHED
+        assert b.generated == offline_short
+        snap = registry.snapshot()
+        assert snap['serve_shed_total{reason="spec_overflow"}'] == 1
+        engine.pool.check()
+        assert engine.pool.in_use == 0
+
+    def test_rejects_spec_without_draft(self, tiny_lm):
+        cfg, _, params = tiny_lm
+        with pytest.raises(ValueError, match="draft"):
+            ServingEngine(
+                cfg, params, dataclasses.replace(ENGINE_CFG, spec_k=2),
+                dtype=jnp.float32,
+            )
+
+    def test_rejects_vocab_mismatch_draft(self, tiny_lm):
+        cfg, _, params = tiny_lm
+        bad = dataclasses.replace(draft_config(cfg, 1), vocab_size=128)
+        with pytest.raises(ValueError, match="vocab"):
+            ServingEngine(
+                cfg, params, dataclasses.replace(ENGINE_CFG, spec_k=2),
+                dtype=jnp.float32, draft_config=bad,
+                draft_params=truncate_lm_params(params, 1),
+            )
+
+
+class TestBucketedDecode:
+    def test_held_steps_form_larger_batches_same_output(self, tiny_lm):
+        """decode_buckets holds the decode phase while supply can reach a
+        bigger bucket: the held-steps counter ticks, total decode steps do
+        not increase vs the unbucketed parity run, and — the invariant
+        that makes holding safe — every output is still bit-identical."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(1, 255, size=n).astype(np.int32)
+            for n in PROMPT_LENS
+        ]
+        offline = [
+            _offline_greedy(model, params, p, MAX_NEW) for p in prompts
+        ]
+        clock = FakeClock()
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg, params,
+            dataclasses.replace(ENGINE_CFG, decode_buckets=(2, 3)),
+            dtype=jnp.float32, clock=clock, registry=registry,
+        )
+        arrive_at_step = {0: [0, 1, 2], 2: [3, 4], 4: [5], 6: [6, 7]}
+        reqs = {}
+        step = 0
+        while step in arrive_at_step or not engine.scheduler.idle():
+            for i in arrive_at_step.get(step, []):
+                reqs[i] = engine.submit(prompts[i], MAX_NEW)
+            engine.step()
+            clock.advance(1.0)
+            step += 1
+            assert step < 500, "engine did not drain"
+        for i, expect in enumerate(offline):
+            assert reqs[i].generated == expect
+        assert registry.snapshot()["serve_decode_held_steps"] > 0
 
 
 class TestEngineValidation:

@@ -71,6 +71,20 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--decode_shape", action="append", default=[],
                         metavar="BxLxHkvxD",
                         help="decode KV-buffer shape to tune (repeatable)")
+    parser.add_argument("--decode_buckets", action="append", default=[],
+                        metavar="BxLxHkvxD",
+                        help="tune the decode schedule PER (batch, context) "
+                        "bucket over this gathered-pool shape — the serving "
+                        "engine consults the matching bucket entry every "
+                        "step when launched with use_kernel deferred to "
+                        "the DB (repeatable)")
+    parser.add_argument("--spec_k", type=int, default=None,
+                        metavar="DRAFT_LAYERS",
+                        help="search the speculative proposal depth k "
+                        "end-to-end for a DRAFT_LAYERS-layer self-draft: "
+                        "races real serving engines per candidate k and "
+                        "records the winner with its measured acceptance "
+                        "rate")
     parser.add_argument("--heads", type=int, default=None,
                         help="query heads for decode tuning (default: Hkv "
                         "— no GQA)")
@@ -265,6 +279,56 @@ def selftest() -> int:
             "corrupt DB consult degrades to None, never raises",
         )
 
+        # 6. Per-(batch, context)-bucket decode schedules: every bucket
+        # records its own winner and the live-value consult (the serving
+        # engine's per-step lookup) buckets its way to the right entry.
+        bucket_shape = (2, 64, 2, 16)
+        buckets = autotune.tune_decode_buckets(
+            bucket_shape, db=db, blocks=(16,), repeats=1,
+            batch_buckets=(1, 2), context_buckets=(32, 64),
+        )
+        check(len(buckets) == 4, f"decode buckets tuned: {len(buckets)}")
+        db.save()
+        autotune.set_default_db(autotune.TuningDB.load(db_path))
+        try:
+            live = autotune.tuned_decode_bucket(
+                2, 40, bucket_shape, jnp.float32
+            )  # batch 2 -> bucket 2, context 40 -> bucket 64
+            check(
+                live is not None and live.get("schedule") in
+                ("kernel", "einsum"),
+                f"live (2, 40) consult finds its bucket entry: {live}",
+            )
+        finally:
+            autotune.set_default_db(None)
+        check(
+            autotune.tuned_decode_bucket(2, 40, bucket_shape, jnp.float32)
+            is None,
+            "bucket consult without a DB degrades to None, never raises",
+        )
+
+        # 7. Speculative depth search: real engines race per candidate k
+        # (greedy parity makes it a pure throughput race), the winner and
+        # its measured acceptance rate persist and round-trip.
+        spec = autotune.tune_spec_k(
+            draft_layers=1, db=db, candidates=(0, 2),
+            num_requests=2, max_new_tokens=8,
+        )
+        check(
+            isinstance(spec.get("spec_k"), int) and spec["spec_k"] in (0, 2),
+            f"spec_k tuned: {spec}",
+        )
+        db.save()
+        autotune.set_default_db(autotune.TuningDB.load(db_path))
+        try:
+            from deeplearning_mpi_tpu.models import (
+                TransformerConfig as _TC,
+            )
+            back = autotune.tuned_spec_k(_TC.tiny(), 1, jnp.float32)
+            check(back == spec, f"spec_k entry round-trips: {back}")
+        finally:
+            autotune.set_default_db(None)
+
     print("tune-smoke " + ("OK" if ok else "FAILED"), file=sys.stderr)
     return 0 if ok else 1
 
@@ -282,9 +346,11 @@ def main(argv: list[str] | None = None) -> int:
         bootstrap.set_virtual_cpu_devices(args.virtual_devices)
     if args.selftest:
         return selftest()
-    if not args.attn_shape and not args.decode_shape and not args.step:
-        print("nothing to tune: pass --attn_shape, --decode_shape, and/or "
-              "--step (or --selftest)", file=sys.stderr)
+    if not (args.attn_shape or args.decode_shape or args.decode_buckets
+            or args.step or args.spec_k is not None):
+        print("nothing to tune: pass --attn_shape, --decode_shape, "
+              "--decode_buckets, --spec_k, and/or --step (or --selftest)",
+              file=sys.stderr)
         return 1
 
     import jax
@@ -313,6 +379,22 @@ def main(argv: list[str] | None = None) -> int:
             repeats=args.repeats,
         )
         print(f"flash_decode {spec}: {params}", file=sys.stderr)
+    for spec in args.decode_buckets:
+        shape = _parse_shape(spec, "--decode_buckets")
+        entries = autotune.tune_decode_buckets(
+            shape, dtype, heads=args.heads, db=db, blocks=blocks,
+            repeats=args.repeats,
+        )
+        kernels = sum(1 for p in entries.values() if p["schedule"] == "kernel")
+        print(f"decode buckets {spec}: {len(entries)} bucket entries "
+              f"({kernels} kernel, {len(entries) - kernels} einsum)",
+              file=sys.stderr)
+    if args.spec_k is not None:
+        params = autotune.tune_spec_k(
+            draft_layers=args.spec_k, dtype=dtype, db=db,
+        )
+        print(f"spec_k (draft_layers={args.spec_k}): {params}",
+              file=sys.stderr)
     for spec in args.step:
         batch, seq = _parse_shape(spec, "--step", ndims=2, example="8x2048")
         grad_accums = tuple(int(g) for g in args.grad_accums.split(","))

@@ -10,7 +10,16 @@ cost tracks the filled prefix — and `generate()` tok/s on a ~110M LM at 2k
 context. Timings sync via a device→host fetch; each TPU invocation is one
 bounded compile + short loop (tunnel discipline, BASELINE.md).
 
-Usage: python tools/bench_decode.py [--max_len 2048] [--e2e] [--platform cpu]
+``--spec`` adds the speculative + large-batch serving arm
+(``bench.bench_spec_decode``): the paged engine at batch N with a
+truncated self-draft vs the single-stream ``--e2e`` harness, reporting
+positions/s, accepted-tokens/s, the measured acceptance rate, and the
+consulted decode-bucket tuning entries. Its LAST stdout line is the same
+combined-JSON schema ``bench.py`` emits, so downstream consumers parse
+both tools identically.
+
+Usage: python tools/bench_decode.py [--max_len 2048] [--e2e] [--spec]
+       [--tuning_db tuned.json] [--platform cpu]
 """
 
 from __future__ import annotations
@@ -302,6 +311,24 @@ def main(argv: list[str] | None = None) -> int:
                         help="also run the ~110M-LM generate() end-to-end")
     parser.add_argument("--quantize", default="none", choices=("none", "int8"),
                         help="weight-only int8 kernels for the --e2e model")
+    parser.add_argument("--spec", action="store_true",
+                        help="also run the speculative + large-batch paged "
+                        "engine vs the single-stream harness "
+                        "(bench.bench_spec_decode) and emit the bench.py "
+                        "combined-JSON line last")
+    parser.add_argument("--spec_batch", type=int, default=32,
+                        help="concurrent requests in the --spec engine arm")
+    parser.add_argument("--spec_k", type=int, default=1,
+                        help="draft proposals per sequence per verify step")
+    parser.add_argument("--draft_layers", type=int, default=1,
+                        help="self-draft depth (target layers reused)")
+    parser.add_argument("--spec_context", type=int, default=128,
+                        help="total positions per request in the --spec arms")
+    parser.add_argument("--spec_new_tokens", type=int, default=96,
+                        help="generated tokens per request in the --spec arms")
+    parser.add_argument("--tuning_db", default=None, metavar="PATH",
+                        help="tuning DB to consult (decode-bucket entries "
+                        "land in the combined line's tuning_provenance)")
     parser.add_argument("--platform", default=None, choices=("cpu", "tpu"))
     args = parser.parse_args(argv)
 
@@ -309,6 +336,10 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    if args.tuning_db:
+        from deeplearning_mpi_tpu.compiler import autotune
+
+        autotune.set_default_db(args.tuning_db)
 
     fills = args.fills or [args.max_len // 8, args.max_len // 2, args.max_len]
     bench_attention(
@@ -320,6 +351,31 @@ def main(argv: list[str] | None = None) -> int:
         bench_e2e(
             args.max_len, quantize=args.quantize, kv_heads=args.num_kv_heads
         )
+    if args.spec:
+        # bench.py owns the three-arm measurement (spec engine, plain
+        # engine, single-stream baseline); this tool reuses it so the
+        # micro-bench and the headline bench can never disagree on recipe.
+        import bench
+
+        detail = bench.bench_spec_decode(
+            context=args.spec_context, new_tokens=args.spec_new_tokens,
+            batch=args.spec_batch, spec_k=args.spec_k,
+            draft_layers=args.draft_layers,
+        )
+        print(json.dumps({
+            "metric": "lm_110m_spec_decode_positions_per_sec",
+            "value": detail.get("positions_per_s"),
+            "accepted_tokens_per_s": detail.get("accepted_tokens_per_s"),
+            "acceptance_rate": detail.get("acceptance_rate"),
+            "unit": "positions/s",
+        }), flush=True)
+        # LAST line: the exact combined schema bench.py's driver parses,
+        # with this run's detail (and its consulted decode-bucket entries)
+        # under details.lm_spec_decode / details.tuning_provenance.
+        details = {"lm_spec_decode": detail}
+        if detail.get("tuning_provenance"):
+            details["tuning_provenance"] = detail["tuning_provenance"]
+        print(bench._combined_line(details), flush=True)
     return 0
 
 
