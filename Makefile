@@ -4,16 +4,17 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
+.PHONY: verify selftest check smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
-# serve-smoke, spec-smoke, chaos-smoke, tune-smoke, pod-smoke, and
-# overlap-smoke prerequisites gate the tier-1 run on the serving engine's
-# end-to-end parity selftest, the speculative-decode parity/reconciliation
-# drill, the fault-injection recovery drill, the autotune loop, the
-# elastic-pod rank-failure drill, and the overlapped-ZeRO-1 bit-equality
-# drill without touching the ROADMAP command itself.
-verify: serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke
+# serve-smoke, spec-smoke, chaos-smoke, tune-smoke, pod-smoke,
+# overlap-smoke, and fleet-smoke prerequisites gate the tier-1 run on the
+# serving engine's end-to-end parity selftest, the speculative-decode
+# parity/reconciliation drill, the fault-injection recovery drill, the
+# autotune loop, the elastic-pod rank-failure drill, the overlapped-ZeRO-1
+# bit-equality drill, and the serving-fleet replica-failure drill without
+# touching the ROADMAP command itself.
+verify: serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -102,3 +103,16 @@ chaos-smoke:
 pod-smoke:
 	env JAX_PLATFORMS=cpu python tools/pod_drill.py --fault rank_kill \
 		--root /tmp/dmt_pod_smoke
+
+# Serving-fleet replica-failure drill (docs/SERVING.md "Fault-tolerant
+# fleet", docs/TPU_POD_RUNBOOK.md §8): a 2-replica CPU fleet under a
+# trace-replay burst loses replica 0 to a planned replica_kill and
+# replica 1 to a replica_hang; the supervisor must re-dispatch every
+# in-flight request to a survivor (original arrival/deadline preserved),
+# respawn both, and roll a zero-downtime weight swap through the fleet —
+# with every completed stream bit-identical to offline greedy, zero
+# dropped requests, zero post-warmup compiles, and the chaos books
+# reconciled in fleet_metrics.jsonl.
+fleet-smoke:
+	env JAX_PLATFORMS=cpu python tools/fleet_drill.py --fault kill_hang \
+		--root /tmp/dmt_fleet_smoke

@@ -570,6 +570,103 @@ def bench_allreduce() -> dict:
     return measure_collective_latency(create_mesh(), num_floats=25_600_000)
 
 
+def bench_fleet(replicas: int = 2) -> dict:
+    """Failover-recovery latency of the fault-tolerant serving fleet.
+
+    A ``replicas``-worker CPU fleet (``serving/fleet.py``) serves a
+    burst+trickle trace while a planned ``replica_kill`` takes one worker
+    down mid-decode. The headline is the **failover-recovery latency**:
+    detection (exit reaped / progress stall) → every orphaned request
+    re-dispatched to a survivor and completed — the ``recovery_latency_s``
+    histogram the chaos injector keeps. TTFT p50/p99 before/during/after
+    the failure ride along so the latency a client actually sees through
+    the failover is visible next to the supervisor-side number.
+
+    The model is deliberately the serve-smoke tiny shape: this entry
+    measures the supervision/re-dispatch control plane, not model FLOPs —
+    the fleet workers are CPU processes by design (the supervisor is
+    host-side policy), so the entry forces ``JAX_PLATFORMS=cpu`` in the
+    workers regardless of the bench platform.
+    """
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning_mpi_tpu.serving import FleetSupervisor
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    model_spec = {
+        "vocab_size": 256, "num_layers": 2, "num_heads": 2,
+        "num_kv_heads": None, "head_dim": 16, "d_model": 64, "d_ff": 128,
+        "attention_window": None,
+    }
+    engine_spec = {
+        "max_slots": 3, "block_size": 8, "num_blocks": 32,
+        "max_blocks_per_seq": 6, "prefill_chunk": 8, "max_queue": 64,
+    }
+    rng = np.random.default_rng(7)
+    n_burst, n_trickle, max_new = 12, 12, 6
+    entries = []
+    for i in range(n_burst + n_trickle):
+        n = int(rng.integers(3, 21))
+        entries.append({
+            "arrival": 0.0 if i < n_burst else (i - n_burst + 1) * 0.08,
+            "prompt": [int(t) for t in rng.integers(1, 256, size=n)],
+            "max_new": max_new,
+            "deadline": 0.0,
+        })
+
+    env = {
+        "PYTHONPATH": os.pathsep.join(
+            p for p in (repo, os.environ.get("PYTHONPATH", "")) if p
+        ),
+        "JAX_PLATFORMS": "cpu",
+        "JAX_COMPILATION_CACHE_DIR": os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR", os.path.join(repo, ".jax_cache")
+        ),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.3",
+    }
+    heartbeat_deadline_s = 3.0
+    fleet_dir = tempfile.mkdtemp(prefix="dmt_bench_fleet_")
+    sup = FleetSupervisor(
+        model_spec, engine_spec, replicas, fleet_dir,
+        seed=0,
+        chaos="replica_kill@step:4",
+        heartbeat_interval_s=0.2,
+        heartbeat_deadline_s=heartbeat_deadline_s,
+        spawn_grace_s=600.0,
+        max_replica_restarts=4,
+        timeout_s=480.0,
+        env=env,
+    )
+    t0 = time.perf_counter()
+    result = sup.run(entries)
+    wall = time.perf_counter() - t0
+    snap = result.snapshot
+    tokens = sum(len(r["tokens"]) for r in result.requests.values())
+    return {
+        "replicas": replicas,
+        "requests": len(entries),
+        "completed": result.completed,
+        "dropped": result.dropped,
+        "redispatched": result.redispatched,
+        "restarts": result.restarts,
+        # Supervisor-side: detection -> books closed (orphans completed).
+        "failover_recovery_s_p50": snap.get("recovery_latency_s_p50"),
+        "failover_recovery_s_max": snap.get("recovery_latency_s_max"),
+        # Client-side: what the failure did to first-token latency.
+        "ttft_before_p50_s": result.ttft.get("before_p50"),
+        "ttft_during_p50_s": result.ttft.get("during_p50"),
+        "ttft_during_p99_s": result.ttft.get("during_p99"),
+        "ttft_after_p50_s": result.ttft.get("after_p50"),
+        "detect_budget_s": heartbeat_deadline_s,
+        "wall_s": round(wall, 2),
+        "generated_tokens_per_s": round(tokens / wall, 1),
+        "chaos_balanced": result.chaos_balanced,
+        "fleet_ok": result.ok,
+    }
+
+
 def _kill_group(proc) -> None:
     """SIGKILL a child's whole process group, then reap it. The child may
     spawn helpers (tunnel client) that inherit the pipes; killing only the
@@ -643,6 +740,7 @@ def _combined_line(details: dict, error: str | None = None) -> str:
     unet = details.get("unet2d_512px") or {}
     serving = (details.get("lm_serving_2k") or {}).get("per_batch", {})
     spec = details.get("lm_spec_decode") or {}
+    fleet = details.get("serving_fleet") or {}
     allreduce = details.get("allreduce") or {}
     out = {
         "metric": "resnet50_bf16_images_per_sec_per_chip",
@@ -679,6 +777,10 @@ def _combined_line(details: dict, error: str | None = None) -> str:
             "speedup_vs_single_stream"
         ),
         "spec_acceptance_rate": spec.get("acceptance_rate"),
+        # Fleet robustness headline (ISSUE 8): detection -> orphans
+        # completed on a survivor, and the client-visible TTFT hit.
+        "fleet_failover_recovery_s": fleet.get("failover_recovery_s_p50"),
+        "fleet_ttft_during_p99_s": fleet.get("ttft_during_p99_s"),
         "allreduce_latency_ms": allreduce.get("all_reduce_ms_mean"),
         "details": details,
     }
@@ -698,6 +800,8 @@ def _build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--skip_decode", action="store_true")
     parser.add_argument("--skip_spec", action="store_true",
                         help="skip the speculative+batched serving workload")
+    parser.add_argument("--skip_fleet", action="store_true",
+                        help="skip the serving-fleet failover workload")
     parser.add_argument("--spec_batch", type=int, default=32,
                         help="concurrent requests in the lm_spec_decode "
                         "engine arm (the >=5x target holds for 8-32)")
@@ -761,6 +865,8 @@ def _child_main(args) -> int:
         detail = bench_decode()
     elif key == "lm_spec_decode":
         detail = bench_spec_decode(batch=args.spec_batch)
+    elif key == "serving_fleet":
+        detail = bench_fleet()
     elif key == "allreduce":
         detail = bench_allreduce()
     else:
@@ -940,6 +1046,16 @@ def main() -> None:
             unit="positions/s", value_key="positions_per_s",
             # Engine warmup + two arms' compiles through the tunnel.
             budget_s=max(args.workload_timeout, 1800.0),
+        )
+
+    if not args.skip_fleet:
+        run(
+            "serving_fleet",
+            metric="serving_fleet_failover_recovery_s", unit="s",
+            value_key="failover_recovery_s_p50",
+            # 2 worker processes each paying a (cached) warmup compile,
+            # plus one respawn after the planned kill.
+            budget_s=max(args.workload_timeout, 900.0),
         )
 
     run(

@@ -130,13 +130,36 @@ def build_parser() -> argparse.ArgumentParser:
     trace.add_argument("--eos_id", type=int, default=-1,
                        help="byte value that finishes a sequence (-1 = off)")
     trace.add_argument("--random_seed", type=int, default=0)
+    fleet = parser.add_argument_group(
+        "fleet (replicated serving: supervised replica processes behind "
+        "the SLO-aware router — docs/SERVING.md)"
+    )
+    fleet.add_argument("--replicas", type=int, default=1,
+                       help="serve through N supervised replica processes "
+                       "(1 = single in-process engine); fleet mode implies "
+                       "--selftest semantics (random-init model, parity "
+                       "check against offline greedy)")
+    fleet.add_argument("--hedge_ms", type=float, default=0.0,
+                       help="hedged-retry threshold: a request outstanding "
+                       "this long (with deadline budget left) is duplicated "
+                       "on a second replica; first completion wins, the "
+                       "loser is cancelled (0 = hedging off)")
+    fleet.add_argument("--swap_at", type=int, default=None,
+                       help="after N completions, hot-swap every replica's "
+                       "weights (rolling drain, zero downtime, zero "
+                       "recompiles) to a fresh init from --random_seed + 1")
+    fleet.add_argument("--fleet_dir", default=None,
+                       help="scratch directory for replica mailboxes, "
+                       "heartbeats, and logs (default: a fresh temp dir)")
     parser.add_argument("--metrics_file", default=None,
                         help="append canonical telemetry JSONL records here "
                         "(readable by tools/metrics_report.py)")
     parser.add_argument("--chaos", default=None,
                         help="deterministic fault-injection spec, e.g. "
                         "'serve_crash@step:12' — the engine crashes mid-step "
-                        "and recovers (requeue + KV reconcile); falls back "
+                        "and recovers (requeue + KV reconcile); with "
+                        "--replicas N > 1: 'replica_kill@step:4,"
+                        "replica_hang@step:6' (fleet faults); falls back "
                         "to $DMT_CHAOS (docs/RESILIENCE.md)")
     parser.add_argument("--selftest", action="store_true",
                         help="random-init tiny-ish model, synthetic trace, "
@@ -284,6 +307,142 @@ def _report(reqs, wall_s, registry, out=sys.stderr):
         )
 
 
+def _run_fleet(args, eos_id) -> int:
+    """--replicas N > 1: route the trace through a supervised replica
+    fleet instead of one in-process engine, then hold every completion to
+    the same offline-greedy parity bar as --selftest — including requests
+    that failed over between replicas mid-flight."""
+    import tempfile
+
+    from deeplearning_mpi_tpu.serving import FleetFailure, FleetSupervisor
+    from deeplearning_mpi_tpu.telemetry import JsonlSink, MetricsRegistry
+
+    if args.spec_k:
+        print("--replicas > 1 does not compose with --spec_k yet",
+              file=sys.stderr)
+        return 1
+    model_spec = {
+        "vocab_size": 256,
+        "num_layers": args.num_layers,
+        "num_heads": args.num_heads,
+        "num_kv_heads": args.num_kv_heads or None,
+        "head_dim": args.head_dim,
+        "d_model": args.d_model,
+        "d_ff": args.d_ff,
+        "attention_window": args.attention_window,
+    }
+    engine_spec = {
+        "max_slots": args.max_slots,
+        "block_size": args.block_size,
+        "num_blocks": args.num_blocks,
+        "max_blocks_per_seq": args.max_blocks_per_seq,
+        "prefill_chunk": args.prefill_chunk,
+        "max_queue": args.max_queue,
+    }
+    if args.trace:
+        entries = _load_trace(args.trace, args.max_new_tokens, args.deadline)
+    else:
+        entries = _poisson_trace(args)
+    fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="dmt_fleet_")
+    registry = MetricsRegistry()
+    if args.metrics_file:
+        registry.add_sink(JsonlSink(args.metrics_file))
+    sup = FleetSupervisor(
+        model_spec, engine_spec, args.replicas, fleet_dir,
+        seed=args.random_seed, eos_id=eos_id, warmup=True,
+        chaos=args.chaos, hedge_ms=args.hedge_ms, registry=registry,
+    )
+    swap_seed = args.random_seed + 1 if args.swap_at is not None else None
+    try:
+        result = sup.run(entries, swap_at=args.swap_at, swap_seed=swap_seed)
+    except FleetFailure as e:
+        print(f"fleet FAILED: {e} (logs under {fleet_dir})", file=sys.stderr)
+        return 1
+    shed = ", ".join(f"{n} {why}" for why, n in sorted(result.shed.items()))
+    print(
+        f"fleet: {result.completed} completed, "
+        f"{sum(result.shed.values())} shed" + (f" ({shed})" if shed else "")
+        + f", {result.dropped} dropped | {result.redispatched} re-dispatched "
+        f"across {result.restarts} restart(s)",
+        file=sys.stderr,
+    )
+    snap = result.snapshot
+    if snap.get("serve_hedge_total", 0):
+        parts = []
+        for k in sorted(snap):
+            if k.startswith("serve_hedge_total{"):
+                outcome = k.split("=", 1)[1].strip('"}')
+                parts.append(f"{snap[k]:.0f} {outcome}")
+        print("hedges: " + ", ".join(parts), file=sys.stderr)
+    if result.swap["requested"]:
+        print(
+            f"swap: performed={result.swap['performed']} "
+            f"drain={result.swap['drain_s'] and round(result.swap['drain_s'], 2)}s "
+            f"completions_during={result.swap['completions_during']} "
+            f"compile_flat={result.swap['compile_flat']}",
+            file=sys.stderr,
+        )
+    registry.close()
+
+    # Fleet parity: rebuild each weight version from (config, seed) and
+    # hold every winning stream to offline greedy — the failover and
+    # hedging machinery must be invisible in the tokens.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.generate import generate
+
+    model = TransformerLM(
+        config=TransformerConfig(**model_spec), dtype=jnp.float32
+    )
+    params_by_version = {}
+
+    def version_params(version):
+        if version not in params_by_version:
+            seed = args.random_seed if version == 0 else swap_seed
+            params_by_version[version] = model.init(
+                jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        return params_by_version[version]
+
+    mismatched = 0
+    for rid, rec in sorted(result.requests.items()):
+        out = generate(
+            model, version_params(rec["version"]),
+            jnp.asarray(rec["prompt"], jnp.int32)[None],
+            max_new_tokens=rec["max_new"], rng=jax.random.key(0),
+            temperature=0.0, eos_id=eos_id,
+        )
+        expect = np.asarray(out)[0, len(rec["prompt"]):].tolist()
+        if eos_id is not None and eos_id in expect:
+            expect = expect[: expect.index(eos_id) + 1]
+        if rec["tokens"] != expect:
+            mismatched += 1
+            print(
+                f"fleet parity: rid {rid} (version {rec['version']}) "
+                f"diverged from offline greedy:\n"
+                f"  fleet  : {rec['tokens']}\n  offline: {expect}",
+                file=sys.stderr,
+            )
+    if mismatched or not result.ok:
+        print(
+            f"fleet FAILED: ok={result.ok} (dropped={result.dropped}, "
+            f"compile_flat={result.compile_flat}, "
+            f"chaos_balanced={result.chaos_balanced}), "
+            f"{mismatched} parity mismatch(es); logs under {fleet_dir}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"fleet OK: {result.completed} requests bit-identical to offline "
+        f"greedy across {args.replicas} replicas",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     eos_id = args.eos_id if args.eos_id >= 0 else None
@@ -291,6 +450,34 @@ def main(argv: list[str] | None = None) -> int:
         print(f"--eos_id {eos_id} is outside the byte vocab (0-255)",
               file=sys.stderr)
         return 1
+    # Fail loud on chaos kinds this workload has no injection hook for:
+    # a kind that can never fire would silently pass every drill while
+    # keeping the reconciliation invariant unfalsifiable.
+    import os as _os
+
+    chaos_spec = args.chaos or _os.environ.get("DMT_CHAOS") or ""
+    if chaos_spec.strip():
+        from deeplearning_mpi_tpu.resilience import (
+            FLEET_KINDS,
+            SERVE_KINDS,
+            validate_plan_kinds,
+        )
+
+        supported = FLEET_KINDS if args.replicas > 1 else SERVE_KINDS
+        workload = (
+            "serving fleet" if args.replicas > 1 else "single-replica serving"
+        )
+        try:
+            validate_plan_kinds(chaos_spec, supported, workload=workload)
+        except ValueError as e:
+            print(f"--chaos: {e}", file=sys.stderr)
+            return 1
+    if args.replicas > 1:
+        if args.platform:
+            import jax
+
+            jax.config.update("jax_platforms", args.platform)
+        return _run_fleet(args, eos_id)
     if args.moe_experts > 0:
         # Same fail-fast rule as dmt-generate's composition checks: the
         # engine would raise anyway, but before minutes of init/restore.

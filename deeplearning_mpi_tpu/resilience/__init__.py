@@ -24,11 +24,15 @@ rollback_total`` is the chaos harness's own acceptance check
 """
 
 from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
+    FLEET_KINDS,
+    SERVE_KINDS,
     ChaosInjector,
     FaultPlan,
     FaultSpec,
     InjectedFault,
     InjectedKill,
+    fleet_entries,
+    validate_plan_kinds,
 )
 from deeplearning_mpi_tpu.resilience.integrity import (  # noqa: F401
     CheckpointCorruption,
@@ -59,6 +63,7 @@ from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F4
 __all__ = [
     "ChaosInjector",
     "CheckpointCorruption",
+    "FLEET_KINDS",
     "FaultPlan",
     "FaultSpec",
     "GracefulShutdown",
@@ -71,12 +76,15 @@ __all__ = [
     "PodSupervisor",
     "Preempted",
     "ResilientLoader",
+    "SERVE_KINDS",
     "TrainingFailure",
     "atomic_write_json",
     "corrupt_checkpoint",
     "dir_digests",
+    "fleet_entries",
     "preflight",
     "restart_delay",
     "run_with_auto_resume",
     "tree_digests",
+    "validate_plan_kinds",
 ]

@@ -35,6 +35,14 @@ kind             unit    injection site
                          while its heartbeat daemon keeps beating — the
                          hung-collective shape: liveness must watch progress,
                          not file freshness
+``replica_kill``  step   a serving-fleet replica worker hard-exits mid-decode
+                         — the fleet supervisor must re-dispatch its
+                         in-flight requests to a survivor
+``replica_hang``  step   a replica's serving loop wedges while its heartbeat
+                         daemon keeps beating — liveness-by-progress again,
+                         now for serving
+``replica_slow``  step   every replica step gains ``stall_s`` of latency from
+                         the trigger on — the router's hedged-retry path
 ===============  ======  =====================================================
 
 ``rank_kill``/``rank_hang`` are *pod-level* kinds (:data:`POD_KINDS`): the
@@ -43,7 +51,10 @@ the pod supervisor (:mod:`.pod`) carries their accounting — it marks the
 spec fired when it observes the failure (:meth:`ChaosInjector.fire_observed`)
 and records the recovery when the re-formed world makes progress. The target
 rank defaults to the last rank (``process_count - 1``); ``$DMT_CHAOS_RANK``
-overrides.
+overrides. ``replica_*`` kinds (:data:`FLEET_KINDS`) follow the same
+split for the serving fleet: the replica worker detonates through
+:meth:`ChaosInjector.check_replica_fault`, and the fleet supervisor
+(:mod:`deeplearning_mpi_tpu.serving.fleet`) owns the accounting.
 
 Accounting contract (the reconciliation invariant): every fault increments
 ``fault_injected_total`` exactly once when it first fires, and the layer
@@ -75,6 +86,7 @@ __all__ = [
     "ENV_SPEC",
     "ENV_STALL",
     "FAULT_INJECTED",
+    "FLEET_KINDS",
     "FaultPlan",
     "FaultSpec",
     "InjectedFault",
@@ -84,8 +96,11 @@ __all__ = [
     "RECOVERY",
     "RECOVERY_LATENCY",
     "ROLLBACK",
+    "SERVE_KINDS",
+    "fleet_entries",
     "pod_entries",
     "strip_entries",
+    "validate_plan_kinds",
 ]
 
 #: trigger unit per fault kind — the grammar's validity table.
@@ -98,11 +113,21 @@ FAULT_UNITS = {
     "serve_crash": "step",
     "rank_kill": "step",
     "rank_hang": "step",
+    "replica_kill": "step",
+    "replica_hang": "step",
+    "replica_slow": "step",
 }
 
 #: kinds whose accounting lives in the pod supervisor, not the worker: the
 #: faulted process is dead or wedged before it could emit a run_summary.
 POD_KINDS = frozenset({"rank_kill", "rank_hang"})
+
+#: serving-fleet kinds — same supervisor-side accounting split as
+#: :data:`POD_KINDS`, owned by ``serving.fleet.FleetSupervisor``.
+FLEET_KINDS = frozenset({"replica_kill", "replica_hang", "replica_slow"})
+
+#: kinds a single-replica serving engine can detonate in-process.
+SERVE_KINDS = frozenset({"serve_crash"})
 
 #: exit code of a rank_kill'd worker — distinguishable from collateral
 #: crashes (a peer's collective erroring out) in the supervisor's logs.
@@ -136,6 +161,41 @@ def pod_entries(spec: str) -> list[str]:
         for e in spec.split(",")
         if e.strip() and e.strip().split("@", 1)[0] in POD_KINDS
     ]
+
+
+def fleet_entries(spec: str) -> list[str]:
+    """The ``kind@unit:at`` tokens of ``spec`` whose kind is fleet-level."""
+    return [
+        e.strip()
+        for e in spec.split(",")
+        if e.strip() and e.strip().split("@", 1)[0] in FLEET_KINDS
+    ]
+
+
+def validate_plan_kinds(spec: str, supported: frozenset[str] | set[str],
+                        *, workload: str) -> None:
+    """Reject chaos entries whose kind the workload has no hook for.
+
+    A spec is parsed per-entry by the layer that owns each hook, so a kind
+    with no hook in this workload (``loader_stall`` handed to ``serve_lm``)
+    would otherwise be accepted and simply never fire — leaving the
+    reconciliation invariant permanently unbalanced and, worse, *looking*
+    like a recovery bug. Fail loud at parse time instead.
+    """
+    unsupported = sorted(
+        {
+            e.strip().split("@", 1)[0]
+            for e in spec.split(",")
+            if e.strip() and e.strip().split("@", 1)[0] not in supported
+        }
+    )
+    if unsupported:
+        raise ValueError(
+            f"chaos kind(s) {', '.join(unsupported)} have no injection hook "
+            f"in the {workload} workload (supported: "
+            f"{', '.join(sorted(supported))}) — they would never fire and "
+            "the reconciliation invariant could never balance"
+        )
 
 
 def strip_entries(spec: str, entries: list[str]) -> str:
@@ -360,6 +420,30 @@ class ChaosInjector:
         """Serving-engine hook, mid-step (after prefill mutated host state)."""
         if self.should_fire("serve_crash", step):
             raise InjectedFault(f"chaos: injected serve_crash@step:{step}")
+
+    def check_replica_fault(self, *, step: int) -> float:
+        """Fleet replica-worker hook, called between engine steps. Returns
+        the extra per-step latency (seconds) a fired ``replica_slow``
+        imposes — 0.0 otherwise. A kill or hang never returns.
+
+        Unlike :meth:`check_rank_fault` there is no rank targeting: the
+        fleet supervisor hands each replica only the entries aimed at it
+        (per-replica ``$DMT_CHAOS``), so whoever holds the spec is the
+        target. ``replica_slow`` fires once at its trigger (counting the
+        fault) and the slowdown then PERSISTS for the rest of the worker's
+        life — a degraded replica, not a one-step blip — which is what
+        gives the router's hedging something to beat.
+        """
+        if self.should_fire("replica_kill", step):
+            _exit_rank(step)
+        if self.should_fire("replica_hang", step):
+            _hang_rank(step)
+        self.should_fire("replica_slow", step)
+        if any(
+            s.kind == "replica_slow" and s.fired for s in self.plan.specs
+        ):
+            return self.stall_s
+        return 0.0
 
     def maybe_poison(self, batch: Any, task: str, *, step: int) -> Any:
         """Trainer hook: return a NaN-poisoned copy of ``batch`` when a

@@ -11,6 +11,11 @@ from deeplearning_mpi_tpu.serving.engine import (
     PagedForward,
     ServingEngine,
 )
+from deeplearning_mpi_tpu.serving.fleet import (
+    FleetFailure,
+    FleetResult,
+    FleetSupervisor,
+)
 from deeplearning_mpi_tpu.serving.kv_pool import (
     SCRATCH_BLOCK,
     PagedKVPool,
@@ -21,14 +26,19 @@ from deeplearning_mpi_tpu.serving.scheduler import (
     RequestState,
     Scheduler,
 )
+from deeplearning_mpi_tpu.serving.router import Router
 from deeplearning_mpi_tpu.serving.speculative import SpeculativeDecoder
 
 __all__ = [
     "EngineConfig",
+    "FleetFailure",
+    "FleetResult",
+    "FleetSupervisor",
     "PagedForward",
     "PagedKVPool",
     "Request",
     "RequestState",
+    "Router",
     "SCRATCH_BLOCK",
     "Scheduler",
     "ServingEngine",

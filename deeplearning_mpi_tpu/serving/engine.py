@@ -754,9 +754,20 @@ class ServingEngine:
         max_new_tokens: int,
         *,
         deadline: Optional[float] = None,
+        arrival: Optional[float] = None,
     ) -> Request:
         """Enqueue one request (or shed it at the door — check
-        ``req.state``). ``prompt`` is a 1-D int sequence."""
+        ``req.state``). ``prompt`` is a 1-D int sequence.
+
+        ``arrival`` overrides the arrival stamp (same clock as the
+        engine's). Re-dispatch paths — a fleet supervisor moving a dead
+        replica's request to a survivor — MUST pass the original arrival:
+        a fresh stamp would silently grant the request a brand-new SLO
+        budget, hiding exactly the deadline misses a failover causes.
+        In-process ``recover()`` already keeps it (``Scheduler.requeue``
+        preserves ``arrival``/``deadline``); this extends the same
+        contract across the process boundary.
+        """
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -765,7 +776,7 @@ class ServingEngine:
             rid=self._next_rid,
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=max_new_tokens,
-            arrival=self._clock(),
+            arrival=self._clock() if arrival is None else arrival,
             deadline=deadline,
         )
         self._next_rid += 1
@@ -773,6 +784,14 @@ class ServingEngine:
         if not self.scheduler.submit(req):
             self._inc("serve_requests_shed")
         return req
+
+    def cancel(self, req: Request) -> bool:
+        """Shed ``req`` wherever it currently lives (hedged-retry dedup —
+        the other copy won). False when already finished/shed."""
+        if self.scheduler.cancel(req):
+            self._inc("serve_requests_shed")
+            return True
+        return False
 
     def step(self) -> list[Request]:
         """One engine iteration: shed expired → admit → one prefill chunk

@@ -1,0 +1,1017 @@
+"""Fault-tolerant serving fleet: supervised replicas behind a router.
+
+One :class:`~.engine.ServingEngine` is a single point of failure; ROADMAP
+item 1's millions-of-users direction needs N of them behind admission
+control. This module runs each replica as a supervised OS process (the
+same worker/supervisor split as :mod:`~..resilience.pod`, reusing its
+:class:`~..resilience.supervisor.Heartbeat` /
+:class:`~..resilience.pod.LivenessTracker` machinery to separate dead,
+hung, and merely slow replicas) and fronts them with the
+:class:`~.router.Router`'s policy: lowest-load replica selection off the
+``serve_*`` telemetry each heartbeat carries, deadline-budgeted hedged
+retries against slow replicas, and an exclusion window for the recently
+dead.
+
+Robustness contract (drilled by ``tools/fleet_drill.py`` / ``make
+fleet-smoke``):
+
+- **Failover re-dispatch.** When a replica dies (exit observed) or wedges
+  (heartbeat fresh, ``progress_seq`` frozen — the daemon thread beats
+  through a hang), its in-flight requests are re-dispatched *from their
+  prompts* to a survivor, carrying their ORIGINAL arrival/deadline
+  (`ServingEngine.submit(arrival=...)`) so failover never mints fresh SLO
+  budget. Restarting from the prompt is what keeps every completed stream
+  bit-identical to offline greedy — the same parity bar as in-process
+  ``recover()``.
+- **Hedged retries.** A request outstanding past ``hedge_ms`` with budget
+  left gets a duplicate on a second replica; first completion wins, the
+  loser is cancelled, exactly one stream reaches the client
+  (``serve_hedge_total{outcome}`` accounts every case).
+- **Hot weight swap.** :meth:`FleetSupervisor.swap_weights` (driven by
+  ``run(swap_at=...)``) rolls through the fleet: drain one replica's
+  outstanding work (router exclusion — in-flight requests complete, new
+  ones go elsewhere), swap its params in place (same shapes/dtypes ⇒ the
+  warmed programs retrace nothing; ``serve_compile_total`` must stay
+  flat), re-include, next replica. The fleet keeps serving throughout —
+  zero downtime, zero dropped requests.
+
+Chaos: ``replica_kill`` / ``replica_hang`` / ``replica_slow``
+(:data:`~..resilience.faults.FLEET_KINDS`) detonate inside a worker via
+:meth:`ChaosInjector.check_replica_fault`; the supervisor owns their
+books (``fire_observed`` on detection, ``record_recovery`` when the
+re-dispatched work completes), reconciled under the same
+``fault_injected_total == recovery_total + rollback_total`` invariant as
+training chaos.
+
+Wire protocol: per-replica append-only JSONL files (``inbox.jsonl``
+supervisor→worker, ``outbox.jsonl`` worker→supervisor), single writer
+each, readers tail by byte offset and consume only newline-terminated
+lines — a mid-write SIGKILL can truncate at most the final, unconsumed
+line. Arrival/deadline stamps are absolute ``time.monotonic()`` values:
+CLOCK_MONOTONIC is system-wide on Linux, so they survive the process
+boundary intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+__all__ = ["FleetFailure", "FleetResult", "FleetSupervisor", "worker_main"]
+
+FLEET_RESTARTS = "fleet_replica_restarts_total"
+FLEET_FAILURES = "fleet_replica_failures_total"
+FLEET_REDISPATCH = "fleet_redispatch_total"
+
+
+class FleetFailure(RuntimeError):
+    """The fleet cannot meet its contract (restart budget spent, run
+    timeout, every replica gone)."""
+
+
+def _tail_jsonl(path: Path, offset: int) -> tuple[list[dict], int]:
+    """Read the complete JSONL records appended past ``offset``. Only
+    newline-terminated lines are consumed — a partial trailing line (the
+    writer died mid-write, or the write raced this read) stays unread
+    until its newline lands."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    chunk = data[: end + 1]
+    out = []
+    for line in chunk.splitlines():
+        if line.strip():
+            out.append(json.loads(line))
+    return out, offset + len(chunk)
+
+
+# ---------------------------------------------------------------------------
+# worker (one process per replica)
+# ---------------------------------------------------------------------------
+
+def worker_main(argv: list[str] | None = None) -> int:
+    """Replica worker: a ServingEngine wrapped in the fleet wire protocol.
+
+    Builds the model/params from the spec file (``model.init`` from the
+    spec's seed — replicas of the same (seed, version) are bit-identical
+    by construction, which is what makes cross-replica re-dispatch
+    parity-safe), warms the engine, then loops: drain inbox ops, step the
+    engine when busy, report completions, and publish liveness + the
+    telemetry snapshot the router scores on through the heartbeat.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="fleet-worker")
+    parser.add_argument("--replica", type=int, required=True)
+    parser.add_argument("--dir", required=True)
+    parser.add_argument("--spec", required=True)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.resilience import ChaosInjector, InjectedFault
+    from deeplearning_mpi_tpu.resilience.pod import ENV_HEARTBEAT_INTERVAL
+    from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
+    from deeplearning_mpi_tpu.serving.engine import EngineConfig, ServingEngine
+    from deeplearning_mpi_tpu.serving.scheduler import RequestState
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+    rdir = Path(args.dir)
+    spec = json.loads(Path(args.spec).read_text())
+    cfg = TransformerConfig(**spec["model"])
+    model = TransformerLM(config=cfg, dtype=jnp.float32)
+
+    def init_params(seed: int):
+        # EXACTLY the serve_lm --selftest init: the drill's offline-greedy
+        # oracle rebuilds params from (config, seed) alone, so any drift
+        # here is a parity failure, not a tolerable difference.
+        return model.init(
+            jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+
+    version = int(spec.get("version", 0))
+    params = init_params(int(spec["seed"]))
+    registry = MetricsRegistry()
+    chaos = ChaosInjector.from_spec(None, registry=registry)  # $DMT_CHAOS
+    engine = ServingEngine(
+        cfg, params, EngineConfig(**spec["engine"]),
+        dtype=jnp.float32, eos_id=spec.get("eos_id"),
+        registry=registry, chaos=chaos,
+    )
+    if spec.get("warmup", True):
+        engine.warmup()
+    compile_counter = registry.counter("serve_compile_total")
+    ttft_hist = registry.histogram("serve_ttft_s")
+
+    outbox = (rdir / "outbox.jsonl").open("a")
+
+    def emit(obj: dict) -> None:
+        outbox.write(json.dumps(obj) + "\n")
+        outbox.flush()
+
+    emit({
+        "op": "ready", "replica": args.replica, "pid": os.getpid(),
+        "version": version, "compile_total": compile_counter.value,
+    })
+
+    inbox = rdir / "inbox.jsonl"
+    offset = 0
+    live: dict[int, Any] = {}  # fleet rid -> engine Request
+    cancelled: set[int] = set()
+    slow_reported = False
+    stop = False
+    hb = Heartbeat(
+        rdir / "heartbeat.json",
+        interval_s=float(os.environ.get(ENV_HEARTBEAT_INTERVAL, "0.5")),
+    )
+    hb.start()
+    try:
+        while not stop:
+            msgs, offset = _tail_jsonl(inbox, offset)
+            for m in msgs:
+                op = m["op"]
+                if op == "req":
+                    rid = int(m["rid"])
+                    if rid in cancelled:
+                        continue  # the cancel raced ahead of this copy
+                    req = engine.submit(
+                        np.asarray(m["prompt"], np.int32), int(m["max_new"]),
+                        deadline=m.get("deadline"), arrival=m.get("arrival"),
+                    )
+                    if req.state is RequestState.SHED:
+                        emit({"op": "shed", "rid": rid,
+                              "reason": req.shed_reason})
+                    else:
+                        live[rid] = req
+                elif op == "cancel":
+                    rid = int(m["rid"])
+                    cancelled.add(rid)
+                    req = live.pop(rid, None)
+                    if req is not None:
+                        engine.cancel(req)
+                elif op == "swap":
+                    # Same-shape/dtype params are an argument to the warmed
+                    # programs, not a capture — assignment swaps weights
+                    # with zero retraces. The ack carries the compile
+                    # counter so the supervisor can PROVE that.
+                    engine.params = init_params(int(m["seed"]))
+                    version = int(m["version"])
+                    emit({"op": "swapped", "version": version,
+                          "compile_total": compile_counter.value})
+                elif op == "stop":
+                    stop = True
+
+            if not stop and not engine.scheduler.idle():
+                if chaos is not None:
+                    slow_s = chaos.check_replica_fault(step=engine.steps)
+                    if slow_s > 0.0:
+                        if not slow_reported:
+                            # Alive-but-degraded is the one fleet fault the
+                            # worker CAN report itself; the supervisor still
+                            # owns the accounting (fire_observed on receipt).
+                            emit({"op": "fault", "kind": "replica_slow",
+                                  "step": engine.steps})
+                            slow_reported = True
+                        time.sleep(slow_s)
+                try:
+                    engine.step()
+                except InjectedFault:
+                    engine.recover()
+                for rid, req in list(live.items()):
+                    if req.state is RequestState.FINISHED:
+                        emit({
+                            "op": "done", "rid": rid,
+                            "tokens": [int(t) for t in req.generated],
+                            "version": version,
+                            "ttft": req.ttft, "tpot": req.tpot,
+                        })
+                        del live[rid]
+                    elif req.state is RequestState.SHED:
+                        emit({"op": "shed", "rid": rid,
+                              "reason": req.shed_reason})
+                        del live[rid]
+            elif not stop:
+                time.sleep(0.002)
+
+            # Every loop iteration bumps progress_seq — an idle replica is
+            # a live replica. Only a genuine wedge (replica_hang blocks THIS
+            # loop; the heartbeat daemon keeps the file fresh) freezes the
+            # seq, which is exactly what LivenessTracker watches.
+            hb.progress = {
+                "step": engine.steps,
+                "queue_depth": engine.scheduler.queue_depth(),
+                "slots_active": engine.scheduler.slots_active(),
+                "ttft_p50": ttft_hist.percentile(0.5) or 0.0,
+                "version": version,
+            }
+    finally:
+        hb.stop()
+    emit({
+        "op": "stopped", "version": version,
+        "compile_total": compile_counter.value,
+        "snapshot": registry.snapshot(),
+    })
+    outbox.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Replica:
+    """Supervisor-side state for one replica slot."""
+
+    idx: int
+    seed: int
+    version: int = 0
+    chaos_spec: str = ""
+    attempt: int = 0
+    dir: Optional[Path] = None
+    proc: Optional[subprocess.Popen] = None
+    log: Any = None
+    tracker: Any = None
+    outbox_offset: int = 0
+    inbox: Any = None
+    ready: bool = False
+    compile_at_ready: Optional[float] = None
+    compile_flat: bool = True
+    stopped: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class _Req:
+    """Supervisor-side ledger entry for one client request."""
+
+    rid: int
+    prompt: list[int]
+    max_new: int
+    arrival_abs: float
+    deadline_abs: Optional[float]
+    holders: set[int] = dataclasses.field(default_factory=set)
+    tokens: Optional[list[int]] = None
+    version: Optional[int] = None
+    ttft: Optional[float] = None
+    shed_reason: Optional[str] = None
+    redispatched: bool = False
+
+    @property
+    def resolved(self) -> bool:
+        return self.tokens is not None or self.shed_reason is not None
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """What a :meth:`FleetSupervisor.run` accomplished."""
+
+    ok: bool
+    completed: int
+    shed: dict[str, int]
+    dropped: int  # accepted requests that vanished — the zero-downtime bar
+    restarts: int
+    failures: dict[str, int]
+    redispatched: int
+    compile_flat: bool  # serve_compile_total flat after warmup, all workers
+    chaos_balanced: Optional[bool]
+    ttft: dict[str, Optional[float]]  # {before,during,after}_{p50,p99}
+    swap: dict[str, Any]
+    requests: dict[int, dict]  # rid -> {"tokens", "version", ...} (wins only)
+    snapshot: dict[str, Any]
+
+
+class FleetSupervisor:
+    """Spawn N replica workers, route a trace through them, survive
+    replica loss, and prove the books balance.
+
+    ``model_spec`` / ``engine_spec`` are kwargs dicts for
+    ``TransformerConfig`` / ``EngineConfig`` — shipped to workers as JSON,
+    so replicas are constructed from *specs*, never pickled arrays
+    (params rebuild from ``(config, seed, version)``; a weight swap ships
+    a new seed the same way).
+    """
+
+    def __init__(
+        self,
+        model_spec: dict,
+        engine_spec: dict,
+        num_replicas: int,
+        fleet_dir: str | Path,
+        *,
+        seed: int = 0,
+        eos_id: int | None = None,
+        warmup: bool = True,
+        chaos: str | None = None,
+        hedge_ms: float = 0.0,
+        heartbeat_deadline_s: float = 2.0,
+        heartbeat_interval_s: float = 0.2,
+        spawn_grace_s: float = 120.0,
+        poll_interval_s: float = 0.02,
+        exclusion_s: float = 0.5,
+        max_replica_restarts: int = 4,
+        timeout_s: float = 600.0,
+        registry: Any = None,
+        env: Mapping[str, str] | None = None,
+    ) -> None:
+        from deeplearning_mpi_tpu.resilience.faults import (
+            FLEET_KINDS,
+            validate_plan_kinds,
+        )
+        from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        self.model_spec = dict(model_spec)
+        self.engine_spec = dict(engine_spec)
+        self.num_replicas = num_replicas
+        self.fleet_dir = Path(fleet_dir)
+        self.seed = seed
+        self.eos_id = eos_id
+        self.warmup = warmup
+        self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
+        if self.chaos_spec.strip():
+            validate_plan_kinds(
+                self.chaos_spec, FLEET_KINDS, workload="serving fleet"
+            )
+        self.hedge_ms = hedge_ms
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.spawn_grace_s = spawn_grace_s
+        self.poll_interval_s = poll_interval_s
+        self.exclusion_s = exclusion_s
+        self.max_replica_restarts = max_replica_restarts
+        self.timeout_s = timeout_s
+        self.extra_env = dict(env or {})
+        self._own_registry = registry is None
+        self.registry = registry or MetricsRegistry()
+
+    def _log(self, msg: str) -> None:
+        print(f"fleet: {msg}", flush=True)
+
+    # -- spawning ------------------------------------------------------------
+    def _replica_chaos(self) -> dict[int, str]:
+        """Distribute fleet chaos entries round-robin across replicas:
+        entry i detonates on replica i % N (the drill's 'kill one, hang
+        the other' shape with two replicas and two entries)."""
+        from deeplearning_mpi_tpu.resilience.faults import fleet_entries
+
+        per: dict[int, list[str]] = {k: [] for k in range(self.num_replicas)}
+        for i, entry in enumerate(fleet_entries(self.chaos_spec)):
+            per[i % self.num_replicas].append(entry)
+        return {k: ",".join(v) for k, v in per.items()}
+
+    def _spawn(self, rep: _Replica) -> None:
+        from deeplearning_mpi_tpu.resilience.pod import (
+            ENV_HEARTBEAT_INTERVAL,
+        )
+
+        rdir = self.fleet_dir / f"replica{rep.idx}-a{rep.attempt}"
+        rdir.mkdir(parents=True, exist_ok=True)
+        spec_path = rdir / "spec.json"
+        spec_path.write_text(json.dumps({
+            "model": self.model_spec,
+            "engine": self.engine_spec,
+            "seed": rep.seed,
+            "version": rep.version,
+            "eos_id": self.eos_id,
+            "warmup": self.warmup,
+        }))
+        (rdir / "inbox.jsonl").touch()
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env[ENV_HEARTBEAT_INTERVAL] = str(self.heartbeat_interval_s)
+        if rep.chaos_spec:
+            env["DMT_CHAOS"] = rep.chaos_spec
+        else:
+            env.pop("DMT_CHAOS", None)
+        # A replica is a lone process — leftover rendezvous vars from a
+        # surrounding pod run would make its jax runtime wait for peers.
+        for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+            env.pop(k, None)
+        log_path = self.fleet_dir / f"replica{rep.idx}-a{rep.attempt}.log"
+        rep.log = log_path.open("w")
+        rep.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "deeplearning_mpi_tpu.serving.fleet",
+                "--replica", str(rep.idx), "--dir", str(rdir),
+                "--spec", str(spec_path),
+            ],
+            env=env,
+            stdout=rep.log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,  # isolate signals; killpg on teardown
+        )
+        rep.dir = rdir
+        rep.outbox_offset = 0
+        rep.ready = False
+        rep.compile_at_ready = None
+        rep.inbox = (rdir / "inbox.jsonl").open("a")
+        from deeplearning_mpi_tpu.resilience.pod import LivenessTracker
+
+        rep.tracker = LivenessTracker(
+            [0],
+            deadline_s=self.heartbeat_deadline_s,
+            grace_s=self.spawn_grace_s,
+        )
+        self._log(
+            f"replica {rep.idx} attempt {rep.attempt}: spawned pid "
+            f"{rep.proc.pid} (version {rep.version}, "
+            f"chaos={rep.chaos_spec or 'none'})"
+        )
+
+    def _send(self, rep: _Replica, obj: dict) -> None:
+        rep.inbox.write(json.dumps(obj) + "\n")
+        rep.inbox.flush()
+
+    @staticmethod
+    def _kill(rep: _Replica) -> None:
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                os.killpg(rep.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                rep.proc.kill()
+            try:
+                rep.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        if rep.log is not None:
+            rep.log.close()
+            rep.log = None
+        if rep.inbox is not None:
+            rep.inbox.close()
+            rep.inbox = None
+
+    # -- the supervision loop ------------------------------------------------
+    def run(
+        self,
+        entries: list[dict],
+        *,
+        swap_at: int | None = None,
+        swap_seed: int | None = None,
+    ) -> FleetResult:
+        """Replay ``entries`` (serve_lm trace format: ``arrival`` seconds
+        from start, ``prompt`` int sequence, ``max_new``, optional
+        ``deadline`` seconds after arrival) through the fleet. With
+        ``swap_seed`` set, a rolling :meth:`swap_weights` begins once
+        ``swap_at`` requests have completed — under live load, by design.
+        """
+        from deeplearning_mpi_tpu.resilience.faults import (
+            ChaosInjector,
+            FaultPlan,
+        )
+        from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
+        from deeplearning_mpi_tpu.serving.router import Router
+        from deeplearning_mpi_tpu.telemetry import JsonlSink
+        from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+        self.fleet_dir.mkdir(parents=True, exist_ok=True)
+        self.registry.add_sink(
+            JsonlSink(self.fleet_dir / "fleet_metrics.jsonl")
+        )
+        injector: ChaosInjector | None = None
+        if self.chaos_spec.strip():
+            injector = ChaosInjector(
+                FaultPlan.parse(self.chaos_spec), registry=self.registry
+            )
+        for name in (FLEET_RESTARTS, FLEET_FAILURES, FLEET_REDISPATCH):
+            self.registry.counter(name)
+        router = Router(
+            range(self.num_replicas),
+            hedge_ms=self.hedge_ms,
+            exclusion_s=self.exclusion_s,
+            registry=self.registry,
+        )
+        per_chaos = self._replica_chaos()
+        replicas = {
+            k: _Replica(idx=k, seed=self.seed, chaos_spec=per_chaos.get(k, ""))
+            for k in range(self.num_replicas)
+        }
+        for rep in replicas.values():
+            router.exclude(rep.idx)  # ineligible until its ready lands
+            self._spawn(rep)
+
+        t0 = time.monotonic()
+        pending = deque(sorted(entries, key=lambda e: e["arrival"]))
+        ledger: dict[int, _Req] = {}
+        next_rid = 0
+        redispatch_queue: deque[int] = deque()
+        # kill/hang recoveries close when every re-dispatched rid resolves
+        # (or, for an idle-replica loss, when the respawn reaches ready);
+        # slow recoveries close when a hedged request on the slow replica
+        # completes — the hedge machinery demonstrably covered the fault.
+        pending_recoveries: list[dict] = []
+        hedged_primary: dict[int, int] = {}  # rid -> primary at hedge time
+        restarts = 0
+        failures: dict[str, int] = {}
+        redispatched = 0
+        completed = 0
+        phase = "before"
+        ttft_by_phase: dict[str, list[float]] = {
+            "before": [], "during": [], "after": [],
+        }
+        swap: dict[str, Any] = {
+            "requested": swap_seed is not None,
+            "performed": False, "drain_s": None,
+            "completions_during": 0, "compile_flat": True,
+        }
+        swap_queue: list[int] = []
+        swap_stage: Optional[str] = None  # None | "drain" | "await"
+        swap_t0: Optional[float] = None
+        swap_mark = 0
+        target_version = 0
+        stopping = False
+
+        def close_recovery(pr: dict, now: float) -> None:
+            if injector is not None:
+                injector.record_recovery(
+                    pr["kind"], latency_s=now - pr["detected"]
+                )
+            pending_recoveries.remove(pr)
+            self._log(
+                f"recovery: {pr['kind']} on replica {pr['replica']} closed "
+                f"({now - pr['detected']:.2f}s after detection)"
+            )
+
+        def handle_failure(rep: _Replica, kind: str, why: str) -> None:
+            nonlocal restarts, redispatched, phase
+            now = time.monotonic()
+            failures[kind] = failures.get(kind, 0) + 1
+            self.registry.counter(FLEET_FAILURES).inc()
+            self.registry.counter(labeled(FLEET_FAILURES, kind=kind)).inc()
+            self._kill(rep)
+            orphans = router.mark_dead(rep.idx, now)
+            hit = injector.fire_observed(kind) if injector else None
+            tag = (
+                f"matches planned {hit.kind}@{hit.unit}:{hit.at}"
+                if hit is not None else "unplanned"
+            )
+            self._log(
+                f"replica {rep.idx} failed ({why}) — {tag}; "
+                f"re-dispatching {len(orphans)} in-flight request(s)"
+            )
+            if hit is not None:
+                pending_recoveries.append({
+                    "kind": kind, "replica": rep.idx, "detected": now,
+                    "rids": set(orphans),
+                })
+            phase = "during"
+            for rid in orphans:
+                ledger[rid].holders.discard(rep.idx)
+                ledger[rid].redispatched = True
+                redispatch_queue.append(rid)
+                redispatched += 1
+                self.registry.counter(FLEET_REDISPATCH).inc()
+            # Hedge losers that lived on the dead replica are already
+            # forgotten by mark_dead; their primaries carry on elsewhere.
+            for rec in ledger.values():
+                rec.holders.discard(rep.idx)
+            if restarts >= self.max_replica_restarts:
+                raise FleetFailure(
+                    f"replica restart budget spent "
+                    f"({self.max_replica_restarts})"
+                )
+            restarts += 1
+            self.registry.counter(FLEET_RESTARTS).inc()
+            if injector is not None:
+                from deeplearning_mpi_tpu.resilience.faults import (
+                    strip_entries,
+                )
+
+                fired = [
+                    f"{s.kind}@{s.unit}:{s.at}"
+                    for s in injector.plan.specs
+                    if s.fired and s.kind in ("replica_kill", "replica_hang")
+                ]
+                rep.chaos_spec = strip_entries(rep.chaos_spec, fired)
+            rep.attempt += 1
+            self._spawn(rep)
+
+        def dispatch(rid: int, target: int, now: float) -> None:
+            rec = ledger[rid]
+            self._send(replicas[target], {
+                "op": "req", "rid": rid, "prompt": rec.prompt,
+                "max_new": rec.max_new, "arrival": rec.arrival_abs,
+                "deadline": rec.deadline_abs,
+            })
+            rec.holders.add(target)
+            router.dispatch(rid, target, now, deadline=rec.deadline_abs)
+
+        def handle_msg(rep: _Replica, m: dict) -> None:
+            nonlocal completed, phase, swap_stage
+            now = time.monotonic()
+            op = m["op"]
+            if op == "ready":
+                rep.ready = True
+                rep.compile_at_ready = float(m["compile_total"])
+                router.mark_alive(rep.idx, now)
+                router.include(rep.idx)
+                for pr in list(pending_recoveries):
+                    if pr["replica"] == rep.idx and not pr["rids"]:
+                        close_recovery(pr, now)
+            elif op == "done":
+                rid = int(m["rid"])
+                verdict, loser = router.on_complete(
+                    rid, rep.idx, now, ttft=m.get("ttft")
+                )
+                if verdict != "win":
+                    return
+                rec = ledger[rid]
+                rec.tokens = [int(t) for t in m["tokens"]]
+                rec.version = int(m["version"])
+                rec.ttft = m.get("ttft")
+                rec.holders.discard(rep.idx)
+                completed += 1
+                if rec.ttft is not None:
+                    ttft_by_phase[phase].append(float(rec.ttft))
+                if loser is not None:
+                    self._send(replicas[loser], {"op": "cancel", "rid": rid})
+                    ledger[rid].holders.discard(loser)
+                for pr in list(pending_recoveries):
+                    if pr["rids"] and rid in pr["rids"]:
+                        pr["rids"].discard(rid)
+                        if not pr["rids"]:
+                            close_recovery(pr, now)
+                    elif (
+                        pr["kind"] == "replica_slow"
+                        and hedged_primary.get(rid) == pr["replica"]
+                    ):
+                        close_recovery(pr, now)
+            elif op == "shed":
+                rid = int(m["rid"])
+                reason = m["reason"]
+                rec = ledger.get(rid)
+                if rec is None or reason == "cancelled":
+                    return
+                rec.holders.discard(rep.idx)
+                if rec.tokens is None and not rec.holders:
+                    rec.shed_reason = reason
+                    router.forget(rid)
+                for pr in list(pending_recoveries):
+                    if pr["rids"] and rid in pr["rids"] and rec.resolved:
+                        pr["rids"].discard(rid)
+                        if not pr["rids"]:
+                            close_recovery(pr, now)
+            elif op == "fault":
+                hit = (
+                    injector.fire_observed(m["kind"]) if injector else None
+                )
+                self._log(
+                    f"replica {rep.idx} reported {m['kind']}@step:"
+                    f"{m.get('step')} ("
+                    f"{'planned' if hit is not None else 'unplanned'})"
+                )
+                if hit is not None:
+                    pending_recoveries.append({
+                        "kind": m["kind"], "replica": rep.idx,
+                        "detected": now, "rids": set(),
+                    })
+                phase = "during"
+            elif op == "swapped":
+                rep.version = int(m["version"])
+                if float(m["compile_total"]) != rep.compile_at_ready:
+                    rep.compile_flat = False
+                    swap["compile_flat"] = False
+                    self._log(
+                        f"replica {rep.idx}: COMPILE during swap "
+                        f"({rep.compile_at_ready} -> {m['compile_total']})"
+                    )
+                router.include(rep.idx)
+                self._log(
+                    f"swap: replica {rep.idx} now serving version "
+                    f"{rep.version}"
+                )
+                if swap_queue and swap_queue[0] == rep.idx:
+                    swap_queue.pop(0)
+                    swap_stage = "drain" if swap_queue else None
+            elif op == "stopped":
+                rep.stopped = m
+                if (
+                    rep.compile_at_ready is not None
+                    and float(m["compile_total"]) != rep.compile_at_ready
+                ):
+                    rep.compile_flat = False
+
+        try:
+            while True:
+                now = time.monotonic()
+                if now - t0 > self.timeout_s:
+                    raise FleetFailure(
+                        f"run exceeded timeout_s={self.timeout_s}"
+                    )
+
+                # 1. liveness + telemetry in.
+                for rep in replicas.values():
+                    payload = Heartbeat.read(rep.dir / "heartbeat.json")
+                    rep.tracker.observe(0, payload)
+                    if payload is not None:
+                        router.observe(rep.idx, payload)
+
+                # 2. worker messages.
+                for rep in replicas.values():
+                    msgs, rep.outbox_offset = _tail_jsonl(
+                        rep.dir / "outbox.jsonl", rep.outbox_offset
+                    )
+                    for m in msgs:
+                        handle_msg(rep, m)
+
+                # 3. dead replicas (exit observed).
+                for rep in replicas.values():
+                    if rep.proc is not None and rep.proc.poll() is not None:
+                        if rep.stopped is not None:
+                            continue  # clean shutdown we asked for
+                        handle_failure(
+                            rep, "replica_kill",
+                            f"exit {rep.proc.poll()}",
+                        )
+
+                # 4. hung replicas (alive, progress frozen past deadline).
+                for rep in replicas.values():
+                    if (
+                        rep.proc is not None
+                        and rep.proc.poll() is None
+                        and rep.tracker.stalled(0)
+                    ):
+                        handle_failure(
+                            rep, "replica_hang",
+                            "progress stalled "
+                            f"{rep.tracker.progress_age_s(0):.1f}s "
+                            "(heartbeat daemon still beating)",
+                        )
+
+                # 5. re-dispatch orphans of the dead (original arrival AND
+                # deadline ride along — failover never refreshes a budget).
+                while redispatch_queue:
+                    rid = redispatch_queue[0]
+                    target = router.select(now)
+                    if target is None:
+                        break  # whole fleet cold; retry next tick
+                    redispatch_queue.popleft()
+                    dispatch(rid, target, now)
+
+                # 6. hedged retries for the slow.
+                for rid, target in router.maybe_hedge(now):
+                    rec = ledger[rid]
+                    hedged_primary.setdefault(
+                        rid,
+                        next(iter(rec.holders)) if rec.holders else -1,
+                    )
+                    self._send(replicas[target], {
+                        "op": "req", "rid": rid, "prompt": rec.prompt,
+                        "max_new": rec.max_new, "arrival": rec.arrival_abs,
+                        "deadline": rec.deadline_abs,
+                    })
+                    rec.holders.add(target)
+                    self._log(
+                        f"hedge: rid {rid} duplicated onto replica {target}"
+                    )
+
+                # 7. rolling weight swap, under load.
+                if (
+                    swap_seed is not None
+                    and not swap["performed"]
+                    and swap_t0 is None
+                    and completed >= (swap_at or 0)
+                ):
+                    swap_queue = sorted(replicas)
+                    swap_stage = "drain"
+                    swap_t0 = now
+                    swap_mark = completed
+                    target_version += 1
+                    self._log(
+                        f"swap: rolling weight swap to seed {swap_seed} "
+                        f"(version {target_version}) across "
+                        f"{len(swap_queue)} replicas"
+                    )
+                if swap_stage == "drain" and swap_queue:
+                    cur = replicas[swap_queue[0]]
+                    router.exclude(cur.idx)
+                    if (
+                        cur.ready
+                        and cur.proc is not None
+                        and cur.proc.poll() is None
+                        and not router.outstanding_on(cur.idx)
+                    ):
+                        cur.seed = swap_seed
+                        cur.version = target_version
+                        self._send(cur, {
+                            "op": "swap", "seed": swap_seed,
+                            "version": target_version,
+                        })
+                        swap_stage = "await"
+                if swap_t0 is not None and not swap_queue and not swap[
+                    "performed"
+                ]:
+                    swap["performed"] = True
+                    swap["drain_s"] = now - swap_t0
+                    swap["completions_during"] = completed - swap_mark
+                    self._log(
+                        f"swap: fleet at version {target_version} in "
+                        f"{swap['drain_s']:.2f}s "
+                        f"({swap['completions_during']} requests completed "
+                        "mid-swap)"
+                    )
+
+                # 8. admit due trace entries.
+                while pending and t0 + pending[0]["arrival"] <= now:
+                    target = router.select(now)
+                    if target is None:
+                        break  # fleet saturated/cold — hold at the door
+                    e = pending.popleft()
+                    rid = next_rid
+                    next_rid += 1
+                    deadline = e.get("deadline") or 0
+                    ledger[rid] = _Req(
+                        rid=rid,
+                        prompt=[int(t) for t in e["prompt"]],
+                        max_new=int(e["max_new"]),
+                        arrival_abs=t0 + float(e["arrival"]),
+                        deadline_abs=(
+                            t0 + float(e["arrival"]) + float(deadline)
+                            if deadline > 0 else None
+                        ),
+                    )
+                    dispatch(rid, target, now)
+
+                # 9. done?
+                if (
+                    not pending
+                    and not redispatch_queue
+                    and swap_stage is None
+                    and all(r.resolved for r in ledger.values())
+                    and (swap["performed"] or swap_seed is None)
+                ):
+                    break
+                if phase == "during" and not pending_recoveries:
+                    phase = "after"
+                time.sleep(self.poll_interval_s)
+
+            if phase == "during" and not pending_recoveries:
+                phase = "after"
+            stopping = True
+            for rep in replicas.values():
+                if rep.proc is not None and rep.proc.poll() is None:
+                    self._send(rep, {"op": "stop"})
+            stop_deadline = time.monotonic() + 15.0
+            while time.monotonic() < stop_deadline and any(
+                rep.stopped is None
+                and rep.proc is not None
+                and rep.proc.poll() is None
+                for rep in replicas.values()
+            ):
+                for rep in replicas.values():
+                    msgs, rep.outbox_offset = _tail_jsonl(
+                        rep.dir / "outbox.jsonl", rep.outbox_offset
+                    )
+                    for m in msgs:
+                        handle_msg(rep, m)
+                time.sleep(self.poll_interval_s)
+        finally:
+            for rep in replicas.values():
+                self._kill(rep)
+
+        # -- accounting out ---------------------------------------------------
+        def pct(vals: list[float], q: float) -> Optional[float]:
+            if not vals:
+                return None
+            d = sorted(vals)
+            return d[int(q * (len(d) - 1))]
+
+        shed: dict[str, int] = {}
+        for rec in ledger.values():
+            if rec.shed_reason is not None:
+                shed[rec.shed_reason] = shed.get(rec.shed_reason, 0) + 1
+        dropped = sum(1 for rec in ledger.values() if not rec.resolved)
+        compile_flat = all(r.compile_flat for r in replicas.values())
+        chaos_balanced = injector.balanced() if injector else None
+        if injector is not None:
+            self._log(injector.summary())
+        ttft_summary = {
+            f"{ph}_{name}": pct(vals, q)
+            for ph, vals in ttft_by_phase.items()
+            for name, q in (("p50", 0.50), ("p99", 0.99))
+        }
+        ok = (
+            dropped == 0
+            and compile_flat
+            and (chaos_balanced is not False)
+            and (swap["performed"] or swap_seed is None)
+        )
+        values: dict[str, Any] = {
+            **self.registry.snapshot(),
+            "ok": ok,
+            "replicas": self.num_replicas,
+            "completed_total": completed,
+            "shed_total": sum(shed.values()),
+            "dropped_total": dropped,
+            "redispatched_total": redispatched,
+            "swap_performed": swap["performed"],
+            "swap_drain_s": swap["drain_s"],
+            "swap_completions_during": swap["completions_during"],
+            "compile_flat": compile_flat,
+        }
+        if chaos_balanced is not None:
+            values["chaos_balanced"] = chaos_balanced
+        for key, v in ttft_summary.items():
+            if v is not None:
+                values[f"ttft_{key}"] = v
+        self.registry.emit("fleet_summary", values)
+        result = FleetResult(
+            ok=ok,
+            completed=completed,
+            shed=shed,
+            dropped=dropped,
+            restarts=restarts,
+            failures=failures,
+            redispatched=redispatched,
+            compile_flat=compile_flat,
+            chaos_balanced=chaos_balanced,
+            ttft=ttft_summary,
+            swap=swap,
+            requests={
+                rid: {
+                    "tokens": rec.tokens,
+                    "version": rec.version,
+                    "prompt": rec.prompt,
+                    "max_new": rec.max_new,
+                    "redispatched": rec.redispatched,
+                    "ttft": rec.ttft,
+                }
+                for rid, rec in ledger.items()
+                if rec.tokens is not None
+            },
+            snapshot=self.registry.snapshot(),
+        )
+        if self._own_registry:
+            self.registry.close()
+        return result
+
+    def swap_weights(self, entries: list[dict], *, seed: int,
+                     swap_at: int = 0) -> FleetResult:
+        """Convenience wrapper: :meth:`run` with a rolling weight swap —
+        drain each replica (in-flight requests finish, new ones route to
+        peers), swap params from ``seed`` in place with zero retraces,
+        re-include, next replica. The drill calls :meth:`run` directly to
+        compose the swap with chaos; this entry exists for callers that
+        only want the zero-downtime deploy."""
+        return self.run(entries, swap_at=swap_at, swap_seed=seed)
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

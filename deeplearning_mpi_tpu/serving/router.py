@@ -1,0 +1,266 @@
+"""SLO-aware request router for a multi-replica serving fleet.
+
+The router is the fleet's policy half, deliberately built like the
+scheduler (`serving/scheduler.py`): pure host-side Python, no device work,
+every decision a deterministic function of (telemetry snapshots, clock) —
+so `tests/test_fleet.py` drives all of it under a fake clock. The
+supervisor (`serving/fleet.py`) owns the processes and the wire; the
+router owns three decisions:
+
+- **Replica selection**: each dispatch goes to the eligible replica with
+  the lowest load score, computed from the replica's last heartbeat
+  telemetry snapshot (queue depth, active slots, TTFT p50 — the same
+  ``serve_*`` instruments the single-replica engine already emits) plus
+  the router's own count of outstanding dispatches (the snapshot lags by
+  a heartbeat interval; the router's ledger does not).
+- **Dead-replica exclusion**: a replica marked dead is ineligible until
+  BOTH it has been marked alive again (respawn reached ready) and its
+  exclusion window has elapsed — a freshly respawned replica has a cold
+  queue and would otherwise win every selection while it is still the
+  least-proven member of the fleet.
+- **Deadline-budgeted hedged retries**: an outstanding request older than
+  the hedge threshold with SLO budget left gets a duplicate dispatch on a
+  different replica; the first completion wins and the loser is
+  cancelled. Duplicates are deduplicated here — exactly one stream per
+  rid reaches the client — and every hedge outcome is accounted in
+  ``serve_hedge_total{outcome=fired|primary_win|hedge_win|duplicate}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+__all__ = ["Router"]
+
+HEDGE_TOTAL = "serve_hedge_total"
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Router-side view of one replica."""
+
+    snapshot: dict = dataclasses.field(default_factory=dict)
+    dead: bool = False
+    #: manual drain flag (rolling weight swap): excluded until include()d.
+    draining: bool = False
+    #: monotonic time before which a once-dead replica stays ineligible.
+    excluded_until: float = 0.0
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """One in-flight request the router has dispatched."""
+
+    rid: int
+    primary: int
+    dispatched_at: float
+    deadline: Optional[float] = None
+    hedge: Optional[int] = None
+    hedged_at: Optional[float] = None
+    done: bool = False
+
+
+class Router:
+    def __init__(
+        self,
+        replicas: list[int] | tuple[int, ...] | range,
+        *,
+        clock: Any = time.monotonic,
+        hedge_ms: float = 0.0,
+        exclusion_s: float = 1.0,
+        registry: Any = None,
+    ) -> None:
+        self._clock = clock
+        self.hedge_s = hedge_ms / 1000.0
+        self.exclusion_s = exclusion_s
+        self._registry = registry
+        self._replicas: dict[int, _Replica] = {
+            int(r): _Replica() for r in replicas
+        }
+        self._requests: dict[int, _Tracked] = {}
+        if registry is not None:
+            registry.counter(HEDGE_TOTAL)  # explicit 0 in a hedge-free run
+
+    # -- telemetry in --------------------------------------------------------
+    def observe(self, replica: int, snapshot: dict) -> None:
+        """Record a replica's latest heartbeat telemetry snapshot. Keys the
+        scorer reads: ``queue_depth``, ``slots_active``, ``ttft_p50``."""
+        self._replicas[replica].snapshot = dict(snapshot)
+
+    # -- liveness ------------------------------------------------------------
+    def mark_dead(self, replica: int, now: Optional[float] = None) -> list[int]:
+        """Exclude ``replica`` and return the rids it was serving (primary
+        or hedge) so the supervisor can re-dispatch them. Hedge copies on
+        the dead replica are simply forgotten (the primary still runs)."""
+        now = self._clock() if now is None else now
+        state = self._replicas[replica]
+        state.dead = True
+        state.excluded_until = now + self.exclusion_s
+        orphaned = []
+        for t in self._requests.values():
+            if t.done:
+                continue
+            if t.primary == replica:
+                if t.hedge is not None and t.hedge != replica:
+                    # The hedge copy survives — promote it to primary so
+                    # completion accounting still sees one live owner.
+                    t.primary, t.hedge = t.hedge, None
+                    t.hedged_at = None
+                else:
+                    orphaned.append(t.rid)
+            elif t.hedge == replica:
+                t.hedge = None
+                t.hedged_at = None
+        for rid in orphaned:
+            del self._requests[rid]
+        return orphaned
+
+    def mark_alive(self, replica: int, now: Optional[float] = None) -> None:
+        """A respawned replica reached ready. It stays ineligible until its
+        exclusion window (started at :meth:`mark_dead`) also elapses."""
+        self._replicas[replica].dead = False
+
+    def exclude(self, replica: int) -> None:
+        """Manually drain ``replica`` (rolling swap): no new dispatches."""
+        self._replicas[replica].draining = True
+
+    def include(self, replica: int) -> None:
+        self._replicas[replica].draining = False
+
+    def eligible(self, now: Optional[float] = None) -> list[int]:
+        now = self._clock() if now is None else now
+        return [
+            r
+            for r, s in sorted(self._replicas.items())
+            if not s.dead and not s.draining and now >= s.excluded_until
+        ]
+
+    # -- selection -----------------------------------------------------------
+    def outstanding_on(self, replica: int) -> list[int]:
+        return [
+            t.rid
+            for t in self._requests.values()
+            if not t.done and (t.primary == replica or t.hedge == replica)
+        ]
+
+    def score(self, replica: int) -> float:
+        """Load score — lower is better. Outstanding dispatches are the
+        router's own ledger (fresh); queue depth / active slots / TTFT come
+        from the replica's last snapshot (one heartbeat stale)."""
+        snap = self._replicas[replica].snapshot
+        return (
+            len(self.outstanding_on(replica))
+            + float(snap.get("queue_depth", 0))
+            + 0.25 * float(snap.get("slots_active", 0))
+            + float(snap.get("ttft_p50", 0.0))
+        )
+
+    def select(
+        self, now: Optional[float] = None, *, exclude: tuple[int, ...] = ()
+    ) -> Optional[int]:
+        """The eligible replica with the lowest score (ties → lowest id),
+        or None when the whole fleet is dead/draining/excluded."""
+        now = self._clock() if now is None else now
+        candidates = [r for r in self.eligible(now) if r not in exclude]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (self.score(r), r))
+
+    def dispatch(
+        self,
+        rid: int,
+        replica: int,
+        now: Optional[float] = None,
+        *,
+        deadline: Optional[float] = None,
+    ) -> None:
+        """Record that ``rid`` was sent to ``replica`` (primary copy). A
+        re-dispatch after :meth:`mark_dead` lands here again — the original
+        dispatch record died with the replica — and MUST carry the original
+        deadline so hedging still sees the true remaining budget."""
+        self._requests[rid] = _Tracked(
+            rid=rid,
+            primary=replica,
+            dispatched_at=self._clock() if now is None else now,
+            deadline=deadline,
+        )
+
+    # -- hedging -------------------------------------------------------------
+    def maybe_hedge(
+        self, now: Optional[float] = None
+    ) -> list[tuple[int, int]]:
+        """The (rid, replica) duplicate dispatches due now: outstanding
+        longer than the hedge threshold, not yet hedged, still inside the
+        request's deadline budget (hedging work the client already gave up
+        on is pure waste), with a different eligible replica to run on.
+        Each fired hedge counts ``serve_hedge_total{outcome="fired"}``;
+        the supervisor must actually send the duplicate."""
+        if self.hedge_s <= 0.0:
+            return []
+        now = self._clock() if now is None else now
+        fired = []
+        for t in self._requests.values():
+            if t.done or t.hedge is not None:
+                continue
+            if now - t.dispatched_at < self.hedge_s:
+                continue
+            if t.deadline is not None and now >= t.deadline:
+                continue
+            target = self.select(now, exclude=(t.primary,))
+            if target is None:
+                continue
+            t.hedge = target
+            t.hedged_at = now
+            self._count_hedge("fired")
+            fired.append((t.rid, target))
+        return fired
+
+    def on_complete(
+        self,
+        rid: int,
+        replica: int,
+        now: Optional[float] = None,
+        *,
+        ttft: Optional[float] = None,
+    ) -> tuple[str, Optional[int]]:
+        """A completion arrived from ``replica``. Returns
+        ``(verdict, loser)``: verdict ``"win"`` means this stream goes to
+        the client and ``loser`` (a replica id, or None) still holds a
+        copy the supervisor must cancel; ``"duplicate"`` means the client
+        already has this stream — drop it. Exactly one win per rid, ever.
+        ``ttft`` feeds the per-replica ``serve_ttft_s{replica=...}``
+        histogram the router aggregates for the fleet."""
+        if ttft is not None and self._registry is not None:
+            self._registry.histogram(
+                labeled("serve_ttft_s", replica=str(replica))
+            ).observe(ttft)
+        t = self._requests.get(rid)
+        if t is None or t.done:
+            self._count_hedge("duplicate")
+            return "duplicate", None
+        t.done = True
+        loser: Optional[int] = None
+        if t.hedge is not None:
+            if replica == t.primary:
+                loser = t.hedge
+                self._count_hedge("primary_win")
+            else:
+                loser = t.primary
+                self._count_hedge("hedge_win")
+        return "win", loser
+
+    def forget(self, rid: int) -> None:
+        """Drop a rid the fleet permanently shed (deadline, queue_full):
+        nothing outstanding remains to hedge or re-dispatch."""
+        self._requests.pop(rid, None)
+
+    # -- internals -----------------------------------------------------------
+    def _count_hedge(self, outcome: str) -> None:
+        if self._registry is None:
+            return
+        self._registry.counter(HEDGE_TOTAL).inc()
+        self._registry.counter(labeled(HEDGE_TOTAL, outcome=outcome)).inc()

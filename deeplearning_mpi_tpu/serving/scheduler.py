@@ -275,6 +275,24 @@ class Scheduler:
         self._hold_steps += 1
         return True
 
+    def cancel(self, req: Request) -> bool:
+        """Shed ``req`` at the caller's request (hedged-retry dedup: the
+        other copy of this request already won). A queued request leaves
+        the queue; a running one is evicted and its blocks reclaimed.
+        Returns False when ``req`` is already finished or shed — cancels
+        race completions by design, and losing that race is a no-op."""
+        if req.state is RequestState.QUEUED:
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                return False
+            self._shed(req, "cancelled")
+            return True
+        if req.state in (RequestState.PREFILL, RequestState.DECODE):
+            self.evict(req, reason="cancelled")
+            return True
+        return False
+
     def finish(self, req: Request, now: float) -> None:
         req.t_finished = now
         req.state = RequestState.FINISHED

@@ -1,0 +1,267 @@
+"""Fleet router + replica fault-kind tests: fake clock, no processes.
+
+The router (``serving/router.py``) is deliberately pure host-side policy —
+every decision a function of (telemetry snapshots, ledger, clock) — so
+selection scoring, the dead-replica exclusion window, and the full hedge
+lifecycle (threshold → fire → first-winner-cancels-loser → duplicate
+drop) are all pinned here deterministically. The process-level half of
+the fleet (supervision, re-dispatch, rolling swap) lives in
+``tools/fleet_drill.py`` / ``tests/test_multiprocess.py``.
+"""
+
+import pytest
+
+from deeplearning_mpi_tpu.resilience import faults
+from deeplearning_mpi_tpu.resilience.faults import (
+    FAULT_UNITS,
+    FLEET_KINDS,
+    SERVE_KINDS,
+    ChaosInjector,
+    FaultPlan,
+    fleet_entries,
+    validate_plan_kinds,
+)
+from deeplearning_mpi_tpu.serving.router import Router
+from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+def _router(n=2, **kw):
+    clock = FakeClock()
+    return Router(range(n), clock=clock, **kw), clock
+
+
+class TestRouterSelection:
+    def test_select_prefers_lowest_reported_load(self):
+        router, _ = _router()
+        router.observe(0, {"queue_depth": 5, "slots_active": 3})
+        router.observe(1, {"queue_depth": 1, "slots_active": 1})
+        assert router.select() == 1
+
+    def test_outstanding_ledger_beats_stale_snapshot(self):
+        """The snapshot lags by a heartbeat; the router's own dispatch
+        ledger does not — a burst must spread instead of piling onto the
+        replica whose stale snapshot still says 'idle'."""
+        router, _ = _router()
+        targets = []
+        for rid in range(4):
+            t = router.select()
+            router.dispatch(rid, t)
+            targets.append(t)
+        assert targets == [0, 1, 0, 1]
+
+    def test_ties_break_to_lowest_id(self):
+        router, _ = _router(n=3)
+        assert router.select() == 0
+
+    def test_ttft_in_score(self):
+        router, _ = _router()
+        router.observe(0, {"ttft_p50": 2.0})
+        router.observe(1, {"ttft_p50": 0.1})
+        assert router.select() == 1
+
+    def test_select_none_when_fleet_unavailable(self):
+        router, clock = _router()
+        router.mark_dead(0, clock())
+        router.exclude(1)
+        assert router.select() is None
+
+
+class TestRouterExclusion:
+    def test_mark_dead_orphans_primaries_and_opens_window(self):
+        router, clock = _router(exclusion_s=1.0)
+        router.dispatch(0, 0, clock())
+        router.dispatch(1, 0, clock())
+        router.dispatch(2, 1, clock())
+        orphans = router.mark_dead(0, clock())
+        assert sorted(orphans) == [0, 1]
+        assert router.eligible(clock()) == [1]
+        # ready alone is not enough: the exclusion window must also pass
+        # (a cold respawn would win every selection on an empty queue).
+        router.mark_alive(0, clock())
+        assert router.eligible(clock()) == [1]
+        clock.advance(1.01)
+        assert router.eligible(clock()) == [0, 1]
+
+    def test_window_alone_is_not_enough_either(self):
+        router, clock = _router(exclusion_s=0.5)
+        router.mark_dead(0, clock())
+        clock.advance(5.0)
+        assert router.eligible(clock()) == [1]  # never marked alive
+        router.mark_alive(0, clock())
+        assert router.eligible(clock()) == [0, 1]
+
+    def test_surviving_hedge_is_promoted_to_primary(self):
+        """Primary's replica dies while a hedge copy runs elsewhere: the
+        request is NOT orphaned — the hedge copy becomes the primary and
+        its completion is a plain win (no phantom loser to cancel)."""
+        router, clock = _router(hedge_ms=100.0, registry=MetricsRegistry())
+        router.dispatch(0, 0, clock())
+        clock.advance(0.2)
+        assert router.maybe_hedge(clock()) == [(0, 1)]
+        assert router.mark_dead(0, clock()) == []
+        verdict, loser = router.on_complete(0, 1, clock())
+        assert (verdict, loser) == ("win", None)
+
+
+class TestHedging:
+    def test_fires_only_past_threshold(self):
+        registry = MetricsRegistry()
+        router, clock = _router(hedge_ms=50.0, registry=registry)
+        router.dispatch(0, 0, clock())
+        clock.advance(0.02)
+        assert router.maybe_hedge(clock()) == []
+        clock.advance(0.04)  # 60ms outstanding
+        assert router.maybe_hedge(clock()) == [(0, 1)]
+        # already hedged: never a third copy
+        clock.advance(1.0)
+        assert router.maybe_hedge(clock()) == []
+        snap = registry.snapshot()
+        assert snap['serve_hedge_total{outcome="fired"}'] == 1
+
+    def test_deadline_budget_gates_hedging(self):
+        """Hedging a request the client already gave up on is pure waste:
+        past the absolute deadline, no duplicate fires."""
+        router, clock = _router(hedge_ms=50.0)
+        router.dispatch(0, 0, clock(), deadline=0.04)
+        clock.advance(0.06)  # past hedge threshold AND past deadline
+        assert router.maybe_hedge(clock()) == []
+
+    def test_no_hedge_without_a_second_eligible_replica(self):
+        router, clock = _router(hedge_ms=50.0)
+        router.exclude(1)
+        router.dispatch(0, 0, clock())
+        clock.advance(0.1)
+        assert router.maybe_hedge(clock()) == []
+
+    def test_hedging_disabled_at_zero(self):
+        router, clock = _router(hedge_ms=0.0)
+        router.dispatch(0, 0, clock())
+        clock.advance(100.0)
+        assert router.maybe_hedge(clock()) == []
+
+    def test_first_winner_cancels_loser_exactly_one_stream(self):
+        registry = MetricsRegistry()
+        router, clock = _router(hedge_ms=50.0, registry=registry)
+        router.dispatch(7, 0, clock())
+        clock.advance(0.06)
+        assert router.maybe_hedge(clock()) == [(7, 1)]
+        # hedge copy lands first: it wins, the primary is the loser...
+        verdict, loser = router.on_complete(7, 1, clock(), ttft=0.08)
+        assert (verdict, loser) == ("win", 0)
+        # ...and the primary's late completion is a dropped duplicate.
+        verdict, loser = router.on_complete(7, 0, clock(), ttft=0.09)
+        assert (verdict, loser) == ("duplicate", None)
+        snap = registry.snapshot()
+        assert snap['serve_hedge_total{outcome="fired"}'] == 1
+        assert snap['serve_hedge_total{outcome="hedge_win"}'] == 1
+        assert snap['serve_hedge_total{outcome="duplicate"}'] == 1
+        assert snap["serve_hedge_total"] == 3  # base counter sums outcomes
+        # per-replica TTFT aggregation: each completion labeled by server
+        assert any(k.startswith('serve_ttft_s{replica="1"}') for k in snap)
+
+    def test_primary_win_cancels_hedge(self):
+        registry = MetricsRegistry()
+        router, clock = _router(hedge_ms=50.0, registry=registry)
+        router.dispatch(3, 0, clock())
+        clock.advance(0.06)
+        router.maybe_hedge(clock())
+        verdict, loser = router.on_complete(3, 0, clock())
+        assert (verdict, loser) == ("win", 1)
+        snap = registry.snapshot()
+        assert snap['serve_hedge_total{outcome="primary_win"}'] == 1
+
+    def test_unknown_rid_is_duplicate(self):
+        router, clock = _router(registry=MetricsRegistry())
+        assert router.on_complete(99, 0, clock()) == ("duplicate", None)
+
+
+class TestReplicaFaultKinds:
+    def test_fleet_entries_filters_to_fleet_kinds(self):
+        spec = "replica_kill@step:4,serve_crash@step:2, replica_hang@step:6"
+        assert fleet_entries(spec) == [
+            "replica_kill@step:4", "replica_hang@step:6",
+        ]
+        assert fleet_entries("") == []
+
+    def test_replica_kinds_registered_step_unit(self):
+        assert FLEET_KINDS == {"replica_kill", "replica_hang", "replica_slow"}
+        for kind in FLEET_KINDS:
+            assert FAULT_UNITS[kind] == "step"
+        FaultPlan.parse("replica_kill@step:4,replica_slow@step:2")  # parses
+
+    def test_validate_plan_kinds_accepts_supported(self):
+        validate_plan_kinds(
+            "replica_kill@step:4,replica_hang@step:6", FLEET_KINDS,
+            workload="serving fleet",
+        )
+        validate_plan_kinds("serve_crash@step:2", SERVE_KINDS,
+                            workload="single-replica serving")
+
+    def test_validate_plan_kinds_fails_loud_on_hookless_kind(self):
+        with pytest.raises(ValueError, match="rank_kill.*no injection hook"):
+            validate_plan_kinds("rank_kill@step:1", FLEET_KINDS,
+                                workload="serving fleet")
+        with pytest.raises(ValueError, match="replica_kill"):
+            validate_plan_kinds("replica_kill@step:1", SERVE_KINDS,
+                                workload="single-replica serving")
+
+    def test_replica_kill_and_hang_detonate_at_step(self, monkeypatch):
+        fired = []
+        monkeypatch.setattr(faults, "_exit_rank",
+                            lambda step: fired.append(("kill", step)))
+        monkeypatch.setattr(faults, "_hang_rank",
+                            lambda step: fired.append(("hang", step)))
+        inj = ChaosInjector(
+            FaultPlan.parse("replica_kill@step:4,replica_hang@step:6")
+        )
+        inj.check_replica_fault(step=3)
+        assert fired == []
+        inj.check_replica_fault(step=4)
+        assert fired == [("kill", 4)]
+        inj.check_replica_fault(step=6)
+        assert fired == [("kill", 4), ("hang", 6)]
+
+    def test_replica_slow_fires_once_then_persists(self):
+        """The slowdown is a degraded replica, not a one-step blip — it
+        persists after its trigger, but the fault is COUNTED exactly once
+        so one supervisor-side recovery balances the books."""
+        inj = ChaosInjector(FaultPlan.parse("replica_slow@step:2"),
+                            stall_s=0.5)
+        assert inj.check_replica_fault(step=1) == 0.0
+        assert inj.check_replica_fault(step=2) == 0.5
+        assert inj.check_replica_fault(step=3) == 0.5  # persists
+        assert inj.counts().get("fault_injected_total") == 1
+        inj.record_recovery("replica_slow")
+        assert inj.balanced()
+
+
+class TestServeLmChaosValidation:
+    """Satellite: ``serve_lm --chaos`` used to silently accept kinds with
+    no serving hook — they could never fire, leaving the reconciliation
+    invariant unfalsifiable. Now it fails loud at startup."""
+
+    def test_rejects_pod_kind_in_single_replica_mode(self, capsys):
+        from deeplearning_mpi_tpu.cli import serve_lm
+
+        rc = serve_lm.main(["--selftest", "--chaos", "rank_kill@step:1"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "rank_kill" in err and "no injection hook" in err
+
+    def test_rejects_fleet_kind_without_replicas(self, capsys):
+        from deeplearning_mpi_tpu.cli import serve_lm
+
+        rc = serve_lm.main(["--selftest", "--chaos", "replica_kill@step:1"])
+        assert rc == 1
+        assert "replica_kill" in capsys.readouterr().err

@@ -305,6 +305,53 @@ def _pod_drill_module():
     return mod
 
 
+# -- serving fleet drill ------------------------------------------------------
+
+def _fleet_drill_module():
+    """Import tools/fleet_drill.py by path (script, not a package)."""
+    import importlib.util
+
+    drill = REPO / "tools" / "fleet_drill.py"
+    spec = importlib.util.spec_from_file_location("fleet_drill", drill)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_fleet_survives_kill_and_hang_with_parity(tmp_path):
+    """The fleet acceptance drill (``tools/fleet_drill.py``, also ``make
+    fleet-smoke``): a 2-replica fleet under a trace burst loses replica 0
+    to a replica_kill and replica 1 to a replica_hang; every in-flight
+    request must fail over to a survivor and complete bit-identical to
+    offline greedy, a rolling weight swap must land under load with zero
+    drops and zero post-warmup compiles, and the chaos books must
+    reconcile in ``fleet_metrics.jsonl``."""
+    out = _fleet_drill_module().run_drill(tmp_path / "drill", "kill_hang")
+    assert out["dropped"] == 0
+    assert out["restarts"] == 2
+    assert out["failures"] == {"replica_kill": 1, "replica_hang": 1}
+    assert out["redispatched"] >= 1
+    assert out["swap"]["performed"] and out["swap"]["compile_flat"]
+    assert out["chaos_balanced"] is True
+    assert out["parity_checked"] == out["completed"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_fleet_hedges_around_slow_replica(tmp_path):
+    """A replica_slow-degraded replica must trigger deadline-budgeted
+    hedged retries; first-winner-cancels-loser leaves exactly one stream
+    per request, still bit-identical to offline greedy, books balanced."""
+    out = _fleet_drill_module().run_drill(tmp_path / "drill", "slow")
+    assert out["dropped"] == 0
+    assert out["restarts"] == 0
+    assert out["hedge_total"] >= 1
+    assert out["chaos_balanced"] is True
+    assert out["parity_checked"] == out["completed"] > 0
+
+
 @pytest.mark.slow
 @pytest.mark.multiprocess
 @pytest.mark.parametrize("fault", ["rank_kill", "rank_hang"])

@@ -304,6 +304,47 @@ class TestScheduler:
         assert sched.idle()
         pool.check()
 
+    def test_requeue_preserves_arrival_and_deadline(self):
+        """Failover SLO contract, in-process half: a crashed-and-requeued
+        request keeps its ORIGINAL arrival/deadline — recovery must never
+        mint fresh budget — and a requeued request already past its
+        deadline is shed on the next sweep, not served."""
+        sched, pool = self._sched()
+        req = _req(0, 6, arrival=1.0, deadline=9.0)
+        sched.submit(req)
+        sched.admit(now=2.0)
+        sched.requeue(req)
+        assert req.state is RequestState.QUEUED
+        assert req.arrival == 1.0 and req.deadline == 9.0
+        # requeue abandons block ownership; recovery's pool sweep reclaims.
+        assert pool.reconcile([])["reclaimed"] > 0
+        # still inside budget: survives the sweep...
+        assert sched.shed_expired(now=8.0) == []
+        # ...but a post-deadline recovery sheds it with the honest reason.
+        assert sched.shed_expired(now=10.0) == [req]
+        assert req.shed_reason == "deadline"
+        pool.check()
+
+    def test_cancel_queued_and_running(self):
+        """Hedged-retry dedup: cancel() sheds the losing copy wherever it
+        lives (queue or slot) with reason 'cancelled', and refuses
+        double-cancel / cancel-after-finish."""
+        sched, pool = self._sched(max_slots=1)
+        running, queued = _req(0, 4, arrival=0.0), _req(1, 4, arrival=1.0)
+        for r in (running, queued):
+            sched.submit(r)
+        sched.admit(now=2.0)  # one slot: `running` admitted, `queued` waits
+        assert queued.state is RequestState.QUEUED
+        assert sched.cancel(queued)
+        assert queued.state is RequestState.SHED
+        assert queued.shed_reason == "cancelled"
+        assert sched.cancel(running)
+        assert running.shed_reason == "cancelled"
+        assert not sched.cancel(running)  # already shed: nothing to do
+        assert pool.in_use == 0
+        assert sched.idle()
+        pool.check()
+
 
 # -- engine fixtures ---------------------------------------------------------
 
@@ -761,3 +802,23 @@ class TestEngineValidation:
         engine = ServingEngine(cfg, params, ENGINE_CFG, dtype=jnp.float32)
         with pytest.raises(ValueError, match="max_new_tokens"):
             engine.submit(np.arange(1, 4, dtype=np.int32), 0)
+
+    def test_submit_arrival_override_pins_slo_budget(self, tiny_lm):
+        """Failover SLO contract, cross-process half: a fleet supervisor
+        re-dispatching a dead replica's request passes the ORIGINAL
+        arrival, and an absolute deadline already in the past means the
+        survivor sheds it as 'deadline' instead of quietly serving it on
+        a brand-new budget."""
+        cfg, _, params = tiny_lm
+        clock = FakeClock(100.0)
+        engine = ServingEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32, clock=clock
+        )
+        prompt = np.arange(1, 5, dtype=np.int32)
+        fresh = engine.submit(prompt, 4)
+        assert fresh.arrival == 100.0  # default: stamped now
+        moved = engine.submit(prompt, 4, arrival=3.0, deadline=50.0)
+        assert moved.arrival == 3.0 and moved.deadline == 50.0
+        assert engine.scheduler.shed_expired(now=clock()) == [moved]
+        assert moved.shed_reason == "deadline"
+        assert fresh.state is RequestState.QUEUED  # no deadline: untouched

@@ -1,0 +1,290 @@
+"""Replica-failure drill: kill one replica and hang another under live
+load, prove the fleet's contract.
+
+The acceptance check for the serving fleet (``serving/fleet.py``,
+``docs/SERVING.md`` "Fault-tolerant fleet"), runnable standalone (``make
+fleet-smoke``) or from ``tests/test_multiprocess.py``:
+
+``kill_hang`` (the smoke-gated drill):
+
+1. Launch a 2-replica CPU fleet of the tiny serving model with
+   ``replica_kill@step:4,replica_hang@step:6`` planned — round-robin
+   distribution detonates the kill inside replica 0 and the hang inside
+   replica 1, each mid-decode with a burst of requests in flight.
+2. The supervisor must detect both (exit code for the kill; frozen
+   ``progress_seq`` under a still-beating heartbeat daemon for the hang),
+   re-dispatch every orphaned request from its prompt to the survivor
+   with its ORIGINAL arrival/deadline, and respawn each replica once.
+3. Mid-run, a rolling ``swap_weights`` replaces every replica's params
+   under load: drain → swap → re-include, zero dropped requests, and
+   ``serve_compile_total`` flat after warmup on every worker (the swap
+   ships a seed, not arrays; same shapes ⇒ no retrace).
+4. **Parity oracle**: every completed stream must be bit-identical to the
+   offline greedy decode of its prompt under the weight version that
+   served it — failover, re-dispatch, and the swap are invisible in the
+   tokens. Same bar as the single-engine ``--selftest``.
+5. **Accounting**: exactly one stream per accepted request, zero dropped;
+   ``fault_injected_total == recovery_total + rollback_total`` in the
+   final ``fleet_summary``; restarts/failure counters match the plan. The
+   drill prints the shed/SLO curve (TTFT p50/p99 before/during/after
+   failover + shed-by-reason) so a latency regression is visible even
+   when the invariants hold.
+
+``slow`` (hedging drill): plan ``replica_slow@step:2`` (0.25 s/step
+stall) against replica 0 with ``hedge_ms=60`` — hedged retries must fire,
+first-winner-cancels-loser must leave exactly one stream per rid, and the
+books must still balance (the fault "recovers" when a hedged request
+whose primary was the slow replica completes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: serve-smoke sized model/engine: small enough to compile in seconds on
+#: CPU, big enough that 3-slot continuous batching actually interleaves.
+MODEL_SPEC = {
+    "vocab_size": 256,
+    "num_layers": 2,
+    "num_heads": 2,
+    "num_kv_heads": None,
+    "head_dim": 16,
+    "d_model": 64,
+    "d_ff": 128,
+    "attention_window": None,
+}
+ENGINE_SPEC = {
+    "max_slots": 3,
+    "block_size": 8,
+    "num_blocks": 32,
+    "max_blocks_per_seq": 6,
+    "prefill_chunk": 8,
+    "max_queue": 64,
+}
+SEED = 0
+SWAP_SEED = 1
+
+
+def _base_env() -> dict[str, str]:
+    env = {}
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), os.environ.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    # Same persistent compile cache as the test suite: replica respawns
+    # re-warm from cache instead of paying a fresh XLA compile.
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache")),
+    )
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+    return env
+
+
+def _trace(n_burst: int, n_trickle: int, *, trickle_dt: float = 0.08,
+           max_new: int = 6, seed: int = 7) -> list[dict]:
+    """Deterministic trace: a t=0 burst (so both replicas hold several
+    in-flight requests when the faults detonate) followed by a trickle
+    (so the fleet is still under live load through recovery and the
+    rolling swap)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    entries = []
+    for i in range(n_burst + n_trickle):
+        n = int(rng.integers(3, 21))
+        entries.append({
+            "arrival": 0.0 if i < n_burst else (i - n_burst + 1) * trickle_dt,
+            "prompt": [int(t) for t in rng.integers(1, 256, size=n)],
+            "max_new": max_new,
+            "deadline": 0.0,
+        })
+    return entries
+
+
+def _check_parity(result, *, swap_seed=None) -> int:
+    """Every winning stream vs offline greedy under the weight version
+    that served it. Returns the number of streams checked."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.models.generate import generate
+
+    model = TransformerLM(
+        config=TransformerConfig(**MODEL_SPEC), dtype=jnp.float32
+    )
+    params_by_version: dict[int, object] = {}
+
+    def version_params(version: int):
+        if version not in params_by_version:
+            seed = SEED if version == 0 else swap_seed
+            assert seed is not None, f"stream served by unknown version {version}"
+            params_by_version[version] = model.init(
+                jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
+            )["params"]
+        return params_by_version[version]
+
+    for rid, rec in sorted(result.requests.items()):
+        out = generate(
+            model, version_params(rec["version"]),
+            jnp.asarray(rec["prompt"], jnp.int32)[None],
+            max_new_tokens=rec["max_new"], rng=jax.random.key(0),
+            temperature=0.0, eos_id=None,
+        )
+        expect = np.asarray(out)[0, len(rec["prompt"]):].tolist()
+        assert rec["tokens"] == expect, (
+            f"rid {rid} (version {rec['version']}, "
+            f"redispatched={rec['redispatched']}) diverged from offline "
+            f"greedy:\n  fleet  : {rec['tokens']}\n  offline: {expect}"
+        )
+    return len(result.requests)
+
+
+def _last_summary(fleet_dir: Path) -> dict:
+    summaries = [
+        rec for rec in map(
+            json.loads, (fleet_dir / "fleet_metrics.jsonl").open()
+        )
+        if rec.get("kind") == "fleet_summary"
+    ]
+    assert summaries, "no fleet_summary record emitted"
+    return summaries[-1]
+
+
+def _print_slo_curve(result) -> None:
+    def ms(v):
+        return f"{v * 1e3:.0f}ms" if v is not None else "-"
+
+    print(
+        "SLO curve (TTFT): "
+        + " | ".join(
+            f"{ph} p50/p99 {ms(result.ttft.get(ph + '_p50'))}/"
+            f"{ms(result.ttft.get(ph + '_p99'))}"
+            for ph in ("before", "during", "after")
+        )
+    )
+    shed = ", ".join(f"{n} {why}" for why, n in sorted(result.shed.items()))
+    print(f"shed: {shed or 'none'} | dropped: {result.dropped}")
+
+
+def run_drill(root: Path, fault: str = "kill_hang") -> dict:
+    from deeplearning_mpi_tpu.serving import FleetSupervisor
+
+    assert fault in ("kill_hang", "slow"), fault
+    root = Path(root)
+    if root.exists():
+        shutil.rmtree(root)
+    root.mkdir(parents=True)
+
+    if fault == "kill_hang":
+        # Entry i detonates on replica i % 2: kill replica 0, hang replica 1.
+        chaos = "replica_kill@step:4,replica_hang@step:6"
+        entries = _trace(12, 12)
+        hedge_ms = 0.0
+        swap_at, swap_seed = 8, SWAP_SEED
+        env = _base_env()
+    else:
+        chaos = "replica_slow@step:2"
+        entries = _trace(6, 6)
+        hedge_ms = 60.0
+        swap_at = swap_seed = None
+        env = _base_env()
+        env["DMT_CHAOS_STALL_S"] = "0.25"
+
+    sup = FleetSupervisor(
+        MODEL_SPEC, ENGINE_SPEC, 2, root / "fleet",
+        seed=SEED,
+        chaos=chaos,
+        hedge_ms=hedge_ms,
+        heartbeat_interval_s=0.2,
+        heartbeat_deadline_s=3.0,  # must clear one slow engine step, not warmup
+        spawn_grace_s=600.0,  # cold-cache warmup compile on one shared core
+        max_replica_restarts=4,
+        timeout_s=540.0,
+        env=env,
+    )
+    result = sup.run(entries, swap_at=swap_at, swap_seed=swap_seed)
+
+    # -- contract: nothing accepted was dropped, everything reconciles ----
+    assert result.dropped == 0, f"{result.dropped} request(s) vanished"
+    assert result.completed == len(entries) - sum(result.shed.values()), result
+    assert result.chaos_balanced is True, result.snapshot
+    assert result.compile_flat, "a worker recompiled after warmup"
+    s = _last_summary(root / "fleet")
+    injected = s.get("fault_injected_total", 0)
+    recovered = s.get("recovery_total", 0)
+    rolled_back = s.get("rollback_total", 0)
+    assert injected == recovered + rolled_back, s
+    assert s.get("chaos_balanced") is True, s
+
+    if fault == "kill_hang":
+        assert injected == 2, s
+        assert result.restarts == 2, result.restarts
+        assert result.failures == {"replica_kill": 1, "replica_hang": 1}, (
+            result.failures
+        )
+        assert result.redispatched >= 1, "no in-flight request failed over"
+        assert result.swap["performed"], result.swap
+        assert result.swap["compile_flat"], result.swap
+        assert s.get("fleet_replica_restarts_total") == 2, s
+    else:
+        assert injected == 1, s
+        assert result.restarts == 0, result.restarts
+        fired = result.snapshot.get('serve_hedge_total{outcome="fired"}', 0)
+        assert fired >= 1, "slow replica never triggered a hedge"
+        wins = (
+            result.snapshot.get('serve_hedge_total{outcome="hedge_win"}', 0)
+            + result.snapshot.get(
+                'serve_hedge_total{outcome="primary_win"}', 0
+            )
+        )
+        assert wins >= 1, result.snapshot
+
+    checked = _check_parity(result, swap_seed=swap_seed)
+    assert checked == result.completed, (checked, result.completed)
+
+    _print_slo_curve(result)
+    print(
+        f"fleet-drill OK ({fault}): {result.completed} streams bit-identical "
+        f"to offline greedy, {result.redispatched} re-dispatched, "
+        f"{result.restarts} restart(s), books reconciled "
+        f"(injected={injected:.0f} = recovered={recovered:.0f} "
+        f"+ rolled_back={rolled_back:.0f})"
+    )
+    return {
+        "completed": result.completed,
+        "dropped": result.dropped,
+        "restarts": result.restarts,
+        "failures": result.failures,
+        "redispatched": result.redispatched,
+        "hedge_total": result.snapshot.get("serve_hedge_total", 0),
+        "swap": result.swap,
+        "chaos_balanced": result.chaos_balanced,
+        "parity_checked": checked,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fault", default="kill_hang",
+                        choices=("kill_hang", "slow", "all"))
+    parser.add_argument("--root", default="/tmp/dmt_fleet_drill")
+    args = parser.parse_args(argv)
+    sys.path.insert(0, str(REPO))
+    faults = ("kill_hang", "slow") if args.fault == "all" else (args.fault,)
+    for fault in faults:
+        run_drill(Path(args.root) / fault, fault)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

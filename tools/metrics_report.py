@@ -14,7 +14,11 @@ Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
   keys when present), MFU (plus the remat-aware ``mfu_issued``/``mfu_gap``
   and the roofline ``overlap_fraction`` when the trainer emits them — see
   docs/PERF_ANALYSIS.md), HBM high-water marks;
-- ``eval`` kinds — last record's metric columns verbatim.
+- ``eval`` kinds — last record's metric columns verbatim;
+- ``fleet_summary`` — the serving fleet's end-of-run record
+  (``serving/fleet.py``): completions/shed/dropped, hedge outcomes
+  (``serve_hedge_total{outcome=...}``), replica restarts, swap downtime,
+  failover TTFT p50/p99 by phase, and the chaos reconciliation books.
 """
 
 from __future__ import annotations
@@ -86,11 +90,53 @@ def _percentile(values: list[float], q: float) -> float | None:
     return d[int(q * (len(d) - 1))]
 
 
+def _fleet_table(last: dict) -> str:
+    """The serving fleet's end-of-run record, rendered as one table:
+    delivery accounting, hedge outcomes, failover latency (supervisor-side
+    recovery close AND client-side TTFT by phase), swap downtime, and the
+    chaos reconciliation books."""
+    rows = [("replicas", _fmt(last.get("replicas"))),
+            ("requests completed", _fmt(last.get("completed_total"))),
+            ("requests shed", _fmt(last.get("shed_total"))),
+            ("requests dropped", _fmt(last.get("dropped_total"))),
+            ("re-dispatched (failover)", _fmt(last.get("redispatched_total"))),
+            ("replica restarts",
+             _fmt(last.get("fleet_replica_restarts_total")))]
+    # Hedge outcomes ride as labeled counters: serve_hedge_total{outcome=...}
+    for outcome in ("fired", "primary_win", "hedge_win", "duplicate"):
+        v = last.get(f'serve_hedge_total{{outcome="{outcome}"}}')
+        if v is not None:
+            rows.append((f"hedges {outcome.replace('_', ' ')}", _fmt(v)))
+    p50 = last.get("recovery_latency_s_p50")
+    if p50 is not None:
+        rows.append(("failover recovery p50 (s)", _fmt(p50)))
+    for ph in ("before", "during", "after"):
+        p50, p99 = last.get(f"ttft_{ph}_p50"), last.get(f"ttft_{ph}_p99")
+        if p50 is not None or p99 is not None:
+            rows.append((f"TTFT {ph} failover p50/p99 (s)",
+                         f"{_fmt(p50)} / {_fmt(p99)}"))
+    if last.get("swap_performed") is not None:
+        rows += [("weight swap performed", _fmt(last.get("swap_performed"))),
+                 ("swap downtime: rolling drain (s)",
+                  _fmt(last.get("swap_drain_s"))),
+                 ("completions during swap",
+                  _fmt(last.get("swap_completions_during")))]
+    rows.append(("compile flat after warmup", _fmt(last.get("compile_flat"))))
+    f, r, b = (last.get(k, 0) for k in ("fault_injected_total",
+                                        "recovery_total", "rollback_total"))
+    if f or r or b:
+        rows.append(("chaos books (injected = recovered + rolled back)",
+                     f"{_fmt(f)} = {_fmt(r)} + {_fmt(b)} "
+                     f"(balanced={_fmt(last.get('chaos_balanced'))})"))
+    return table("Serving fleet", rows)
+
+
 def summarize(records: list[dict]) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     epochs = [r for r in records if r.get("kind") == "epoch"]
     evals = [r for r in records
              if str(r.get("kind", "")).startswith(("eval", "final_eval"))]
+    fleet = [r for r in records if r.get("kind") == "fleet_summary"]
     out = []
 
     if steps:
@@ -177,8 +223,11 @@ def summarize(records: list[dict]) -> str:
                 if k not in ("ts", "kind")]
         out.append(table(f"Last eval ({last.get('kind')})", rows))
 
+    if fleet:
+        out.append(_fleet_table(fleet[-1]))
+
     if not out:
-        return "no step/epoch/eval records found\n"
+        return "no step/epoch/eval/fleet records found\n"
     return "\n".join(out)
 
 
@@ -210,11 +259,33 @@ def _selftest() -> int:
             "comm_bytes_per_step": 1.5e6,
         })
         reg.emit("final_eval", {"epoch": 0, "eval_loss": 1.6, "eval_accuracy": 0.41})
+        # A serving-fleet run's end-of-run record (serving/fleet.py run()):
+        # the hedge/restart/swap columns must render alongside the
+        # reconciliation books.
+        reg.emit("fleet_summary", {
+            "ok": True, "replicas": 2, "completed_total": 24,
+            "shed_total": 0, "dropped_total": 0, "redispatched_total": 12,
+            "fleet_replica_restarts_total": 2,
+            'serve_hedge_total{outcome="fired"}': 3,
+            'serve_hedge_total{outcome="hedge_win"}': 2,
+            'serve_hedge_total{outcome="primary_win"}': 1,
+            "recovery_latency_s_p50": 0.31,
+            "ttft_before_p50": 0.8, "ttft_before_p99": 1.1,
+            "ttft_during_p50": 1.4, "ttft_during_p99": 2.6,
+            "ttft_after_p50": 0.7, "ttft_after_p99": 1.0,
+            "swap_performed": True, "swap_drain_s": 1.9,
+            "swap_completions_during": 9, "compile_flat": True,
+            "fault_injected_total": 2, "recovery_total": 2,
+            "rollback_total": 0, "chaos_balanced": True,
+        })
         reg.close()
         report = summarize(load_records(path))
         print(report)
         for needle in ("images/s", "p50", "p95", "MFU", "collective bytes",
-                       "MFU issued", "MFU gap", "overlap fraction"):
+                       "MFU issued", "MFU gap", "overlap fraction",
+                       "hedges fired", "replica restarts",
+                       "failover recovery p50", "swap downtime",
+                       "chaos books"):
             if needle not in report:
                 print(f"selftest FAILED: '{needle}' missing from report",
                       file=sys.stderr)
