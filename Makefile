@@ -4,17 +4,18 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke
+.PHONY: verify selftest check smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The
 # serve-smoke, spec-smoke, chaos-smoke, tune-smoke, pod-smoke,
-# overlap-smoke, and fleet-smoke prerequisites gate the tier-1 run on the
-# serving engine's end-to-end parity selftest, the speculative-decode
-# parity/reconciliation drill, the fault-injection recovery drill, the
-# autotune loop, the elastic-pod rank-failure drill, the overlapped-ZeRO-1
-# bit-equality drill, and the serving-fleet replica-failure drill without
-# touching the ROADMAP command itself.
-verify: serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke
+# overlap-smoke, fleet-smoke, and disagg-smoke prerequisites gate the
+# tier-1 run on the serving engine's end-to-end parity selftest, the
+# speculative-decode parity/reconciliation drill, the fault-injection
+# recovery drill, the autotune loop, the elastic-pod rank-failure drill,
+# the overlapped-ZeRO-1 bit-equality drill, the serving-fleet
+# replica-failure drill, and the disaggregated prefill/decode drill
+# without touching the ROADMAP command itself.
+verify: serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Telemetry pipeline smoke: registry -> JSONL -> report, no training needed.
@@ -103,6 +104,31 @@ chaos-smoke:
 pod-smoke:
 	env JAX_PLATFORMS=cpu python tools/pod_drill.py --fault rank_kill \
 		--root /tmp/dmt_pod_smoke
+
+# Disaggregated prefill/decode drill (docs/SERVING.md "Disaggregated
+# topology"): the serve-smoke trace through the split topology — a
+# prefill-only engine handing completed prompts to a decode-only engine
+# over one shared KV pool — under a handoff_stall + serve_crash chaos
+# plan. The selftest asserts every stream is still bit-identical to
+# offline greedy (the handoff and both recoveries must be invisible in
+# the tokens); the second run gates the opt-in int8 paged KV cache on
+# measured token-level acceptance vs the fp reference.
+disagg-smoke:
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.serve_lm \
+		--selftest --disagg --warmup \
+		--chaos "handoff_stall@step:6,serve_crash@step:14" \
+		--num_layers 2 --num_heads 2 --head_dim 16 \
+		--d_model 64 --d_ff 128 --num_requests 8 --rate 100 \
+		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
+		--max_slots 3 --block_size 8 --num_blocks 32 \
+		--max_blocks_per_seq 6 --prefill_chunk 8
+	env JAX_PLATFORMS=cpu python -m deeplearning_mpi_tpu.cli.serve_lm \
+		--selftest --disagg --kv_dtype int8 \
+		--num_layers 2 --num_heads 2 --head_dim 16 \
+		--d_model 64 --d_ff 128 --num_requests 8 --rate 100 \
+		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
+		--max_slots 3 --block_size 8 --num_blocks 32 \
+		--max_blocks_per_seq 6 --prefill_chunk 8
 
 # Serving-fleet replica-failure drill (docs/SERVING.md "Fault-tolerant
 # fleet", docs/TPU_POD_RUNBOOK.md §8): a 2-replica CPU fleet under a

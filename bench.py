@@ -667,6 +667,190 @@ def bench_fleet(replicas: int = 2) -> dict:
     }
 
 
+def bench_disagg(
+    n_burst: int = 12,
+    n_trickle: int = 12,
+    max_new: int = 6,
+) -> dict:
+    """Disaggregated prefill/decode serving vs the colocated engine, plus
+    the int8 paged-KV capacity multiplier (ISSUE 9).
+
+    Three arms serve the SAME burst+trickle trace (``n_burst`` requests at
+    t=0, then ``n_trickle`` more at 80 ms spacing — the bench_fleet arrival
+    pattern, replayed in real time against the engine's monotonic clock):
+
+    - ``colocated`` — the single ``ServingEngine``, fp KV: the reference
+      streams and the TTFT baseline;
+    - ``disagg`` — ``DisaggregatedEngine`` (prefill-only engine handing
+      finished prompts to a decode-only engine over one shared pool), fp
+      KV. Greedy decode is batch-invariant, so these streams must be
+      BIT-identical to the colocated arm's — the split topology is judged
+      purely on latency (``ttft_p99_ratio_vs_colocated``: the headline
+      claim is that isolating prefill keeps decode's cadence, and
+      therefore tail TTFT under burst, no worse than colocated);
+    - ``disagg_int8`` — the same topology with the opt-in int8 paged KV
+      cache. Lossy by design, so it is judged the way the CLI gate judges
+      it: matched-prefix token acceptance against the fp reference
+      (greedy forks permanently at the first divergence), plus the
+      capacity multiplier below.
+
+    The int8 headline is ``resident_seqs_x``: at a FIXED HBM byte budget,
+    how many more sequences stay resident when a KV token costs
+    2·H_kv·D int8 bytes + 2·H_kv f32 scales instead of 2·H_kv·D fp bytes.
+    Both the analytic per-token numbers and the measured buffer bytes of
+    the two arms (same pool geometry) ride the details; the acceptance
+    bar for the ISSUE is >= 1.9x (see docs/PERF_ANALYSIS.md §13 for why
+    the smoke shape lands at 3.2x and a production GQA shape at ~3.6x).
+
+    The model is the serve-smoke tiny shape: like bench_fleet, this entry
+    measures scheduling/topology (handoff latency, admission under burst),
+    not model FLOPs. All arms are AOT-warmed; timed windows contain zero
+    compiles.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.serving import (
+        DisaggregatedEngine,
+        EngineConfig,
+        ServingEngine,
+    )
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, head_dim=16,
+        d_model=64, d_ff=128,
+    )
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dt)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    base = EngineConfig(
+        max_slots=3, block_size=8, num_blocks=32, max_blocks_per_seq=6,
+        prefill_chunk=8, max_queue=64,
+    )
+
+    rng = np.random.default_rng(7)
+    trace = []
+    for i in range(n_burst + n_trickle):
+        n = int(rng.integers(3, 21))
+        trace.append((
+            0.0 if i < n_burst else (i - n_burst + 1) * 0.08,
+            rng.integers(1, cfg.vocab_size, size=n).astype(np.int32),
+        ))
+
+    def pct(xs: list, q: float) -> float | None:
+        return round(float(np.percentile(xs, q)), 4) if xs else None
+
+    def run_arm(disagg: bool, kv_dtype: str | None) -> tuple[dict, list]:
+        registry = MetricsRegistry()
+        cls = DisaggregatedEngine if disagg else ServingEngine
+        engine = cls(
+            cfg, params,
+            dataclasses.replace(base, kv_dtype=kv_dtype),
+            dtype=dt, registry=registry,
+        )
+        engine.warmup()
+        idle = engine.idle if disagg else engine.scheduler.idle
+        reqs, pending = [], list(trace)
+        t0 = time.monotonic()
+        while pending or not idle():
+            now = time.monotonic() - t0
+            while pending and pending[0][0] <= now:
+                arr, prompt = pending.pop(0)
+                reqs.append(engine.submit(prompt, max_new, arrival=t0 + arr))
+            if not idle():
+                engine.step()
+            elif pending:  # trace gap: engine drained ahead of the trickle
+                gap = pending[0][0] - (time.monotonic() - t0)
+                if gap > 0:
+                    time.sleep(gap)
+        wall = time.monotonic() - t0
+        snap = registry.snapshot()
+        done = [r for r in reqs if r.t_finished is not None]
+        ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        tpots = sorted(r.tpot for r in done if r.tpot is not None)
+        tokens = sum(len(r.generated) for r in done)
+        detail = {
+            "requests_finished": len(done),
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+            "tpot_p50_s": pct(tpots, 50),
+            "wall_s": round(wall, 2),
+            "generated_tokens_per_s": round(tokens / wall, 1),
+            "decode_steps": snap.get("serve_decode_steps", 0),
+        }
+        if disagg:
+            detail["handoffs"] = snap.get("serve_handoffs_total", 0)
+        for key, val in snap.items():  # measured KV buffer bytes, by dtype
+            if key.startswith('serve_kv_bytes{dtype='):
+                detail["kv_bytes"] = {key.split('"')[1]: int(val)}
+        streams = [
+            [int(t) for t in r.generated]
+            for r in sorted(done, key=lambda r: r.rid)
+        ]
+        return detail, streams
+
+    colo, ref_streams = run_arm(False, None)
+    disagg, disagg_streams = run_arm(True, None)
+    int8, int8_streams = run_arm(True, "int8")
+
+    # int8 acceptance: matched-prefix tokens vs the fp reference (greedy
+    # forks permanently at the first divergence) — the same rule the CLI
+    # --kv_acceptance_min gate applies.
+    expected = accepted = 0
+    for ref, got in zip(ref_streams, int8_streams):
+        agree = 0
+        for a, b in zip(ref, got):
+            if a != b:
+                break
+            agree += 1
+        expected += len(ref)
+        accepted += agree
+
+    # Capacity at a fixed byte budget: bytes one KV token costs per layer.
+    hkv = cfg.num_kv_heads or cfg.num_heads
+    fp_tok = 2 * hkv * cfg.head_dim * jnp.dtype(dt).itemsize
+    int8_tok = 2 * hkv * cfg.head_dim * 1 + 2 * hkv * 4  # int8 q + f32 scale
+    resident_x = fp_tok / int8_tok
+
+    result = {
+        "requests": len(trace),
+        "burst": n_burst,
+        "trickle": n_trickle,
+        "max_new": max_new,
+        "colocated": colo,
+        "disagg": disagg,
+        "disagg_int8": int8,
+        "disagg_bit_identical_to_colocated": disagg_streams == ref_streams,
+        "ttft_p99_ratio_vs_colocated": (
+            round(disagg["ttft_p99_s"] / colo["ttft_p99_s"], 2)
+            if disagg["ttft_p99_s"] and colo["ttft_p99_s"] else None
+        ),
+        "int8_acceptance_rate": (
+            round(accepted / expected, 3) if expected else None
+        ),
+        "kv_bytes_per_token_per_layer": {
+            str(jnp.dtype(dt)): fp_tok, "int8": int8_tok,
+        },
+        # At a fixed pool byte budget, int8 keeps resident_seqs_x more
+        # sequences' KV resident than the fp cache (ISSUE bar: >= 1.9x).
+        "resident_seqs_x": round(resident_x, 2),
+        "device": str(jax.devices()[0].device_kind),
+    }
+    from deeplearning_mpi_tpu.compiler import autotune
+
+    db = autotune.default_db()
+    if db is not None and db.consulted:
+        result["tuning_provenance"] = db.consulted
+    return result
+
+
 def _kill_group(proc) -> None:
     """SIGKILL a child's whole process group, then reap it. The child may
     spawn helpers (tunnel client) that inherit the pipes; killing only the
@@ -741,6 +925,7 @@ def _combined_line(details: dict, error: str | None = None) -> str:
     serving = (details.get("lm_serving_2k") or {}).get("per_batch", {})
     spec = details.get("lm_spec_decode") or {}
     fleet = details.get("serving_fleet") or {}
+    disagg = details.get("serving_disagg") or {}
     allreduce = details.get("allreduce") or {}
     out = {
         "metric": "resnet50_bf16_images_per_sec_per_chip",
@@ -781,6 +966,16 @@ def _combined_line(details: dict, error: str | None = None) -> str:
         # completed on a survivor, and the client-visible TTFT hit.
         "fleet_failover_recovery_s": fleet.get("failover_recovery_s_p50"),
         "fleet_ttft_during_p99_s": fleet.get("ttft_during_p99_s"),
+        # Disaggregated prefill/decode + int8 KV headline (ISSUE 9): tail
+        # TTFT of the split topology relative to colocated on the same
+        # burst+trickle trace (<= 1.0 means no worse), and the int8 cache's
+        # resident-sequence multiplier at a fixed byte budget with its
+        # measured token-level acceptance vs the fp reference.
+        "disagg_ttft_p99_vs_colocated": disagg.get(
+            "ttft_p99_ratio_vs_colocated"
+        ),
+        "kv_int8_resident_seqs_x": disagg.get("resident_seqs_x"),
+        "kv_int8_acceptance_rate": disagg.get("int8_acceptance_rate"),
         "allreduce_latency_ms": allreduce.get("all_reduce_ms_mean"),
         "details": details,
     }
@@ -802,6 +997,9 @@ def _build_parser() -> argparse.ArgumentParser:
                         help="skip the speculative+batched serving workload")
     parser.add_argument("--skip_fleet", action="store_true",
                         help="skip the serving-fleet failover workload")
+    parser.add_argument("--skip_disagg", action="store_true",
+                        help="skip the disaggregated prefill/decode + "
+                        "int8 KV workload")
     parser.add_argument("--spec_batch", type=int, default=32,
                         help="concurrent requests in the lm_spec_decode "
                         "engine arm (the >=5x target holds for 8-32)")
@@ -867,6 +1065,8 @@ def _child_main(args) -> int:
         detail = bench_spec_decode(batch=args.spec_batch)
     elif key == "serving_fleet":
         detail = bench_fleet()
+    elif key == "serving_disagg":
+        detail = bench_disagg()
     elif key == "allreduce":
         detail = bench_allreduce()
     else:
@@ -1055,6 +1255,16 @@ def main() -> None:
             value_key="failover_recovery_s_p50",
             # 2 worker processes each paying a (cached) warmup compile,
             # plus one respawn after the planned kill.
+            budget_s=max(args.workload_timeout, 900.0),
+        )
+
+    if not args.skip_disagg:
+        run(
+            "serving_disagg",
+            metric="serving_disagg_int8_resident_seqs_x", unit="x",
+            value_key="resident_seqs_x",
+            # 3 engine arms (colocated, disagg, disagg+int8), each paying
+            # a (cached) warmup compile before its timed replay.
             budget_s=max(args.workload_timeout, 900.0),
         )
 

@@ -68,6 +68,23 @@ def build_parser() -> argparse.ArgumentParser:
                      "(chunked prefill interleaves with decode)")
     eng.add_argument("--max_queue", type=int, default=64,
                      help="bounded request queue; overflow is shed")
+    eng.add_argument("--kv_dtype", default=None, choices=("int8",),
+                     help="paged KV cache storage dtype (default: the "
+                     "compute dtype). int8 stores per-(token,head) scales "
+                     "and dequantizes in-gather — ~half the pool bytes per "
+                     "position, so more resident sequences at fixed HBM; "
+                     "lossy, so --selftest gates on token-level acceptance "
+                     "vs the fp reference instead of bit-exact parity")
+    eng.add_argument("--kv_acceptance_min", type=float, default=0.9,
+                     help="minimum token-level acceptance rate vs offline "
+                     "greedy the --selftest requires under a lossy "
+                     "--kv_dtype (matched-prefix tokens / expected tokens)")
+    eng.add_argument("--disagg", action="store_true",
+                     help="disaggregated topology: a prefill-only engine "
+                     "hands completed prompts (block tables over a shared "
+                     "KV pool — no KV bytes move) to a decode-only engine, "
+                     "so decode batches never stall behind long prefills; "
+                     "with --replicas > 1 every replica runs disaggregated")
     eng.add_argument("--use_kernel", action="store_true",
                      help="dispatch decode attention to the Pallas "
                      "flash_decode kernel (per-row fill levels)")
@@ -151,6 +168,12 @@ def build_parser() -> argparse.ArgumentParser:
     fleet.add_argument("--fleet_dir", default=None,
                        help="scratch directory for replica mailboxes, "
                        "heartbeats, and logs (default: a fresh temp dir)")
+    fleet.add_argument("--tp", type=int, default=1,
+                       help="tensor-parallel degree per replica: each "
+                       "replica's params are sharded across this many "
+                       "devices (virtual CPU devices under JAX_PLATFORMS="
+                       "cpu) via the Megatron column/row rules; requires "
+                       "--replicas > 1")
     parser.add_argument("--metrics_file", default=None,
                         help="append canonical telemetry JSONL records here "
                         "(readable by tools/metrics_report.py)")
@@ -158,7 +181,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="deterministic fault-injection spec, e.g. "
                         "'serve_crash@step:12' — the engine crashes mid-step "
                         "and recovers (requeue + KV reconcile); with "
-                        "--replicas N > 1: 'replica_kill@step:4,"
+                        "--disagg also 'handoff_stall@step:N' (the "
+                        "prefill→decode handoff wedges, then recovers); "
+                        "with --replicas N > 1: 'replica_kill@step:4,"
                         "replica_hang@step:6' (fleet faults); falls back "
                         "to $DMT_CHAOS (docs/RESILIENCE.md)")
     parser.add_argument("--selftest", action="store_true",
@@ -224,10 +249,15 @@ def replay(engine, entries, *, poll_s: float = 0.0005):
     submission order."""
     from deeplearning_mpi_tpu.resilience import InjectedFault
 
+    # DisaggregatedEngine exposes idle() directly (two schedulers + a
+    # handoff queue); the colocated engine's idleness is its scheduler's.
+    idle = (
+        engine.idle if hasattr(engine, "idle") else engine.scheduler.idle
+    )
     pending = deque(entries)
     reqs = []
     t0 = time.monotonic()
-    while pending or not engine.scheduler.idle():
+    while pending or not idle():
         now = time.monotonic() - t0
         while pending and pending[0]["arrival"] <= now:
             e = pending.popleft()
@@ -238,7 +268,7 @@ def replay(engine, entries, *, poll_s: float = 0.0005):
             reqs.append(
                 engine.submit(e["prompt"], e["max_new"], deadline=deadline)
             )
-        if not engine.scheduler.idle():
+        if not idle():
             try:
                 engine.step()
             except InjectedFault as fault:
@@ -294,6 +324,13 @@ def _report(reqs, wall_s, registry, out=sys.stderr):
         ),
         file=out,
     )
+    if snap.get("serve_handoffs_total"):
+        print(
+            f"disagg: {snap['serve_handoffs_total']:.0f} prefill→decode "
+            f"handoffs, {snap.get('serve_handoff_stalls_total', 0):.0f} "
+            "stalled step(s)",
+            file=out,
+        )
     prop = snap.get("spec_proposed_total", 0)
     if prop:
         acc = snap.get("spec_accepted_total", 0)
@@ -351,6 +388,7 @@ def _run_fleet(args, eos_id) -> int:
         model_spec, engine_spec, args.replicas, fleet_dir,
         seed=args.random_seed, eos_id=eos_id, warmup=True,
         chaos=args.chaos, hedge_ms=args.hedge_ms, registry=registry,
+        disagg=args.disagg, tp=args.tp,
     )
     swap_seed = args.random_seed + 1 if args.swap_at is not None else None
     try:
@@ -458,26 +496,39 @@ def main(argv: list[str] | None = None) -> int:
     chaos_spec = args.chaos or _os.environ.get("DMT_CHAOS") or ""
     if chaos_spec.strip():
         from deeplearning_mpi_tpu.resilience import (
+            DISAGG_KINDS,
             FLEET_KINDS,
             SERVE_KINDS,
             validate_plan_kinds,
         )
 
-        supported = FLEET_KINDS if args.replicas > 1 else SERVE_KINDS
-        workload = (
-            "serving fleet" if args.replicas > 1 else "single-replica serving"
-        )
+        if args.replicas > 1:
+            supported, workload = FLEET_KINDS, "serving fleet"
+        elif args.disagg:
+            supported, workload = DISAGG_KINDS, "disaggregated serving"
+        else:
+            supported, workload = SERVE_KINDS, "single-replica serving"
         try:
             validate_plan_kinds(chaos_spec, supported, workload=workload)
         except ValueError as e:
             print(f"--chaos: {e}", file=sys.stderr)
             return 1
     if args.replicas > 1:
+        if args.kv_dtype:
+            # Fleet parity is a bit-exact bar (failover must be invisible
+            # in the tokens); a lossy KV cache would make it vacuous.
+            print("--kv_dtype does not compose with --replicas > 1: fleet "
+                  "parity is bit-exact", file=sys.stderr)
+            return 1
         if args.platform:
             import jax
 
             jax.config.update("jax_platforms", args.platform)
         return _run_fleet(args, eos_id)
+    if args.tp > 1:
+        print("--tp > 1 shards replica processes; it requires "
+              "--replicas > 1", file=sys.stderr)
+        return 1
     if args.moe_experts > 0:
         # Same fail-fast rule as dmt-generate's composition checks: the
         # engine would raise anyway, but before minutes of init/restore.
@@ -499,6 +550,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
     from deeplearning_mpi_tpu.serving import (
+        DisaggregatedEngine,
         EngineConfig,
         RequestState,
         ServingEngine,
@@ -621,7 +673,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"bad --decode_buckets {args.decode_buckets!r}: expected "
               "comma-separated integers like '8,16,32'", file=sys.stderr)
         return 1
-    engine = ServingEngine(
+    engine_cls = DisaggregatedEngine if args.disagg else ServingEngine
+    engine = engine_cls(
         cfg, params,
         EngineConfig(
             max_slots=args.max_slots,
@@ -634,6 +687,7 @@ def main(argv: list[str] | None = None) -> int:
             spec_k=spec_k,
             decode_buckets=decode_buckets,
             max_hold_steps=args.max_hold_steps,
+            kv_dtype=args.kv_dtype,
         ),
         dtype=dtype, eos_id=eos_id, registry=registry, chaos=chaos,
         draft_config=draft_cfg, draft_params=draft_params,
@@ -688,7 +742,10 @@ def main(argv: list[str] | None = None) -> int:
                if r.state is not RequestState.FINISHED]
         print(f"selftest: not all requests completed: {bad}", file=sys.stderr)
         return 1
+    kv_lossy = args.kv_dtype is not None
     mismatched = 0
+    tokens_expected = 0
+    tokens_accepted = 0
     for r in done:
         out = generate(
             model, params, jnp.asarray(r.prompt)[None],
@@ -699,14 +756,47 @@ def main(argv: list[str] | None = None) -> int:
         if eos_id is not None and eos_id in expect:
             # offline pads with EOS to the static window; the engine stops.
             expect = expect[: expect.index(eos_id) + 1]
+        # Matched-prefix length: greedy decode forks permanently at the
+        # first divergent token, so the prefix is the honest agreement
+        # measure for the lossy-KV acceptance gate.
+        agree = 0
+        for a, b in zip(r.generated, expect):
+            if a != b:
+                break
+            agree += 1
+        tokens_expected += len(expect)
+        tokens_accepted += agree
         if r.generated != expect:
             mismatched += 1
+            if not kv_lossy:
+                print(
+                    f"selftest: rid {r.rid} diverged from offline greedy:\n"
+                    f"  engine : {r.generated}\n  offline: {expect}",
+                    file=sys.stderr,
+                )
+    if kv_lossy:
+        # A quantized KV cache is allowed to perturb tokens — but only so
+        # far. The gate is MEASURED acceptance against the fp reference,
+        # not a promise: quantization bugs (wrong scale, stale epoch)
+        # crater acceptance and fail here.
+        acceptance = tokens_accepted / max(tokens_expected, 1)
+        if acceptance < args.kv_acceptance_min:
             print(
-                f"selftest: rid {r.rid} diverged from offline greedy:\n"
-                f"  engine : {r.generated}\n  offline: {expect}",
+                f"selftest FAILED: {args.kv_dtype} KV acceptance "
+                f"{acceptance:.1%} ({tokens_accepted}/{tokens_expected} "
+                f"tokens match the fp reference) below the "
+                f"--kv_acceptance_min {args.kv_acceptance_min:.1%} gate",
                 file=sys.stderr,
             )
-    if mismatched:
+            return 1
+        print(
+            f"selftest {args.kv_dtype} KV: acceptance {acceptance:.1%} "
+            f"({tokens_accepted}/{tokens_expected} tokens, "
+            f"{mismatched} stream(s) diverged) >= "
+            f"{args.kv_acceptance_min:.1%} gate",
+            file=sys.stderr,
+        )
+    elif mismatched:
         print(f"selftest FAILED: {mismatched}/{len(done)} request(s) "
               "diverged", file=sys.stderr)
         return 1
@@ -728,8 +818,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"selftest speculative: {prop:.0f} proposed = {acc:.0f} "
               f"accepted + {rb:.0f} rolled back (rate {acc / prop:.1%})",
               file=sys.stderr)
+    bar = (
+        f"within the {args.kv_acceptance_min:.1%} acceptance gate vs"
+        if kv_lossy else "bit-identical to"
+    )
     print(
-        f"selftest OK: {len(done)} requests bit-identical to offline "
+        f"selftest OK: {len(done)} requests {bar} offline "
         f"greedy decode ({engine.pool.total_allocated} block allocations, "
         f"{engine.pool.total_freed} frees)",
         file=sys.stderr,

@@ -314,10 +314,29 @@ def tuned_attention_blocks(
 
 
 def tuned_decode_schedule(
-    shape: tuple[int, ...], dtype: Any
+    shape: tuple[int, ...], dtype: Any, *, role: str | None = None
 ) -> dict[str, Any] | None:
     """``{"schedule": "kernel"|"einsum", "block": int|None}`` for a
-    ``[B, L, Hkv, D]`` decode buffer, or None when untuned."""
+    ``[B, L, Hkv, D]`` decode buffer, or None when untuned.
+
+    ``role`` selects a disaggregated engine's own key space (a
+    ``|role=decode`` suffix): a prefill-only and a decode-only engine see
+    different live shapes and should keep independent winners. A role
+    lookup falls back to the shared (role-less) entry, so an untuned role
+    inherits the colocated tuning instead of losing it.
+    """
+    if role:
+        try:
+            db = default_db()
+            if db is not None:
+                key = tuning_key(
+                    "flash_decode", shape, dtype, jax.default_backend()
+                ) + f"|role={role}"
+                params = db.lookup_key(key)
+                if params and params.get("schedule") in ("kernel", "einsum"):
+                    return params
+        except Exception:
+            pass
     params = _consult("flash_decode", shape, dtype)
     if not params or params.get("schedule") not in ("kernel", "einsum"):
         return None
@@ -358,42 +377,57 @@ def decode_bucket_key(
     shape: tuple[int, ...],
     dtype: Any,
     backend: str | None = None,
+    role: str | None = None,
 ) -> str:
     """Key for one decode (batch, context) bucket over a ``[S, L, Hkv, D]``
     gathered-pool shape:
-    ``decode_bucket|b<batch>xc<context>|<dims>|<dtype>|<backend>``.
+    ``decode_bucket|b<batch>xc<context>|<dims>|<dtype>|<backend>`` —
+    suffixed ``|role=<role>`` for a disaggregated engine's own key space.
 
     The plain ``flash_decode`` entry keys on the buffer shape alone, which
     collapses every live condition a serving step can be in to ONE
     schedule; the bucket key space splits it by how many slots are live
     and how deep they are — the two variables the kernel-vs-einsum
-    crossover actually moves with.
+    crossover actually moves with. A prefill-only engine and a decode-only
+    engine split further by role: their live (batch, context) mixes never
+    overlap, so a shared winner is the wrong winner for at least one.
     """
     backend = backend or jax.default_backend()
     dims = "x".join(str(int(s)) for s in shape)
-    return (
+    key = (
         f"decode_bucket|b{int(batch_bucket)}xc{int(context_bucket)}|"
         f"{dims}|{jnp.dtype(dtype).name}|{backend}"
     )
+    return key + (f"|role={role}" if role else "")
 
 
 def tuned_decode_bucket(
-    batch: int, context: int, shape: tuple[int, ...], dtype: Any
+    batch: int,
+    context: int,
+    shape: tuple[int, ...],
+    dtype: Any,
+    *,
+    role: str | None = None,
 ) -> dict[str, Any] | None:
     """The tuned decode schedule for LIVE (batch, context) values — both
     bucketed here, batch capped at the slot count and context at the
     gathered length — or None when untuned. Never raises (call-site
-    consult: the serving hot loop hits this every step)."""
+    consult: the serving hot loop hits this every step). With ``role``
+    set, the role-specific entry wins and the shared entry is the
+    fallback — same inheritance rule as :func:`tuned_decode_schedule`."""
     try:
         db = default_db()
         if db is None:
             return None
         bb = pow2_bucket(batch, cap=int(shape[0]))
         cb = pow2_bucket(context, cap=int(shape[1]))
-        params = db.lookup_key(decode_bucket_key(bb, cb, tuple(shape), dtype))
-        if not params or params.get("schedule") not in ("kernel", "einsum"):
-            return None
-        return params
+        for r in ((role, None) if role else (None,)):
+            params = db.lookup_key(
+                decode_bucket_key(bb, cb, tuple(shape), dtype, role=r)
+            )
+            if params and params.get("schedule") in ("kernel", "einsum"):
+                return params
+        return None
     except Exception:
         return None
 

@@ -81,6 +81,32 @@ def quantize_array(w: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize cached K/V rows to (int8, f32 scale over the last axis).
+
+    One symmetric absmax scale per token row per head — ``scale[...] =
+    max|x[..., :]| / 127`` over ``head_dim`` — so a loud token (attention
+    sink, BOS) cannot flatten the resolution of its neighbours the way a
+    per-block or per-tensor scale would. Same ``|x - q*scale| <= scale/2``
+    elementwise bound as :func:`quantize_array`. Input ``[..., head_dim]``
+    yields ``q`` of the same shape and ``scale`` of shape ``x.shape[:-1]``.
+    """
+    x32 = jnp.asarray(x, jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x32), axis=-1) / 127.0, 1e-12
+    ).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(
+    q: jax.Array, scale: jax.Array, dtype: Any = jnp.float32
+) -> jax.Array:
+    """Inverse of :func:`quantize_kv`: ``q * scale`` broadcast over the
+    trailing ``head_dim`` axis, in the requested compute dtype."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
 def quantize_lm_params(
     params: Any, *, targets: tuple[str, ...] = DEFAULT_TARGETS
 ) -> Any:

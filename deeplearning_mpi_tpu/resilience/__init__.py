@@ -24,6 +24,7 @@ rollback_total`` is the chaos harness's own acceptance check
 """
 
 from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
+    DISAGG_KINDS,
     FLEET_KINDS,
     SERVE_KINDS,
     ChaosInjector,
@@ -63,6 +64,7 @@ from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F4
 __all__ = [
     "ChaosInjector",
     "CheckpointCorruption",
+    "DISAGG_KINDS",
     "FLEET_KINDS",
     "FaultPlan",
     "FaultSpec",

@@ -43,6 +43,9 @@ kind             unit    injection site
                          now for serving
 ``replica_slow``  step   every replica step gains ``stall_s`` of latency from
                          the trigger on — the router's hedged-retry path
+``handoff_stall`` step   the prefill→decode handoff queue of a disaggregated
+                         engine wedges: completed prefills pile up undrained
+                         until the coordinator notices and un-sticks it
 ===============  ======  =====================================================
 
 ``rank_kill``/``rank_hang`` are *pod-level* kinds (:data:`POD_KINDS`): the
@@ -82,6 +85,7 @@ from deeplearning_mpi_tpu.telemetry.registry import labeled
 
 __all__ = [
     "ChaosInjector",
+    "DISAGG_KINDS",
     "ENV_RANK",
     "ENV_SPEC",
     "ENV_STALL",
@@ -116,6 +120,7 @@ FAULT_UNITS = {
     "replica_kill": "step",
     "replica_hang": "step",
     "replica_slow": "step",
+    "handoff_stall": "step",
 }
 
 #: kinds whose accounting lives in the pod supervisor, not the worker: the
@@ -128,6 +133,13 @@ FLEET_KINDS = frozenset({"replica_kill", "replica_hang", "replica_slow"})
 
 #: kinds a single-replica serving engine can detonate in-process.
 SERVE_KINDS = frozenset({"serve_crash"})
+
+#: kinds a disaggregated (prefill/decode split) engine can detonate
+#: in-process — everything a colocated engine can, plus the handoff wedge
+#: that only exists once prefill and decode are separate instances. Kept
+#: distinct from :data:`SERVE_KINDS` so a colocated run handed
+#: ``handoff_stall`` still fails loud at validation.
+DISAGG_KINDS = SERVE_KINDS | frozenset({"handoff_stall"})
 
 #: exit code of a rank_kill'd worker — distinguishable from collateral
 #: crashes (a peer's collective erroring out) in the supervisor's logs.
@@ -420,6 +432,20 @@ class ChaosInjector:
         """Serving-engine hook, mid-step (after prefill mutated host state)."""
         if self.should_fire("serve_crash", step):
             raise InjectedFault(f"chaos: injected serve_crash@step:{step}")
+
+    def check_handoff_stall(self, *, step: int) -> bool:
+        """Disaggregated-serving hook, called before the prefill→decode
+        handoff drain. Returns True while the queue is WEDGED: a planned
+        ``handoff_stall`` fires once at its trigger (counting the fault) and
+        the wedge then persists — completed prefills keep piling up — until
+        the coordinator notices the stuck queue and records the recovery,
+        mirroring how ``replica_slow`` persists until hedging beats it.
+        """
+        self.should_fire("handoff_stall", step)
+        return any(
+            s.kind == "handoff_stall" and s.fired and not s.recovered
+            for s in self.plan.specs
+        )
 
     def check_replica_fault(self, *, step: int) -> float:
         """Fleet replica-worker hook, called between engine steps. Returns

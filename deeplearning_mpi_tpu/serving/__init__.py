@@ -6,8 +6,14 @@ Entry points: :class:`~deeplearning_mpi_tpu.serving.engine.ServingEngine`
 is ``deeplearning_mpi_tpu.cli.serve_lm``. Design doc: ``docs/SERVING.md``.
 """
 
+from deeplearning_mpi_tpu.serving.disagg import (
+    DecodeEngine,
+    DisaggregatedEngine,
+    PrefillEngine,
+)
 from deeplearning_mpi_tpu.serving.engine import (
     EngineConfig,
+    KVBuffers,
     PagedForward,
     ServingEngine,
 )
@@ -30,11 +36,15 @@ from deeplearning_mpi_tpu.serving.router import Router
 from deeplearning_mpi_tpu.serving.speculative import SpeculativeDecoder
 
 __all__ = [
+    "DecodeEngine",
+    "DisaggregatedEngine",
     "EngineConfig",
     "FleetFailure",
     "FleetResult",
     "FleetSupervisor",
+    "KVBuffers",
     "PagedForward",
+    "PrefillEngine",
     "PagedKVPool",
     "Request",
     "RequestState",

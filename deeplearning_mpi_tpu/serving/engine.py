@@ -63,7 +63,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,7 @@ from deeplearning_mpi_tpu.ops.attention import (
     dense_attention,
     repeat_kv,
 )
+from deeplearning_mpi_tpu.ops.quant import dequantize_kv, quantize_kv
 from deeplearning_mpi_tpu.serving.kv_pool import (
     SCRATCH_BLOCK,
     PagedKVPool,
@@ -91,7 +92,7 @@ from deeplearning_mpi_tpu.serving.scheduler import (
     Scheduler,
 )
 
-__all__ = ["EngineConfig", "PagedForward", "ServingEngine"]
+__all__ = ["EngineConfig", "KVBuffers", "PagedForward", "ServingEngine"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,10 +139,50 @@ class EngineConfig:
     #: resets every time a decode step actually runs, so decode is never
     #: deferred more than this many consecutive steps
     max_hold_steps: int = 4
+    #: KV-cache storage dtype, by NAME so the config stays JSON-round-
+    #: trippable across the fleet's spec files. ``None`` = the engine's
+    #: compute dtype (the default — keeps the bit-identical-to-offline-
+    #: greedy invariant untouched). ``"int8"`` stores quantized pages plus
+    #: per-token-row f32 scales (``ops/quant.quantize_kv``), dequantized
+    #: inside the jitted gather — an opt-in capacity multiplier whose
+    #: output is tolerance-gated, not bit-exact (docs/SERVING.md).
+    kv_dtype: str | None = None
 
     @property
     def max_seq_len(self) -> int:
         return self.max_blocks_per_seq * self.block_size
+
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the KV pools store an integer dtype (scales ride
+        alongside and the gather dequantizes)."""
+        if self.kv_dtype is None:
+            return False
+        return jnp.issubdtype(jnp.dtype(self.kv_dtype), jnp.integer)
+
+
+class KVBuffers:
+    """Mutable holder for the device KV pools a :class:`ServingEngine`
+    threads through its jitted steps — ``(k, v)`` for float storage,
+    ``(k, v, k_scale, v_scale)`` for quantized storage (see
+    :func:`~deeplearning_mpi_tpu.serving.kv_pool.init_kv_buffers`).
+
+    The indirection exists for disaggregation: a prefill-only and a
+    decode-only engine share ONE set of pools (handoff transfers block-
+    table ownership, never copies pages), and because every step donates
+    and rebinds the buffers, the shared thing must be this holder, not the
+    arrays — whichever engine stepped last leaves the live buffers here
+    for the other to pick up.
+    """
+
+    __slots__ = ("bufs",)
+
+    def __init__(self, bufs: tuple[Any, ...]) -> None:
+        self.bufs = bufs
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(b.nbytes) for b in self.bufs)
 
 
 class PagedForward:
@@ -154,6 +195,13 @@ class PagedForward:
     is called at TRACE time of every program (the engine wires it to the
     ``serve_compile_total`` counter so "zero compiles on the first
     request" stays an assertable counter delta).
+
+    ``kv_dtype`` (a dtype, or None for full precision) selects the KV
+    storage format. Every program threads one ``kv`` tuple — ``(k, v)``
+    pools, plus ``(k_scale, v_scale)`` when quantized — and all scatter/
+    gather goes through :meth:`_kv_scatter` / :meth:`_kv_gather`, so the
+    int8 path quantizes rows on the way into the pool and dequantizes
+    inside the gather, leaving the attention math itself dtype-blind.
     """
 
     def __init__(
@@ -163,11 +211,62 @@ class PagedForward:
         dtype: Any,
         *,
         tick: Callable[[], None] | None = None,
+        kv_dtype: Any = None,
     ) -> None:
         self.config = config
         self.engine = engine
         self.dtype = dtype
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype is not None and jnp.issubdtype(
+            jnp.dtype(kv_dtype), jnp.integer
+        )
         self._tick = tick or (lambda: None)
+
+    # -- paged scatter/gather (the storage-format seam) ----------------------
+    def _kv_scatter(
+        self,
+        kv: tuple[jax.Array, ...],
+        i: int,
+        bid: jax.Array,
+        off: jax.Array,
+        k: jax.Array,
+        v: jax.Array,
+    ) -> tuple[jax.Array, ...]:
+        """Write this step's new K/V rows (``[..., Hkv, D]``) through the
+        block table at layer ``i``. Quantized storage also writes the
+        per-row scales — data and scales land in ONE jitted program, which
+        is what makes the pool's scale/block epoch check a real invariant
+        rather than a race window."""
+        if not self.quantized:
+            k_pool, v_pool = kv
+            return (
+                k_pool.at[i, bid, off].set(k.astype(k_pool.dtype)),
+                v_pool.at[i, bid, off].set(v.astype(v_pool.dtype)),
+            )
+        k_pool, v_pool, k_scale, v_scale = kv
+        qk, sk = quantize_kv(k)
+        qv, sv = quantize_kv(v)
+        return (
+            k_pool.at[i, bid, off].set(qk),
+            v_pool.at[i, bid, off].set(qv),
+            k_scale.at[i, bid, off].set(sk),
+            v_scale.at[i, bid, off].set(sv),
+        )
+
+    def _kv_gather(
+        self, kv: tuple[jax.Array, ...], i: int, tables: jax.Array
+    ) -> tuple[jax.Array, jax.Array]:
+        """Gather layer ``i``'s pages through ``tables``, returning K/V in
+        the compute dtype — the int8 path dequantizes here, inside the
+        jitted program, so downstream attention never sees storage."""
+        if not self.quantized:
+            k_pool, v_pool = kv
+            return k_pool[i][tables], v_pool[i][tables]
+        k_pool, v_pool, k_scale, v_scale = kv
+        return (
+            dequantize_kv(k_pool[i][tables], k_scale[i][tables], self.dtype),
+            dequantize_kv(v_pool[i][tables], v_scale[i][tables], self.dtype),
+        )
 
     # -- building blocks (mirror TransformerLM numerics) ---------------------
     def _lin(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
@@ -218,8 +317,7 @@ class PagedForward:
     def decode_step(
         self,
         params: Any,
-        k_pool: jax.Array,
-        v_pool: jax.Array,
+        kv: tuple[jax.Array, ...],  # pools (+ scales when quantized)
         tables: jax.Array,   # [S, MB] int32 block ids (0-padded)
         lengths: jax.Array,  # [S] int32 known tokens (prompt + generated)
         tokens: jax.Array,   # [S] int32 token fed this step (position len-1)
@@ -227,7 +325,7 @@ class PagedForward:
         *,
         use_kernel: bool | None = False,
         block: int | None = None,
-    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    ) -> tuple[tuple[jax.Array, ...], jax.Array]:
         # Host side effect at TRACE time only: one tick per compilation of
         # this program. A warmed engine calls the AOT executable directly
         # (never retraces), so "zero compiles on the first request" is an
@@ -262,13 +360,13 @@ class PagedForward:
             lp = params[f"layer_{i}"]
             h = self._rmsnorm(x, lp["attn_norm"]["scale"])
             q, k, v = self._attn_proj(lp, h, pos)
-            k_pool = k_pool.at[i, bid, off].set(k[:, 0])
-            v_pool = v_pool.at[i, bid, off].set(v[:, 0])
+            kv = self._kv_scatter(kv, i, bid, off, k[:, 0], v[:, 0])
             # Gather each slot's pages back into position order: the block
             # table IS the logical->physical map, so indexing the pool with
             # it yields a contiguous [S, L] view of every sequence.
-            k_seq = k_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim)
-            v_seq = v_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim)
+            k_seq, v_seq = self._kv_gather(kv, i, tables)
+            k_seq = k_seq.reshape(S, L, kv_heads, cfg.head_dim)
+            v_seq = v_seq.reshape(S, L, kv_heads, cfg.head_dim)
             ctx = batched_decode_attention(
                 q, k_seq, v_seq, idx, window=window,
                 use_kernel=use_kernel,
@@ -281,19 +379,18 @@ class PagedForward:
             x = self._mlp(lp, x)
         x = self._rmsnorm(x, params["final_norm"]["scale"])
         logits = self._logits(x[:, 0], params)  # [S, V] f32
-        return k_pool, v_pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return kv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # -- jitted prefill chunk ------------------------------------------------
     def prefill_chunk(
         self,
         params: Any,
-        k_pool: jax.Array,
-        v_pool: jax.Array,
+        kv: tuple[jax.Array, ...],  # pools (+ scales when quantized)
         table: jax.Array,   # [MB] int32 this slot's block table (0-padded)
         tokens: jax.Array,  # [C] int32 prompt chunk (0-padded past n_valid)
         start: jax.Array,   # scalar int32: absolute position of tokens[0]
         n_valid: jax.Array,  # scalar int32: real rows in the chunk
-    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    ) -> tuple[tuple[jax.Array, ...], jax.Array]:
         # Trace-time compile tick — see decode_step.
         self._tick()
         cfg, e = self.config, self.engine
@@ -313,10 +410,10 @@ class PagedForward:
             lp = params[f"layer_{i}"]
             h = self._rmsnorm(x, lp["attn_norm"]["scale"])
             q, k, v = self._attn_proj(lp, h, pos)
-            k_pool = k_pool.at[i, bid, off].set(k[0])
-            v_pool = v_pool.at[i, bid, off].set(v[0])
-            k_seq = k_pool[i][table].reshape(1, L, kv_heads, cfg.head_dim)
-            v_seq = v_pool[i][table].reshape(1, L, kv_heads, cfg.head_dim)
+            kv = self._kv_scatter(kv, i, bid, off, k[0], v[0])
+            k_seq, v_seq = self._kv_gather(kv, i, table)
+            k_seq = k_seq.reshape(1, L, kv_heads, cfg.head_dim)
+            v_seq = v_seq.reshape(1, L, kv_heads, cfg.head_dim)
             # The chunk's queries see every earlier chunk's pages PLUS this
             # chunk's own rows (just scattered above); causal masking in
             # absolute coordinates via q_offset. Stale rows from a previous
@@ -336,20 +433,19 @@ class PagedForward:
         # chunk — the host ignores them otherwise). Padded rows compute
         # garbage that is never read and whose K/V went to scratch.
         x_last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
-        return k_pool, v_pool, self._logits(x_last[0, 0], params)
+        return kv, self._logits(x_last[0, 0], params)
 
     # -- jitted verify step (speculative decoding) ---------------------------
     def verify_step(
         self,
         params: Any,
-        k_pool: jax.Array,
-        v_pool: jax.Array,
+        kv: tuple[jax.Array, ...],  # pools (+ scales when quantized)
         tables: jax.Array,   # [S, MB] int32 block ids (0-padded)
         lengths: jax.Array,  # [S] int32 known tokens before this step
         tokens: jax.Array,   # [S, W] int32: last known token + proposals
         n_live: jax.Array,   # [S] int32 fed rows per slot (n_prop + 1)
         active: jax.Array,   # [S] bool
-    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    ) -> tuple[tuple[jax.Array, ...], jax.Array]:
         """One batched multi-token target forward over the paged KV pools.
 
         The width-``W = spec_k + 1`` extension of :meth:`prefill_chunk`,
@@ -410,13 +506,13 @@ class PagedForward:
             lp = params[f"layer_{i}"]
             h = self._rmsnorm(x, lp["attn_norm"]["scale"])
             q, k, v = self._attn_proj(lp, h, pos)
-            k_pool = k_pool.at[i, bid, off].set(k)
-            v_pool = v_pool.at[i, bid, off].set(v)
+            kv = self._kv_scatter(kv, i, bid, off, k, v)
+            k_seq, v_seq = self._kv_gather(kv, i, tables)
             k_seq = repeat_kv(
-                k_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim), rep
+                k_seq.reshape(S, L, kv_heads, cfg.head_dim), rep
             )
             v_seq = repeat_kv(
-                v_pool[i][tables].reshape(S, L, kv_heads, cfg.head_dim), rep
+                v_seq.reshape(S, L, kv_heads, cfg.head_dim), rep
             )
             scores = jnp.einsum(
                 "bqhd,bkhd->bhqk", q, k_seq,
@@ -439,7 +535,7 @@ class PagedForward:
             x = self._mlp(lp, x)
         x = self._rmsnorm(x, params["final_norm"]["scale"])
         logits = self._logits(x, params)  # [S, W, V] f32
-        return k_pool, v_pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return kv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 class ServingEngine:
@@ -455,6 +551,16 @@ class ServingEngine:
     ``TransformerLM`` sharing the target's vocab; the usual choice is the
     target's own first N layers (``models.transformer.truncate_lm_params``),
     which reuses the target's tied embedding for the draft logits.
+
+    ``pool``/``kv_buffers`` inject SHARED block accounting and device
+    pools — the disaggregation seam (``serving/disagg.py``): a prefill-
+    only and a decode-only engine built over the same pool + holder hand
+    sequences off by transferring block-table ownership, with the pages
+    already in place. Omitted (the default), the engine owns both privately
+    — the colocated topology, byte-identical to the pre-disaggregation
+    behavior. ``role`` labels this engine's autotuning key space
+    (``compiler.autotune`` ``|role=...`` suffix) so each role keeps its own
+    tuned winners.
     """
 
     def __init__(
@@ -470,6 +576,10 @@ class ServingEngine:
         chaos: Any = None,
         draft_config: TransformerConfig | None = None,
         draft_params: Any = None,
+        pool: PagedKVPool | None = None,
+        kv_buffers: KVBuffers | None = None,
+        draft_kv_buffers: KVBuffers | None = None,
+        role: str | None = None,
     ) -> None:
         engine = engine or EngineConfig()
         if config.moe_experts > 0:
@@ -497,6 +607,14 @@ class ServingEngine:
                 "draft_params (models.transformer.truncate_lm_params builds "
                 "a self-draft from the target's own first N layers)"
             )
+        storage = jnp.dtype(engine.kv_dtype) if engine.kv_dtype else None
+        if storage is not None and jnp.issubdtype(storage, jnp.integer):
+            if storage != jnp.dtype(jnp.int8):
+                raise NotImplementedError(
+                    f"integer KV storage supports int8 only, got "
+                    f"{storage.name} (ops.quant.quantize_kv is an int8 "
+                    "symmetric scheme)"
+                )
         self.config = config
         self.engine = engine
         self.params = params
@@ -504,7 +622,21 @@ class ServingEngine:
         self.eos_id = eos_id
         self._clock = clock
         self.chaos = chaos
-        self.pool = PagedKVPool(engine.num_blocks, engine.block_size)
+        self.role = role
+        if pool is None:
+            pool = PagedKVPool(
+                engine.num_blocks, engine.block_size, kv_dtype=storage
+            )
+        elif (
+            pool.num_blocks != engine.num_blocks
+            or pool.block_size != engine.block_size
+        ):
+            raise ValueError(
+                f"injected pool geometry {pool.num_blocks}x{pool.block_size} "
+                f"does not match engine config "
+                f"{engine.num_blocks}x{engine.block_size}"
+            )
+        self.pool = pool
         self.scheduler = Scheduler(
             self.pool,
             max_slots=engine.max_slots,
@@ -514,10 +646,14 @@ class ServingEngine:
             decode_buckets=engine.decode_buckets,
             max_hold_steps=engine.max_hold_steps,
         )
-        self._k, self._v = init_kv_buffers(
-            config.num_layers, engine.num_blocks, engine.block_size,
-            config.num_kv_heads or config.num_heads, config.head_dim, dtype,
-        )
+        if kv_buffers is None:
+            kv_buffers = KVBuffers(init_kv_buffers(
+                config.num_layers, engine.num_blocks, engine.block_size,
+                config.num_kv_heads or config.num_heads, config.head_dim,
+                storage if storage is not None else dtype,
+            ))
+        self._kvh = kv_buffers
+        self._kv_dtype_name = (storage or jnp.dtype(dtype)).name
         self._next_rid = 0
         self.steps = 0
         self._metrics = registry
@@ -530,11 +666,23 @@ class ServingEngine:
                 "serve_tokens_discarded_total",
             ):
                 registry.counter(name)
+            # A role-labeled engine (one half of a disaggregated pair)
+            # keeps its occupancy gauges under role=... names — two engines
+            # share one registry, and unlabeled gauges would be whichever
+            # role stepped last. The coordinator owns the unlabeled
+            # combined view.
             for name in (
                 "serve_queue_depth", "serve_slots_active",
                 "serve_kv_blocks_in_use",
             ):
-                registry.gauge(name)
+                registry.gauge(self._role_name(name))
+            # Pool footprint by storage dtype: the capacity-multiplier
+            # metric metrics_report's per-role table reads ("how many
+            # bytes of KV does this engine hold, and in what format").
+            from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+            registry.gauge(self._role_name("serve_kv_bytes"))
+            registry.gauge(labeled("serve_kv_bytes", dtype=self._kv_dtype_name))
             registry.histogram("serve_ttft_s")
             registry.histogram("serve_tpot_s")
             registry.histogram("serve_compile_seconds")
@@ -554,13 +702,16 @@ class ServingEngine:
         self._fwd = PagedForward(
             config, engine, dtype,
             tick=lambda: self._inc("serve_compile_total"),
+            kv_dtype=storage,
         )
         # KV-cache donation, vetoed where unsafe (XLA:CPU + persistent
         # compile cache — compiler.cache.donation_safe, reached through the
         # compat shim): the engine restores weights from disk and then runs
         # these jitted steps, the exact restore-then-execute sequence that
         # corrupts the heap with donated cache-deserialized executables.
-        self._kv_donate = (1, 2) if buffer_donation_supported() else ()
+        # Donating argument 1 donates every leaf of the kv tuple — data
+        # pools and (when quantized) scale pools alike.
+        self._kv_donate = (1,) if buffer_donation_supported() else ()
         self._decode_jit = jax.jit(
             functools.partial(self._fwd.decode_step, use_kernel=engine.use_kernel),
             donate_argnums=self._kv_donate,
@@ -589,11 +740,24 @@ class ServingEngine:
                 target_config=config, engine=engine, dtype=dtype,
                 tick=lambda: self._inc("serve_compile_total"),
                 donate=self._kv_donate,
+                kv_dtype=storage,
+                kv_buffers=draft_kv_buffers,
             )
             self._verify_jit = jax.jit(
                 self._fwd.verify_step, donate_argnums=self._kv_donate
             )
             self._verify_fn = self._timed_first_call(self._verify_jit)
+
+    @property
+    def _kv(self) -> tuple[Any, ...]:
+        """The live device KV pools — always read through the shared
+        holder: a disaggregated peer's step may have donated and replaced
+        the arrays since this engine last ran."""
+        return self._kvh.bufs
+
+    @_kv.setter
+    def _kv(self, bufs: tuple[Any, ...]) -> None:
+        self._kvh.bufs = bufs
 
     def _timed_first_call(self, jitted: Callable[..., Any]) -> Callable[..., Any]:
         """Wrap a jitted program so its first dispatch — the one that pays
@@ -631,6 +795,7 @@ class ServingEngine:
                 self.config.head_dim,
             ),
             self.dtype,
+            role=self.role,
         ) or {"schedule": "einsum", "block": None}
         return (tuned["schedule"], tuned.get("block")) == (
             base["schedule"], base.get("block")
@@ -688,13 +853,13 @@ class ServingEngine:
         slots_i32 = jnp.zeros((e.max_slots,), jnp.int32)
         reg.register(
             "serve_decode_step", self._decode_jit,
-            self.params, self._k, self._v,
+            self.params, self._kv,
             jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
             slots_i32, slots_i32, jnp.zeros((e.max_slots,), bool),
         )
         reg.register(
             "serve_prefill_chunk", self._prefill_jit,
-            self.params, self._k, self._v,
+            self.params, self._kv,
             jnp.zeros((e.max_blocks_per_seq,), jnp.int32),
             jnp.zeros((e.prefill_chunk,), jnp.int32),
             jnp.int32(0), jnp.int32(1),
@@ -702,7 +867,7 @@ class ServingEngine:
         if self._spec is not None:
             reg.register(
                 "serve_verify_step", self._verify_jit,
-                self.params, self._k, self._v,
+                self.params, self._kv,
                 jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
                 slots_i32,
                 jnp.zeros((e.max_slots, e.spec_k + 1), jnp.int32),
@@ -735,12 +900,12 @@ class ServingEngine:
         off = jnp.zeros((e.max_slots,), bool)
         for wb in self._gather_widths()[:-1]:
             t = jnp.zeros((e.max_slots, wb), jnp.int32)
-            self._k, self._v, _ = self._decode_jit(
-                self.params, self._k, self._v, t, idle, idle, off
+            self._kv, _ = self._decode_jit(
+                self.params, self._kv, t, idle, idle, off
             )
             if self._spec is not None:
-                self._k, self._v, _ = self._verify_jit(
-                    self.params, self._k, self._v, t, idle,
+                self._kv, _ = self._verify_jit(
+                    self.params, self._kv, t, idle,
                     jnp.zeros((e.max_slots, e.spec_k + 1), jnp.int32),
                     idle, off,
                 )
@@ -798,18 +963,41 @@ class ServingEngine:
         per PREFILL slot → grow/evict for KV pressure → one batched decode
         (or draft-propose + verify) step → retire finished sequences.
         Returns the requests that FINISHED this step (their freed blocks
-        are already back in the pool, ready for the next admission)."""
+        are already back in the pool, ready for the next admission).
+
+        The phases are factored into ``_phase_*`` methods so the
+        disaggregated engines (``serving/disagg.py``) can each run exactly
+        the subset their role owns — a prefill engine never decodes, a
+        decode engine never admits from a prompt queue — against this one
+        implementation of each phase.
+        """
         now = self._clock()
         finished: list[Request] = []
+        self._phase_admit(now)
+        self._phase_prefill(finished)
+        self._phase_chaos()
+        decoding = self._phase_grow()
+        self._phase_decode(decoding, finished)
+        self.steps += 1
+        self._set_gauges()
+        return finished
+
+    # -- step phases ---------------------------------------------------------
+    def _phase_admit(self, now: float) -> list[Request]:
+        """Shed expired queued requests, then admit into free slots."""
         for _ in self.scheduler.shed_expired(now):
             self._inc("serve_requests_shed")
         admitted = self.scheduler.admit(now)
         self._inc("serve_requests_admitted", len(admitted))
+        return admitted
 
+    def _phase_prefill(self, finished: list[Request]) -> None:
+        """One prefill chunk for every PREFILL slot."""
         for req in list(self.scheduler.running()):
             if req.state is RequestState.PREFILL:
                 self._prefill_one(req, finished)
 
+    def _phase_chaos(self) -> None:
         if self.chaos is not None:
             # Mid-step, after prefill has already mutated host + device
             # state — the nastiest crash point: admitted requests hold
@@ -817,6 +1005,9 @@ class ServingEngine:
             # completes. recover() must untangle exactly this.
             self.chaos.check_serve_crash(step=self.steps)
 
+    def _phase_grow(self) -> list[Request]:
+        """Mandatory KV growth for every DECODE slot; returns the decode
+        batch that survived it."""
         # Feeding a token at position length-1 writes its K/V there, so a
         # slot needs blocks_for(length) blocks BEFORE the step; growth is
         # where OOM pressure surfaces and the scheduler may evict. In
@@ -832,10 +1023,16 @@ class ServingEngine:
                     self._inc("serve_requests_shed")
                     break
         # grow() may have evicted requests from the snapshot above.
-        decoding = [
+        return [
             r for r in self.scheduler.running()
             if r.state is RequestState.DECODE
         ]
+
+    def _phase_decode(
+        self, decoding: list[Request], finished: list[Request]
+    ) -> None:
+        """One batched decode (or draft-propose + verify) dispatch, unless
+        bucketed batch formation holds it."""
         if decoding and self.scheduler.hold_decode(len(decoding)):
             # Bucketed batch formation: prefill/admission supply can still
             # grow this decode batch toward the next bucket, so spend one
@@ -849,9 +1046,6 @@ class ServingEngine:
                 self._spec_decode(decoding, finished)
             else:
                 self._plain_decode(decoding, finished)
-        self.steps += 1
-        self._set_gauges()
-        return finished
 
     def _gather_width(self, blocks_held: int) -> int:
         """Static block-table width for this step's jitted program: the
@@ -914,6 +1108,7 @@ class ServingEngine:
                     self.config.head_dim,
                 ),
                 self.dtype,
+                role=self.role,
             )
             if tuned is not None and not self._is_base_schedule(
                 tuned, tables.shape[1]
@@ -921,10 +1116,14 @@ class ServingEngine:
                 fn = self._decode_variant(
                     tuned["schedule"] == "kernel", tuned.get("block")
                 )
-        self._k, self._v, next_tok = fn(
-            self.params, self._k, self._v,
+        self._kv, next_tok = fn(
+            self.params, self._kv,
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(tokens), jnp.asarray(active),
+        )
+        BS = e.block_size
+        self._record_writes(
+            {req.blocks[(req.length - 1) // BS] for req in decoding}
         )
         self._inc("serve_decode_steps")
         next_np = np.asarray(jax.device_get(next_tok))
@@ -990,12 +1189,19 @@ class ServingEngine:
         tokens = np.zeros((e.max_slots, W), np.int32)
         tokens[:, 0] = last
         tokens[:, 1:] = props
-        self._k, self._v, greedy = self._verify_fn(
-            self.params, self._k, self._v,
+        self._kv, greedy = self._verify_fn(
+            self.params, self._kv,
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(tokens), jnp.asarray(n_prop + 1),
             jnp.asarray(active),
         )
+        touched: set[int] = set()
+        for req in decoding:
+            n_fed = int(n_prop[req.slot]) + 1
+            lo = (req.length - 1) // BS
+            hi = min((req.length - 1 + n_fed - 1) // BS, len(req.blocks) - 1)
+            touched.update(req.blocks[lo : hi + 1])
+        self._record_writes(touched)
         self._inc("serve_decode_steps")
         self._inc("spec_verify_steps")
         greedy_np = np.asarray(jax.device_get(greedy))  # [S, W]
@@ -1113,10 +1319,14 @@ class ServingEngine:
         chunk[:n_valid] = req.prompt[start : start + n_valid]
         table = np.zeros((e.max_blocks_per_seq,), np.int32)
         table[: len(req.blocks)] = req.blocks
-        self._k, self._v, last_logits = self._prefill_fn(
-            self.params, self._k, self._v,
+        self._kv, last_logits = self._prefill_fn(
+            self.params, self._kv,
             jnp.asarray(table), jnp.asarray(chunk),
             jnp.int32(start), jnp.int32(n_valid),
+        )
+        self._record_writes(
+            req.blocks[start // e.block_size :
+                       (start + n_valid - 1) // e.block_size + 1]
         )
         if self._spec is not None:
             # The draft ingests the prompt alongside the target (same
@@ -1139,6 +1349,23 @@ class ServingEngine:
             self._metrics.histogram("serve_ttft_s").observe(req.ttft)
         if self._done(req, tok):
             self._finish(req, req.t_first_token, finished)
+        else:
+            self._prefill_complete(req)
+
+    def _prefill_complete(self, req: Request) -> None:
+        """Hook: ``req`` just finished its prompt (first token emitted) and
+        is entering DECODE. No-op in the colocated engine; the
+        disaggregated prefill engine overrides this to hand the sequence —
+        block table and all — to its decode peer (``serving/disagg.py``)."""
+
+    def _record_writes(self, blocks: Iterable[int]) -> None:
+        """Log this dispatch's KV writes against the pool's per-block
+        epochs (data + scale move together on quantized pools, which is
+        exactly the invariant ``pool.check()`` enforces)."""
+        blocks = [b for b in blocks if b != SCRATCH_BLOCK]
+        self.pool.record_fill(blocks)
+        if self.pool.quantized:
+            self.pool.record_scale(blocks)
 
     # -- retirement ---------------------------------------------------------
     def _done(self, req: Request, tok: int) -> bool:
@@ -1158,13 +1385,31 @@ class ServingEngine:
         if self._metrics is not None and amount:
             self._metrics.counter(name).inc(amount)
 
+    def _role_name(self, name: str) -> str:
+        """Gauge name for this engine: role-labeled when disaggregated,
+        plain otherwise."""
+        if self.role is None:
+            return name
+        from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+        return labeled(name, role=self.role)
+
     def _set_gauges(self) -> None:
         if self._metrics is None:
             return
-        self._metrics.gauge("serve_queue_depth").set(
+        self._metrics.gauge(self._role_name("serve_queue_depth")).set(
             self.scheduler.queue_depth()
         )
-        self._metrics.gauge("serve_slots_active").set(
+        self._metrics.gauge(self._role_name("serve_slots_active")).set(
             self.scheduler.slots_active()
         )
-        self._metrics.gauge("serve_kv_blocks_in_use").set(self.pool.in_use)
+        self._metrics.gauge(self._role_name("serve_kv_blocks_in_use")).set(
+            self.pool.in_use
+        )
+        from deeplearning_mpi_tpu.telemetry.registry import labeled
+
+        nbytes = self._kvh.nbytes
+        self._metrics.gauge(self._role_name("serve_kv_bytes")).set(nbytes)
+        self._metrics.gauge(
+            labeled("serve_kv_bytes", dtype=self._kv_dtype_name)
+        ).set(nbytes)

@@ -135,26 +135,74 @@ def worker_main(argv: list[str] | None = None) -> int:
 
     rdir = Path(args.dir)
     spec = json.loads(Path(args.spec).read_text())
+    # Topology keys ride next to (not inside) the engine kwargs dict.
+    disagg = bool(spec.get("disagg", False))
+    tp = int(spec.get("tp", 1))
+    if tp > 1 and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # Hardware-free TP: fake CPU devices, forced BEFORE the first
+        # backend use (model.init below initializes it).
+        from deeplearning_mpi_tpu.runtime.bootstrap import (
+            set_virtual_cpu_devices,
+        )
+
+        set_virtual_cpu_devices(tp)
     cfg = TransformerConfig(**spec["model"])
     model = TransformerLM(config=cfg, dtype=jnp.float32)
+
+    param_sharding = None
+    if tp > 1:
+        from deeplearning_mpi_tpu.runtime.mesh import MeshSpec, create_mesh
+
+        mesh = create_mesh(MeshSpec(data=1, model=tp))
 
     def init_params(seed: int):
         # EXACTLY the serve_lm --selftest init: the drill's offline-greedy
         # oracle rebuilds params from (config, seed) alone, so any drift
         # here is a parity failure, not a tolerable difference.
-        return model.init(
+        p = model.init(
             jax.random.key(seed), jnp.zeros((1, 8), jnp.int32)
         )["params"]
+        if tp > 1:
+            # Megatron-style sharded replica: one replica = tp devices.
+            # XLA's GSPMD partitioner splits the engine's jitted steps
+            # along the param shardings; the serving code is unchanged.
+            from deeplearning_mpi_tpu.parallel.tensor_parallel import (
+                infer_tp_param_sharding,
+            )
+
+            nonlocal param_sharding
+            if param_sharding is None:
+                param_sharding = infer_tp_param_sharding(p, mesh)
+            p = jax.device_put(p, param_sharding)
+        return p
 
     version = int(spec.get("version", 0))
     params = init_params(int(spec["seed"]))
     registry = MetricsRegistry()
     chaos = ChaosInjector.from_spec(None, registry=registry)  # $DMT_CHAOS
-    engine = ServingEngine(
+    engine_cls: Any = ServingEngine
+    if disagg:
+        from deeplearning_mpi_tpu.serving.disagg import DisaggregatedEngine
+
+        engine_cls = DisaggregatedEngine
+    engine = engine_cls(
         cfg, params, EngineConfig(**spec["engine"]),
         dtype=jnp.float32, eos_id=spec.get("eos_id"),
         registry=registry, chaos=chaos,
     )
+    if disagg:
+        eng_idle = engine.idle
+        q_depth = lambda: engine.prefill.scheduler.queue_depth()  # noqa: E731
+        slots_active = lambda: (  # noqa: E731
+            engine.prefill.scheduler.slots_active()
+            + engine.decode.scheduler.slots_active()
+        )
+        handoff_depth = lambda: engine.handoff_depth  # noqa: E731
+    else:
+        eng_idle = engine.scheduler.idle
+        q_depth = engine.scheduler.queue_depth
+        slots_active = engine.scheduler.slots_active
+        handoff_depth = lambda: 0  # noqa: E731
     if spec.get("warmup", True):
         engine.warmup()
     compile_counter = registry.counter("serve_compile_total")
@@ -218,7 +266,7 @@ def worker_main(argv: list[str] | None = None) -> int:
                 elif op == "stop":
                     stop = True
 
-            if not stop and not engine.scheduler.idle():
+            if not stop and not eng_idle():
                 if chaos is not None:
                     slow_s = chaos.check_replica_fault(step=engine.steps)
                     if slow_s > 0.0:
@@ -256,8 +304,9 @@ def worker_main(argv: list[str] | None = None) -> int:
             # seq, which is exactly what LivenessTracker watches.
             hb.progress = {
                 "step": engine.steps,
-                "queue_depth": engine.scheduler.queue_depth(),
-                "slots_active": engine.scheduler.slots_active(),
+                "queue_depth": q_depth(),
+                "slots_active": slots_active(),
+                "handoff_depth": handoff_depth(),
                 "ttft_p50": ttft_hist.percentile(0.5) or 0.0,
                 "version": version,
             }
@@ -369,6 +418,8 @@ class FleetSupervisor:
         timeout_s: float = 600.0,
         registry: Any = None,
         env: Mapping[str, str] | None = None,
+        disagg: bool = False,
+        tp: int = 1,
     ) -> None:
         from deeplearning_mpi_tpu.resilience.faults import (
             FLEET_KINDS,
@@ -385,6 +436,14 @@ class FleetSupervisor:
         self.seed = seed
         self.eos_id = eos_id
         self.warmup = warmup
+        #: topology knobs, shipped to workers inside spec.json. ``disagg``
+        #: replicas run a DisaggregatedEngine (prefill/decode split);
+        #: ``tp > 1`` shards each replica's params across tp (virtual CPU)
+        #: devices via infer_tp_param_sharding.
+        self.disagg = bool(disagg)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        self.tp = int(tp)
         self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
         if self.chaos_spec.strip():
             validate_plan_kinds(
@@ -432,6 +491,8 @@ class FleetSupervisor:
             "version": rep.version,
             "eos_id": self.eos_id,
             "warmup": self.warmup,
+            "disagg": self.disagg,
+            "tp": self.tp,
         }))
         (rdir / "inbox.jsonl").touch()
         env = dict(os.environ)
@@ -537,6 +598,10 @@ class FleetSupervisor:
             hedge_ms=self.hedge_ms,
             exclusion_s=self.exclusion_s,
             registry=self.registry,
+            roles=(
+                {r: "disagg" for r in range(self.num_replicas)}
+                if self.disagg else None
+            ),
         )
         per_chaos = self._replica_chaos()
         replicas = {

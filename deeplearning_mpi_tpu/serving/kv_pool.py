@@ -54,7 +54,9 @@ class PagedKVPool:
     token positions each. Host-side accounting only; see the module
     docstring for the device-buffer half."""
 
-    def __init__(self, num_blocks: int, block_size: int) -> None:
+    def __init__(
+        self, num_blocks: int, block_size: int, *, kv_dtype: Any = None
+    ) -> None:
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 blocks (1 scratch + 1 usable), got {num_blocks}"
@@ -63,6 +65,7 @@ class PagedKVPool:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.kv_dtype = kv_dtype
         # Descending so pop() hands out the lowest id first — deterministic
         # allocation order, which the tests (and debugging) rely on.
         self._free: list[int] = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
@@ -70,6 +73,21 @@ class PagedKVPool:
         # Monotonic counters for telemetry / the reuse-proving tests.
         self.total_allocated = 0
         self.total_freed = 0
+        # Quantized pools carry a scale array next to each data block; the
+        # engine must write both in the same step. Per-block write epochs
+        # make "data written but scale not" (or vice versa) a checkable
+        # invariant instead of a silent garbage gather.
+        self._fill_epoch: dict[int, int] = {}
+        self._scale_epoch: dict[int, int] = {}
+
+    @property
+    def quantized(self) -> bool:
+        """True when the device pools store integer KV + separate scales."""
+        if self.kv_dtype is None:
+            return False
+        import jax.numpy as jnp
+
+        return jnp.issubdtype(jnp.dtype(self.kv_dtype), jnp.integer)
 
     # -- capacity queries ---------------------------------------------------
     @property
@@ -113,6 +131,30 @@ class PagedKVPool:
             self._used.remove(b)
             self._free.append(b)
             self.total_freed += 1
+            self._fill_epoch.pop(b, None)
+            self._scale_epoch.pop(b, None)
+
+    # -- quantized-pool write accounting ------------------------------------
+    def record_fill(self, blocks: Iterable[int]) -> None:
+        """Note that the engine scattered KV *data* into ``blocks`` this
+        step. Paired with :meth:`record_scale` on quantized pools; the
+        scratch block is ignored (its writes are garbage by design)."""
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                continue
+            if b not in self._used:
+                raise ValueError(f"recording fill of unallocated block {b}")
+            self._fill_epoch[b] = self._fill_epoch.get(b, 0) + 1
+
+    def record_scale(self, blocks: Iterable[int]) -> None:
+        """Note that the engine scattered *scale* rows into ``blocks`` this
+        step (quantized pools only)."""
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                continue
+            if b not in self._used:
+                raise ValueError(f"recording scale of unallocated block {b}")
+            self._scale_epoch[b] = self._scale_epoch.get(b, 0) + 1
 
     def reconcile(self, live_blocks: Iterable[int]) -> dict[str, int]:
         """Rebuild the free list from the ground truth of which blocks are
@@ -141,6 +183,21 @@ class PagedKVPool:
         self._used = set(live)
         all_ids = set(range(SCRATCH_BLOCK + 1, self.num_blocks))
         self._free = sorted(all_ids - live, reverse=True)
+        # Epochs restart from a consistent baseline: reclaimed blocks lose
+        # theirs with the block, survivors keep whatever matched state they
+        # had, adopted blocks start at zero (their pages will be rewritten
+        # by the requeued prefill anyway).
+        self._fill_epoch = {b: self._fill_epoch.get(b, 0) for b in live}
+        if self.quantized:
+            # A crash can land between the data and scale scatters; recovery
+            # requeues and re-prefills every live sequence, so declare the
+            # surviving pages consistent by fiat rather than tripping check()
+            # on a tear the rewrite is about to erase.
+            self._scale_epoch = dict(self._fill_epoch)
+        else:
+            self._scale_epoch = {
+                b: self._scale_epoch.get(b, 0) for b in live
+            }
         return {"reclaimed": len(reclaimed), "adopted": len(adopted)}
 
     # -- invariants ---------------------------------------------------------
@@ -156,6 +213,19 @@ class PagedKVPool:
             f"leak: {len(free)} free + {len(self._used)} used "
             f"!= {self.capacity}"
         )
+        stray = (set(self._fill_epoch) | set(self._scale_epoch)) - self._used
+        assert not stray, f"write epochs recorded for non-live blocks {stray}"
+        if self.quantized:
+            torn = [
+                b
+                for b in self._used
+                if self._fill_epoch.get(b, 0) != self._scale_epoch.get(b, 0)
+            ]
+            assert not torn, (
+                f"stale scales: data/scale write epochs diverge on blocks "
+                f"{torn} — a gather here would dequantize with the wrong "
+                f"scale"
+            )
 
 
 def init_kv_buffers(
@@ -164,16 +234,31 @@ def init_kv_buffers(
     block_size: int,
     kv_heads: int,
     head_dim: int,
-    dtype: Any,
-) -> tuple[Any, Any]:
-    """Zero-initialized device pools: ``(k, v)``, each
-    ``[num_layers, num_blocks, block_size, kv_heads, head_dim]``.
+    kv_dtype: Any,
+) -> tuple[Any, ...]:
+    """Zero-initialized device pools in the explicit storage ``kv_dtype``.
 
-    One array per K/V (not per layer) so the jitted engine step threads two
-    buffers instead of ``2 * num_layers`` — the layer axis is indexed
-    statically inside the step's Python layer loop.
+    Float dtypes return ``(k, v)``, each ``[num_layers, num_blocks,
+    block_size, kv_heads, head_dim]``. Integer dtypes (the int8 KV cache)
+    additionally return per-token-row scale pools — ``(k, v, k_scale,
+    v_scale)`` with scales shaped ``[num_layers, num_blocks, block_size,
+    kv_heads]`` in f32, one absmax scale per cached row per head (see
+    ``ops/quant.quantize_kv``).
+
+    One array per K/V (not per layer) so the jitted engine step threads a
+    handful of buffers instead of ``2 * num_layers`` — the layer axis is
+    indexed statically inside the step's Python layer loop.
     """
     import jax.numpy as jnp
 
     shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
-    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    k = jnp.zeros(shape, kv_dtype)
+    v = jnp.zeros(shape, kv_dtype)
+    if not jnp.issubdtype(jnp.dtype(kv_dtype), jnp.integer):
+        return k, v
+    # Scales default to 1 (not 0): a gather from a never-written block then
+    # dequantizes zeros to zeros instead of 0 * 0 hiding a missing write
+    # behind an all-zero page that happens to look plausible.
+    sshape = (num_layers, num_blocks, block_size, kv_heads)
+    ones = jnp.ones(sshape, jnp.float32)
+    return k, v, ones, jnp.ones(sshape, jnp.float32)

@@ -73,6 +73,7 @@ class Router:
         hedge_ms: float = 0.0,
         exclusion_s: float = 1.0,
         registry: Any = None,
+        roles: dict[int, str] | None = None,
     ) -> None:
         self._clock = clock
         self.hedge_s = hedge_ms / 1000.0
@@ -81,9 +82,18 @@ class Router:
         self._replicas: dict[int, _Replica] = {
             int(r): _Replica() for r in replicas
         }
+        #: replica id -> topology role ("colocated" when unmapped).
+        #: Disaggregated replicas score differently (see :meth:`score`) and
+        #: are selectable by role (:meth:`select` ``role=``).
+        self._roles: dict[int, str] = {
+            int(r): v for r, v in (roles or {}).items()
+        }
         self._requests: dict[int, _Tracked] = {}
         if registry is not None:
             registry.counter(HEDGE_TOTAL)  # explicit 0 in a hedge-free run
+
+    def role(self, replica: int) -> str:
+        return self._roles.get(replica, "colocated")
 
     # -- telemetry in --------------------------------------------------------
     def observe(self, replica: int, snapshot: dict) -> None:
@@ -150,22 +160,45 @@ class Router:
     def score(self, replica: int) -> float:
         """Load score — lower is better. Outstanding dispatches are the
         router's own ledger (fresh); queue depth / active slots / TTFT come
-        from the replica's last snapshot (one heartbeat stale)."""
+        from the replica's last snapshot (one heartbeat stale).
+
+        Role-aware term: a disaggregated replica's ``queue_depth`` counts
+        only its prefill door — work that has cleared prefill but not yet
+        entered a decode slot sits in the handoff queue instead, invisible
+        to the colocated scorer. ``handoff_depth`` (from the replica's
+        heartbeat) re-surfaces that backlog at half weight: handed-off
+        work no longer delays a NEW request's TTFT (prefill slots are
+        free) but still competes for the decode slots it will eventually
+        need.
+        """
         snap = self._replicas[replica].snapshot
-        return (
+        score = (
             len(self.outstanding_on(replica))
             + float(snap.get("queue_depth", 0))
             + 0.25 * float(snap.get("slots_active", 0))
             + float(snap.get("ttft_p50", 0.0))
         )
+        if self.role(replica) == "disagg":
+            score += 0.5 * float(snap.get("handoff_depth", 0))
+        return score
 
     def select(
-        self, now: Optional[float] = None, *, exclude: tuple[int, ...] = ()
+        self,
+        now: Optional[float] = None,
+        *,
+        exclude: tuple[int, ...] = (),
+        role: Optional[str] = None,
     ) -> Optional[int]:
         """The eligible replica with the lowest score (ties → lowest id),
-        or None when the whole fleet is dead/draining/excluded."""
+        or None when the whole fleet is dead/draining/excluded. ``role``
+        restricts selection to replicas of one topology role (a mixed
+        fleet can pin long-prompt traffic to disaggregated replicas)."""
         now = self._clock() if now is None else now
-        candidates = [r for r in self.eligible(now) if r not in exclude]
+        candidates = [
+            r
+            for r in self.eligible(now)
+            if r not in exclude and (role is None or self.role(r) == role)
+        ]
         if not candidates:
             return None
         return min(candidates, key=lambda r: (self.score(r), r))

@@ -293,6 +293,34 @@ class Scheduler:
             return True
         return False
 
+    # -- disaggregated handoff ----------------------------------------------
+    def detach(self, req: Request) -> None:
+        """Vacate ``req``'s slot WITHOUT releasing its KV blocks — the
+        prefill half of a disaggregated handoff (``serving/disagg.py``).
+        The request keeps its block table, generated tokens, and timing
+        record; ownership of the pages travels with it to whichever
+        scheduler :meth:`adopt`\\ s it next. Both schedulers must share one
+        :class:`PagedKVPool` for that transfer to be meaningful."""
+        if req.slot is None:
+            raise ValueError(f"detaching request {req.rid} that holds no slot")
+        self.slots[req.slot] = None
+        req.slot = None
+
+    def adopt(self, req: Request) -> bool:
+        """Install a detached request into a free slot — the decode half of
+        a disaggregated handoff. No allocation happens: the request arrives
+        already owning its blocks (written by the prefill engine through
+        the shared pool). Returns False when no slot is free; the caller
+        keeps the request in its handoff queue and retries next step."""
+        if req.slot is not None:
+            raise ValueError(f"adopting request {req.rid} that holds a slot")
+        if None not in self.slots:
+            return False
+        slot = self.slots.index(None)
+        req.slot = slot
+        self.slots[slot] = req
+        return True
+
     def finish(self, req: Request, now: float) -> None:
         req.t_finished = now
         req.state = RequestState.FINISHED

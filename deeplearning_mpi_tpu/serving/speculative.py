@@ -76,6 +76,8 @@ class SpeculativeDecoder:
         dtype: Any,
         tick: Callable[[], None] | None = None,
         donate: tuple[int, ...] = (),
+        kv_dtype: Any = None,
+        kv_buffers: Any = None,
     ) -> None:
         if config.vocab_size != target_config.vocab_size:
             raise ValueError(
@@ -90,17 +92,27 @@ class SpeculativeDecoder:
             )
         # engine.py imports this module lazily; import the forward the same
         # way to keep the cycle one-directional at module load.
-        from deeplearning_mpi_tpu.serving.engine import PagedForward
+        from deeplearning_mpi_tpu.serving.engine import KVBuffers, PagedForward
 
         self.config = config
         self.params = params
         self.engine = engine
         self.spec_k = engine.spec_k
-        self._fwd = PagedForward(config, engine, dtype, tick=tick)
-        self._k, self._v = init_kv_buffers(
-            config.num_layers, engine.num_blocks, engine.block_size,
-            config.num_kv_heads or config.num_heads, config.head_dim, dtype,
+        self._fwd = PagedForward(
+            config, engine, dtype, tick=tick, kv_dtype=kv_dtype
         )
+        # Same storage dtype as the target: the int8 capacity win applies
+        # to the draft's pools too. ``kv_buffers`` injects a SHARED holder
+        # (disaggregation: the prefill role's draft writes the prompt, the
+        # decode role's draft proposes from it); omitted, the draft owns
+        # its pools privately, exactly as before.
+        if kv_buffers is None:
+            kv_buffers = KVBuffers(init_kv_buffers(
+                config.num_layers, engine.num_blocks, engine.block_size,
+                config.num_kv_heads or config.num_heads, config.head_dim,
+                kv_dtype if kv_dtype is not None else dtype,
+            ))
+        self._kvh = kv_buffers
         # The draft always decodes through the einsum schedule: its
         # gathered KV shape differs from the target's, so target bucket
         # tuning does not transfer, and draft steps are small enough that
@@ -115,12 +127,20 @@ class SpeculativeDecoder:
         self._decode_fn: Callable[..., Any] = self._decode_jit
         self._prefill_fn: Callable[..., Any] = self._prefill_jit
 
+    @property
+    def _kv(self) -> tuple[Any, ...]:
+        return self._kvh.bufs
+
+    @_kv.setter
+    def _kv(self, bufs: tuple[Any, ...]) -> None:
+        self._kvh.bufs = bufs
+
     # -- warmup (driven by ServingEngine.warmup) -----------------------------
     def register_warmup(self, reg: Any) -> None:
         e = self.engine
         reg.register(
             "serve_draft_decode_step", self._decode_jit,
-            self.params, self._k, self._v,
+            self.params, self._kv,
             jnp.zeros((e.max_slots, e.max_blocks_per_seq), jnp.int32),
             jnp.zeros((e.max_slots,), jnp.int32),
             jnp.zeros((e.max_slots,), jnp.int32),
@@ -128,7 +148,7 @@ class SpeculativeDecoder:
         )
         reg.register(
             "serve_draft_prefill_chunk", self._prefill_jit,
-            self.params, self._k, self._v,
+            self.params, self._kv,
             jnp.zeros((e.max_blocks_per_seq,), jnp.int32),
             jnp.zeros((e.prefill_chunk,), jnp.int32),
             jnp.int32(0), jnp.int32(1),
@@ -150,8 +170,8 @@ class SpeculativeDecoder:
         """Compile the draft decode program for one narrower gather-width
         bucket (ServingEngine.warmup drives this with all-inactive rows —
         scratch-block writes, harmless execution)."""
-        self._k, self._v, _ = self._decode_jit(
-            self.params, self._k, self._v, tables, idle, idle, off
+        self._kv, _ = self._decode_jit(
+            self.params, self._kv, tables, idle, idle, off
         )
 
     # -- engine hooks --------------------------------------------------------
@@ -165,8 +185,8 @@ class SpeculativeDecoder:
         """Ingest one prompt chunk into the draft's KV pools (same chunk,
         same block table, draft dims); the logits are discarded — the
         target's prefill owns the first generated token."""
-        self._k, self._v, _ = self._prefill_fn(
-            self.params, self._k, self._v,
+        self._kv, _ = self._prefill_fn(
+            self.params, self._kv,
             jnp.asarray(table), jnp.asarray(chunk),
             jnp.int32(start), jnp.int32(n_valid),
         )
@@ -201,8 +221,8 @@ class SpeculativeDecoder:
         steps = 0
         for j in range(min(last_j, K) + 1):
             act = act_rows & (j <= budget)
-            self._k, self._v, out = self._decode_fn(
-                self.params, self._k, self._v,
+            self._kv, out = self._decode_fn(
+                self.params, self._kv,
                 jnp.asarray(tables),
                 jnp.asarray(lengths + j, dtype=np.int32),
                 jnp.asarray(cur), jnp.asarray(act),

@@ -148,3 +148,96 @@ class TestQuantizedLM:
         out = qmodel.apply({"params": qparams}, tokens)
         assert np.all(np.isfinite(np.asarray(out)))
         assert qparams["layer_0"]["attn"]["k_proj"]["kernel"].shape == (32, 2 * 8)
+
+
+class TestInt8KVCache:
+    """Activation (KV) quantization for the paged serving cache: per-
+    (token, head) absmax scales over head_dim, dequantized in-gather."""
+
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        from deeplearning_mpi_tpu.ops.quant import dequantize_kv, quantize_kv
+
+        x = jnp.asarray(
+            np.random.default_rng(5).normal(size=(3, 8, 2, 16)), jnp.float32
+        )
+        q, scale = quantize_kv(x)
+        assert q.dtype == jnp.int8
+        assert scale.shape == x.shape[:-1]  # one scale per (token, head) row
+        deq = np.asarray(dequantize_kv(q, scale, jnp.float32))
+        err = np.abs(np.asarray(x) - deq)
+        assert np.all(err <= np.asarray(scale)[..., None] / 2 + 1e-7)
+
+    def test_extreme_values_saturate_at_127(self):
+        from deeplearning_mpi_tpu.ops.quant import quantize_kv
+
+        x = jnp.asarray([[4.0, -2.0, 1.0, -4.0]], jnp.float32)
+        q, scale = quantize_kv(x)
+        assert int(np.abs(np.asarray(q)).max()) == 127
+        np.testing.assert_allclose(np.asarray(scale), [4.0 / 127.0])
+
+    def test_zero_rows_safe(self):
+        from deeplearning_mpi_tpu.ops.quant import dequantize_kv, quantize_kv
+
+        x = jnp.zeros((4, 2, 8), jnp.float32)
+        q, scale = quantize_kv(x)
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.asarray(scale) > 0)  # clamped, never divides by 0
+        assert np.all(np.asarray(dequantize_kv(q, scale, jnp.float32)) == 0)
+
+    def test_engine_decode_parity_at_tolerance(self):
+        """int8 KV is lossy by design; the contract is MEASURED token-level
+        acceptance against the fp engine on the same trace, mirroring the
+        serve_lm --kv_dtype int8 selftest gate. The fp run itself stays
+        bit-identical to offline greedy (the default path is untouched)."""
+        from deeplearning_mpi_tpu.models.generate import generate
+        from deeplearning_mpi_tpu.serving import EngineConfig, ServingEngine
+
+        cfg = TransformerConfig.tiny()
+        model = TransformerLM(config=cfg, dtype=jnp.float32)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+            "params"
+        ]
+        rng = np.random.default_rng(9)
+        prompts = [
+            rng.integers(1, 255, size=n).astype(np.int32) for n in (5, 11, 3)
+        ]
+        max_new = 5
+        ecfg = EngineConfig(
+            max_slots=3, block_size=4, num_blocks=32, max_blocks_per_seq=8,
+            prefill_chunk=4,
+        )
+
+        def run(kv_dtype):
+            engine = ServingEngine(
+                cfg, params,
+                dataclasses.replace(ecfg, kv_dtype=kv_dtype),
+                dtype=jnp.float32,
+            )
+            reqs = [engine.submit(p, max_new) for p in prompts]
+            engine.run_until_idle()
+            assert engine.pool.quantized == (kv_dtype is not None)
+            engine.pool.check()
+            assert engine.pool.in_use == 0
+            return [r.generated for r in reqs]
+
+        fp_tokens = run(None)
+        int8_tokens = run("int8")
+        for p, fp in zip(prompts, fp_tokens):
+            out = generate(
+                model, params, jnp.asarray(p)[None], max_new_tokens=max_new,
+                rng=jax.random.key(1), temperature=0.0,
+            )
+            assert fp == np.asarray(out)[0, len(p):].tolist()
+        expected = sum(len(t) for t in fp_tokens)
+        accepted = 0
+        for fp, q8 in zip(fp_tokens, int8_tokens):
+            for a, b in zip(fp, q8):
+                if a != b:
+                    break
+                accepted += 1
+        acceptance = accepted / expected
+        assert acceptance >= 0.9, (
+            f"int8 KV acceptance {acceptance:.1%} "
+            f"({accepted}/{expected} tokens) below tolerance; "
+            f"fp={fp_tokens} int8={int8_tokens}"
+        )

@@ -34,6 +34,7 @@ from deeplearning_mpi_tpu.models.transformer import (
 )
 from deeplearning_mpi_tpu.serving import (
     SCRATCH_BLOCK,
+    DisaggregatedEngine,
     EngineConfig,
     PagedKVPool,
     Request,
@@ -343,6 +344,41 @@ class TestScheduler:
         assert not sched.cancel(running)  # already shed: nothing to do
         assert pool.in_use == 0
         assert sched.idle()
+        pool.check()
+
+    def test_detach_vacates_slot_and_keeps_blocks(self):
+        """The prefill half of a handoff: the request leaves its slot but
+        KEEPS its KV blocks — block-table ownership is what moves between
+        the disaggregated roles, not bytes."""
+        sched, pool = self._sched(max_slots=1)
+        req = _req(0, 5)
+        sched.submit(req)
+        sched.admit(now=0.0)
+        blocks = list(req.blocks)
+        sched.detach(req)
+        assert req.slot is None
+        assert req.blocks == blocks
+        assert pool.in_use == len(blocks)  # nothing freed
+        assert sched.slots_active() == 0
+        with pytest.raises(ValueError, match="holds no slot"):
+            sched.detach(req)  # double-detach
+
+    def test_adopt_installs_into_free_slot_or_refuses(self):
+        sched, pool = self._sched(max_slots=1)
+        a, b = _req(0, 5), _req(1, 3, arrival=1.0)
+        for r in (a, b):
+            sched.submit(r)
+        sched.admit(now=2.0)  # one slot: a admitted
+        sched.detach(a)
+        peer, _ = self._sched(max_slots=1)
+        assert peer.adopt(a)
+        assert a.slot == 0 and peer.running() == [a]
+        with pytest.raises(ValueError, match="holds a slot"):
+            peer.adopt(a)  # already slotted
+        sched.admit(now=3.0)  # b takes the vacated prefill slot
+        sched.detach(b)
+        assert not peer.adopt(b)  # peer full: coordinator retries later
+        assert b.slot is None
         pool.check()
 
 
@@ -822,3 +858,143 @@ class TestEngineValidation:
         assert engine.scheduler.shed_expired(now=clock()) == [moved]
         assert moved.shed_reason == "deadline"
         assert fresh.state is RequestState.QUEUED  # no deadline: untouched
+
+
+# -- disaggregated prefill/decode ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def disagg_parity_run(tiny_lm):
+    """The parity_run trace replayed through the disaggregated topology:
+    same staggered arrivals, same engine config, but prefill and decode
+    run in separate role engines bridged by the handoff queue over one
+    shared KV pool."""
+    cfg, model, params = tiny_lm
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, 255, size=n).astype(np.int32) for n in PROMPT_LENS
+    ]
+    offline = [_offline_greedy(model, params, p, MAX_NEW) for p in prompts]
+
+    clock = FakeClock()
+    registry = MetricsRegistry()
+    engine = DisaggregatedEngine(
+        cfg, params, ENGINE_CFG, dtype=jnp.float32, clock=clock,
+        registry=registry,
+    )
+    arrive_at_step = {0: [0, 1, 2], 2: [3, 4], 4: [5], 6: [6, 7]}
+    reqs = {}
+    step = 0
+    while step in arrive_at_step or not engine.idle():
+        for i in arrive_at_step.get(step, []):
+            reqs[i] = engine.submit(prompts[i], MAX_NEW)
+        engine.step()
+        clock.advance(1.0)
+        step += 1
+        assert step < 500, "disaggregated engine did not drain"
+    snapshot = registry.snapshot()
+    return {
+        "engine": engine, "reqs": [reqs[i] for i in range(len(prompts))],
+        "offline": offline, "snapshot": snapshot,
+    }
+
+
+class TestDisaggregatedServing:
+    def test_streams_bit_identical_to_offline_greedy(self, disagg_parity_run):
+        """The tentpole's correctness bar: splitting prefill and decode
+        into separate engines (and moving sequences between them mid-
+        flight) must be invisible in the tokens — same staggered trace,
+        same outputs as offline greedy, hence as the colocated engine."""
+        for req, expect in zip(
+            disagg_parity_run["reqs"], disagg_parity_run["offline"]
+        ):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect, (
+                f"rid={req.rid}: disagg {req.generated} != offline {expect}"
+            )
+
+    def test_handoffs_actually_happened(self, disagg_parity_run):
+        """Every request generating > 1 token must have crossed the
+        handoff seam (prefill never decodes past the first token)."""
+        snap = disagg_parity_run["snapshot"]
+        crossing = sum(
+            1 for r in disagg_parity_run["reqs"] if len(r.generated) > 1
+        )
+        assert snap["serve_handoffs_total"] == crossing > 0
+        assert snap["serve_handoff_depth"] == 0  # drained
+
+    def test_roles_stayed_in_their_lanes(self, disagg_parity_run):
+        """Role-labeled telemetry proves the split: all prefill chunks on
+        the prefill engine, all decode steps on the decode engine."""
+        snap = disagg_parity_run["snapshot"]
+        engine = disagg_parity_run["engine"]
+        assert engine.prefill.role == "prefill"
+        assert engine.decode.role == "decode"
+        assert snap["serve_prefill_chunks"] >= len(disagg_parity_run["reqs"])
+        assert snap["serve_decode_steps"] > 0
+        # Per-role gauges exist and read drained.
+        assert snap['serve_slots_active{role="prefill"}'] == 0
+        assert snap['serve_slots_active{role="decode"}'] == 0
+
+    def test_shared_pool_drained_and_consistent(self, disagg_parity_run):
+        engine = disagg_parity_run["engine"]
+        assert engine.prefill.pool is engine.decode.pool is engine.pool
+        engine.pool.check()
+        assert engine.pool.in_use == 0
+        assert engine.pool.total_allocated == engine.pool.total_freed > 0
+
+    def test_handoff_stall_and_crash_recovery(self, tiny_lm):
+        """Chaos across the disaggregated seam: a handoff_stall wedges the
+        queue (prefills pile up, decode drains), then a serve_crash inside
+        prefill forces a cross-role recovery — and the books and the
+        tokens both still balance."""
+        from deeplearning_mpi_tpu.resilience import ChaosInjector
+
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(11)
+        prompts = [
+            rng.integers(1, 255, size=n).astype(np.int32)
+            for n in (5, 9, 3, 12)
+        ]
+        offline = [_offline_greedy(model, params, p, MAX_NEW) for p in prompts]
+        registry = MetricsRegistry()
+        chaos = ChaosInjector.from_spec(
+            "handoff_stall@step:2,serve_crash@step:5", registry=registry
+        )
+        engine = DisaggregatedEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32,
+            registry=registry, chaos=chaos,
+        )
+        reqs = [engine.submit(p, MAX_NEW) for p in prompts]
+        engine.run_until_idle()
+        for req, expect in zip(reqs, offline):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect
+        snap = registry.snapshot()
+        assert snap["fault_injected_total"] == 2
+        assert snap["recovery_total"] == 2
+        assert snap["serve_handoff_stalls_total"] == 1
+        assert snap["serve_requeued_total"] > 0  # the crash requeued work
+        assert chaos.balanced()
+        engine.pool.check()
+        assert engine.pool.in_use == 0
+
+    def test_cancel_in_handoff_queue(self, tiny_lm):
+        """A request cancelled while parked BETWEEN roles (prefill done,
+        decode not yet adopted) must free its blocks and shed cleanly."""
+        cfg, _, params = tiny_lm
+        engine = DisaggregatedEngine(
+            cfg, params, ENGINE_CFG, dtype=jnp.float32
+        )
+        req = engine.submit(np.arange(1, 6, dtype=np.int32), MAX_NEW)
+        steps = 0
+        while not engine.prefill.handoff:
+            engine.prefill.step()  # prefill only: nothing drains the queue
+            steps += 1
+            assert steps < 100, "prompt never completed prefill"
+        assert engine.cancel(req)
+        assert req.state is RequestState.SHED
+        assert req.shed_reason == "cancelled"
+        assert engine.handoff_depth == 0
+        assert engine.pool.in_use == 0
+        engine.pool.check()
+        assert not engine.cancel(req)  # already shed

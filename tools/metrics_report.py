@@ -131,12 +131,58 @@ def _fleet_table(last: dict) -> str:
     return table("Serving fleet", rows)
 
 
+def _serving_table(last: dict) -> str:
+    """A serve_lm run's end-of-run snapshot (``serve_summary``): delivery
+    and latency numbers, plus — for a disaggregated run — the per-role
+    split (each role's latency metric is the one IT produces: TTFT is
+    minted where prefill emits the first token, TPOT where decode
+    retires sequences) and the KV pool footprint by storage dtype."""
+    rows = [("requests completed", _fmt(last.get("serve_requests_completed"))),
+            ("requests shed", _fmt(last.get("serve_requests_shed"))),
+            ("tokens generated", _fmt(last.get("serve_tokens_generated"))),
+            ("decode steps", _fmt(last.get("serve_decode_steps"))),
+            ("prefill chunks", _fmt(last.get("serve_prefill_chunks")))]
+    disagg = last.get("serve_handoffs_total") is not None
+    ttft_owner = "prefill: " if disagg else ""
+    tpot_owner = "decode: " if disagg else ""
+    p50, p95 = last.get("serve_ttft_s_p50"), last.get("serve_ttft_s_p95")
+    if p50 is not None:
+        rows.append((f"{ttft_owner}TTFT p50/p95 (ms)",
+                     f"{_fmt(p50 * 1e3)} / {_fmt(p95 * 1e3)}"))
+    tpot = last.get("serve_tpot_s_p50")
+    if tpot is not None:
+        rows.append((f"{tpot_owner}TPOT p50 (ms)", _fmt(tpot * 1e3)))
+    if disagg:
+        rows += [("handoffs prefill→decode",
+                  _fmt(last.get("serve_handoffs_total"))),
+                 ("handoff stalls (chaos)",
+                  _fmt(last.get("serve_handoff_stalls_total"))),
+                 ("handoff depth (end of run)",
+                  _fmt(last.get("serve_handoff_depth")))]
+        for role in ("prefill", "decode"):
+            for key, label in (
+                ("serve_slots_active", "slots active"),
+                ("serve_kv_blocks_in_use", "KV blocks in use"),
+            ):
+                v = last.get(f'{key}{{role="{role}"}}')
+                if v is not None:
+                    rows.append((f"{role}: {label} (end of run)", _fmt(v)))
+    # KV pool footprint keyed by storage dtype (fp default vs --kv_dtype):
+    # serve_kv_bytes{dtype="float32"} / {dtype="int8"} / ...
+    for key in sorted(last):
+        if key.startswith("serve_kv_bytes{dtype="):
+            dtype = key.split("=", 1)[1].strip('"}')
+            rows.append((f"KV pool bytes ({dtype})", _bytes(last[key])))
+    return table("Serving", rows)
+
+
 def summarize(records: list[dict]) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     epochs = [r for r in records if r.get("kind") == "epoch"]
     evals = [r for r in records
              if str(r.get("kind", "")).startswith(("eval", "final_eval"))]
     fleet = [r for r in records if r.get("kind") == "fleet_summary"]
+    serving = [r for r in records if r.get("kind") == "serve_summary"]
     out = []
 
     if steps:
@@ -223,11 +269,14 @@ def summarize(records: list[dict]) -> str:
                 if k not in ("ts", "kind")]
         out.append(table(f"Last eval ({last.get('kind')})", rows))
 
+    if serving:
+        out.append(_serving_table(serving[-1]))
+
     if fleet:
         out.append(_fleet_table(fleet[-1]))
 
     if not out:
-        return "no step/epoch/eval/fleet records found\n"
+        return "no step/epoch/eval/fleet/serving records found\n"
     return "\n".join(out)
 
 
@@ -259,6 +308,24 @@ def _selftest() -> int:
             "comm_bytes_per_step": 1.5e6,
         })
         reg.emit("final_eval", {"epoch": 0, "eval_loss": 1.6, "eval_accuracy": 0.41})
+        # A disaggregated serve_lm run's end-of-run snapshot (serve_lm
+        # emits `serve_summary` with the registry snapshot): per-role
+        # occupancy gauges, the handoff counters, and the KV pool
+        # footprint keyed by storage dtype must all render.
+        reg.emit("serve_summary", {
+            "serve_requests_completed": 8, "serve_requests_shed": 0,
+            "serve_tokens_generated": 64, "serve_decode_steps": 27,
+            "serve_prefill_chunks": 18,
+            "serve_ttft_s_p50": 0.006, "serve_ttft_s_p95": 0.032,
+            "serve_tpot_s_p50": 0.0022,
+            "serve_handoffs_total": 11, "serve_handoff_stalls_total": 1,
+            "serve_handoff_depth": 0,
+            'serve_slots_active{role="prefill"}': 0,
+            'serve_slots_active{role="decode"}': 0,
+            'serve_kv_blocks_in_use{role="prefill"}': 0,
+            'serve_kv_blocks_in_use{role="decode"}': 0,
+            'serve_kv_bytes{dtype="int8"}': 81920,
+        })
         # A serving-fleet run's end-of-run record (serving/fleet.py run()):
         # the hedge/restart/swap columns must render alongside the
         # reconciliation books.
@@ -285,7 +352,8 @@ def _selftest() -> int:
                        "MFU issued", "MFU gap", "overlap fraction",
                        "hedges fired", "replica restarts",
                        "failover recovery p50", "swap downtime",
-                       "chaos books"):
+                       "chaos books", "prefill: TTFT", "decode: TPOT",
+                       "handoffs prefill", "KV pool bytes (int8)"):
             if needle not in report:
                 print(f"selftest FAILED: '{needle}' missing from report",
                       file=sys.stderr)
