@@ -1,0 +1,36 @@
+"""Static analysis + runtime sanitization for the repo's framework contracts.
+
+Every severe bug this repo has shipped-and-fixed was a violated *framework
+contract*, not a logic error: donated-buffer aliasing under async checkpoint
+save (PR 3), survivors computed after the teardown SIGKILL (PR 5), and the
+zero-retrace / single-writer-JSONL contracts serving and fleet correctness
+silently depend on. This package mechanizes those invariants in two layers:
+
+- :mod:`~.passes` — AST-based static rules (``dmt-lint`` / ``tools/lint.py``,
+  wired as ``make lint``), each derived from a documented past bug or
+  standing contract. Rule catalog: ``docs/ANALYSIS.md``.
+- :mod:`~.sanitizer` — an opt-in runtime sanitizer (``DMT_SANITIZE=1``)
+  that enforces the same contracts dynamically: KV-block poisoning on free
+  with double-free / use-after-free detection, a retrace tripwire that
+  fails loud when ``serve_compile_total`` ticks after warmup, and a
+  donation canary around checkpoint save (``make sanitize-smoke``).
+"""
+
+from deeplearning_mpi_tpu.analysis.core import (
+    Finding,
+    Rule,
+    SourceFile,
+    load_suppressions,
+    run_lint,
+)
+from deeplearning_mpi_tpu.analysis.sanitizer import SanitizerError, enabled
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SanitizerError",
+    "SourceFile",
+    "enabled",
+    "load_suppressions",
+    "run_lint",
+]

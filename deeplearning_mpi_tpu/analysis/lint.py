@@ -1,0 +1,82 @@
+"""``dmt-lint`` CLI: run the static contract passes over the repo.
+
+    dmt-lint                         # package + tools/ + bench.py
+    dmt-lint path/to/file_or_dir...  # explicit targets (e.g. the fixture
+                                     # corpus: tests/fixtures/lint)
+    dmt-lint --list-rules            # rule catalog with contracts
+    dmt-lint --show-suppressed       # audit the recorded exceptions too
+
+Exit code 0 iff no *unsuppressed* findings. Suppression mechanisms (both
+need a one-line justification): inline ``# dmt-lint: disable=DMT003 —
+why`` on the flagged line, or a ``path:RULE: why`` entry in
+``tools/lint_suppressions.txt`` (the baseline file). See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from deeplearning_mpi_tpu.analysis.core import (
+    REPO_ROOT,
+    default_roots,
+    load_suppressions,
+    run_lint,
+)
+from deeplearning_mpi_tpu.analysis.passes import all_rules
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="dmt-lint", description=__doc__)
+    parser.add_argument("paths", nargs="*", type=Path,
+                        help="files/dirs to lint (default: the gated tree)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--suppressions", type=Path,
+                        default=REPO_ROOT / "tools" / "lint_suppressions.txt",
+                        help="suppression/baseline file")
+    parser.add_argument("--no-suppressions", action="store_true",
+                        help="ignore the suppression file AND inline "
+                        "disables (fixture-corpus mode)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="print suppressed findings too")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name:<22} {r.contract}")
+        return 0
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        rules = [r for r in rules if r.id in wanted]
+        if not rules:
+            parser.error(f"no rule matches {args.rules!r}")
+
+    roots = [p for p in args.paths] or None
+    suppressions = (
+        {} if args.no_suppressions else load_suppressions(args.suppressions)
+    )
+    findings = run_lint(roots, rules=rules, suppressions=suppressions)
+    if args.no_suppressions:
+        for f in findings:
+            f.suppressed = False
+
+    failures = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else failures
+    for f in shown:
+        print(f.render())
+    n_sup = sum(1 for f in findings if f.suppressed)
+    print(
+        f"dmt-lint: {len(failures)} finding(s), {n_sup} suppressed, "
+        f"{len(rules)} rule(s)",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,7 +331,7 @@ class PodSupervisor:
             if world > 1:
                 env["PROCESS_ID"] = str(rank)
             log_path = self.pod_dir / f"attempt{attempt}-rank{rank}.log"
-            f = log_path.open("w")
+            f = log_path.open("w")  # dmt-lint: disable=DMT004 — per-attempt stdout capture, not a consumed JSON artifact
             handles.append(f)
             procs[rank] = subprocess.Popen(
                 self.worker_cmd,
@@ -515,7 +515,7 @@ class PodSupervisor:
                     self.registry.counter(
                         labeled(POD_RANK_FAILURES, kind=kind)
                     ).inc()
-                    rc = procs[rank].poll()
+                    rc = procs[rank].poll()  # dmt-lint: disable=DMT006 — rank was observed dead BEFORE teardown; poll() returns the stored exit code, not a live query
                     why = f"exit {rc}" if kind == "rank_kill" else (
                         f"progress stalled {tracker.progress_age_s(rank):.1f}s"
                     )

@@ -204,7 +204,7 @@ class Heartbeat:
             **self._progress,
         }
         tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(payload))
+        tmp.write_text(json.dumps(payload))  # dmt-lint: disable=DMT004 — hand-rolled tmp+rename below; fsync skipped on purpose at heartbeat cadence
         os.replace(tmp, self.path)  # atomic: readers never see partial JSON
 
     @staticmethod

@@ -484,7 +484,12 @@ class FleetSupervisor:
         rdir = self.fleet_dir / f"replica{rep.idx}-a{rep.attempt}"
         rdir.mkdir(parents=True, exist_ok=True)
         spec_path = rdir / "spec.json"
-        spec_path.write_text(json.dumps({
+        # Atomic: the replica reads spec.json immediately after spawn, and a
+        # supervisor kill mid-write must never hand it a torn spec
+        # (dmt-lint DMT004 — the atomic-IO contract).
+        from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
+
+        atomic_write_json(spec_path, {
             "model": self.model_spec,
             "engine": self.engine_spec,
             "seed": rep.seed,
@@ -493,7 +498,7 @@ class FleetSupervisor:
             "warmup": self.warmup,
             "disagg": self.disagg,
             "tp": self.tp,
-        }))
+        })
         (rdir / "inbox.jsonl").touch()
         env = dict(os.environ)
         env.update(self.extra_env)
@@ -507,7 +512,7 @@ class FleetSupervisor:
         for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
             env.pop(k, None)
         log_path = self.fleet_dir / f"replica{rep.idx}-a{rep.attempt}.log"
-        rep.log = log_path.open("w")
+        rep.log = log_path.open("w")  # dmt-lint: disable=DMT004 — stdout capture stream, not a consumed JSON artifact
         rep.proc = subprocess.Popen(
             [
                 sys.executable, "-m", "deeplearning_mpi_tpu.serving.fleet",

@@ -79,6 +79,15 @@ class PagedKVPool:
         # invariant instead of a silent garbage gather.
         self._fill_epoch: dict[int, int] = {}
         self._scale_epoch: dict[int, int] = {}
+        # Opt-in runtime sanitizer (DMT_SANITIZE=1): freed blocks are
+        # poisoned until re-allocated, so double-free and use-after-free
+        # fail loud as classified SanitizerErrors instead of the generic
+        # accounting ValueError (docs/ANALYSIS.md "Runtime sanitizer").
+        self._san = None
+        from deeplearning_mpi_tpu.analysis import sanitizer as _sanitizer
+
+        if _sanitizer.enabled():
+            self._san = _sanitizer.KVPoolSanitizer()
 
     @property
     def quantized(self) -> bool:
@@ -118,6 +127,8 @@ class PagedKVPool:
         blocks = [self._free.pop() for _ in range(n)]
         self._used.update(blocks)
         self.total_allocated += n
+        if self._san is not None:
+            self._san.on_alloc(blocks)
         return blocks
 
     def free(self, blocks: Iterable[int]) -> None:
@@ -125,6 +136,9 @@ class PagedKVPool:
         allocated (double-free, scratch, out of range) is a caller bug and
         raises — silent tolerance here would mask exactly the accounting
         errors this class exists to prevent."""
+        blocks = list(blocks)
+        if self._san is not None:
+            self._san.check_free(blocks, self._used)
         for b in blocks:
             if b not in self._used:
                 raise ValueError(f"freeing block {b} that is not allocated")
@@ -133,12 +147,17 @@ class PagedKVPool:
             self.total_freed += 1
             self._fill_epoch.pop(b, None)
             self._scale_epoch.pop(b, None)
+        if self._san is not None:
+            self._san.on_free(blocks)
 
     # -- quantized-pool write accounting ------------------------------------
     def record_fill(self, blocks: Iterable[int]) -> None:
         """Note that the engine scattered KV *data* into ``blocks`` this
         step. Paired with :meth:`record_scale` on quantized pools; the
         scratch block is ignored (its writes are garbage by design)."""
+        blocks = list(blocks)
+        if self._san is not None:
+            self._san.check_touch(blocks, self._used, "data")
         for b in blocks:
             if b == SCRATCH_BLOCK:
                 continue
@@ -149,6 +168,9 @@ class PagedKVPool:
     def record_scale(self, blocks: Iterable[int]) -> None:
         """Note that the engine scattered *scale* rows into ``blocks`` this
         step (quantized pools only)."""
+        blocks = list(blocks)
+        if self._san is not None:
+            self._san.check_touch(blocks, self._used, "scale")
         for b in blocks:
             if b == SCRATCH_BLOCK:
                 continue

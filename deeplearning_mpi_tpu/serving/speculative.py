@@ -228,7 +228,7 @@ class SpeculativeDecoder:
                 jnp.asarray(cur), jnp.asarray(act),
             )
             steps += 1
-            out_np = np.asarray(jax.device_get(out), np.int32)
+            out_np = np.asarray(jax.device_get(out), np.int32)  # dmt-lint: disable=DMT003 — the draft's one audited fetch per propose step: proposals feed the host-side accept loop
             if j < K:
                 take = act & (j < budget)
                 props[take, j] = out_np[take]

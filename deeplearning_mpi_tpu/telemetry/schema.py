@@ -1,0 +1,98 @@
+"""Canonical metric schema: the one list of instrument names and label
+keys this repo is allowed to emit.
+
+Why a schema module and not a grep: the drills' reconciliation invariants
+(``fault_injected_total == recovery_total + rollback_total``, ``spec_proposed
+== spec_accepted + spec_rollback``, the fleet books) are arithmetic over
+metric *names* — a typo'd name is not an error anywhere at runtime, it is a
+silently-always-zero column that makes an invariant unfalsifiable. The
+``dmt-lint`` telemetry-schema rule (DMT007, ``analysis/passes.py``) checks
+every literal name and label key at instrument call sites against THIS
+module at lint time, so "metric exists" is a build fact.
+
+Adding a metric is a two-line change: the call site and the schema entry.
+Kinds are documentation (the registry itself stays duck-typed); labels are
+the allowed ``labeled(name, key=...)`` encodings per base name.
+"""
+
+from __future__ import annotations
+
+__all__ = ["LABEL_KEYS", "METRICS", "is_canonical"]
+
+#: Every label key any ``labeled(...)`` call may use.
+LABEL_KEYS: frozenset[str] = frozenset(
+    {"dtype", "kind", "outcome", "reason", "replica", "role"}
+)
+
+#: name -> (kind, {allowed label keys}). Kind is one of
+#: "counter" | "gauge" | "histogram".
+METRICS: dict[str, tuple[str, frozenset[str]]] = {
+    # -- compilation service (PR 4, compiler/) ------------------------------
+    "compile_cache_evicted_total": ("counter", frozenset()),
+    "compile_cache_hit_total": ("counter", frozenset()),
+    "compile_cache_miss_total": ("counter", frozenset()),
+    "compile_cache_quarantined_total": ("counter", frozenset()),
+    "compile_seconds": ("histogram", frozenset()),
+    "train_compile_seconds": ("gauge", frozenset()),
+    "xla_bytes_per_step": ("gauge", frozenset()),
+    "xla_flops_per_step": ("gauge", frozenset()),
+    # -- serving engine (PR 2/7/9, serving/) --------------------------------
+    "serve_compile_seconds": ("histogram", frozenset()),
+    "serve_compile_total": ("counter", frozenset()),
+    "serve_decode_held_steps": ("counter", frozenset()),
+    "serve_decode_steps": ("counter", frozenset()),
+    "serve_handoff_depth": ("gauge", frozenset()),
+    "serve_handoff_stalls_total": ("counter", frozenset()),
+    "serve_handoffs_total": ("counter", frozenset()),
+    "serve_kv_blocks_in_use": ("gauge", frozenset({"role"})),
+    "serve_kv_bytes": ("gauge", frozenset({"dtype", "role"})),
+    "serve_prefill_chunks": ("counter", frozenset()),
+    "serve_queue_depth": ("gauge", frozenset({"role"})),
+    "serve_requests_admitted": ("counter", frozenset()),
+    "serve_requests_completed": ("counter", frozenset()),
+    "serve_requests_shed": ("counter", frozenset()),
+    "serve_requests_submitted": ("counter", frozenset()),
+    "serve_requeued_total": ("counter", frozenset()),
+    "serve_shed_total": ("counter", frozenset({"reason"})),
+    "serve_slots_active": ("gauge", frozenset({"role"})),
+    "serve_tokens_discarded_total": ("counter", frozenset()),
+    "serve_tokens_generated": ("counter", frozenset()),
+    "serve_tpot_s": ("histogram", frozenset()),
+    "serve_ttft_s": ("histogram", frozenset({"replica"})),
+    # -- speculative decode (PR 7) ------------------------------------------
+    "spec_accepted_total": ("counter", frozenset()),
+    "spec_blocks_rolled_back_total": ("counter", frozenset()),
+    "spec_degraded_total": ("counter", frozenset()),
+    "spec_draft_steps": ("counter", frozenset()),
+    "spec_proposed_total": ("counter", frozenset()),
+    "spec_rollback_total": ("counter", frozenset()),
+    "spec_verify_steps": ("counter", frozenset()),
+    # -- serving fleet + router (PR 8) --------------------------------------
+    "fleet_redispatch_total": ("counter", frozenset()),
+    "fleet_replica_failures_total": ("counter", frozenset({"kind"})),
+    "fleet_replica_restarts_total": ("counter", frozenset()),
+    "serve_hedge_total": ("counter", frozenset({"outcome"})),
+    # -- chaos / resilience (PR 3/5) ----------------------------------------
+    "fault_injected_total": ("counter", frozenset({"kind"})),
+    "recovery_latency_s": ("histogram", frozenset()),
+    "recovery_total": ("counter", frozenset()),
+    "rollback_total": ("counter", frozenset()),
+    "train_restarts_total": ("counter", frozenset()),
+    # -- elastic pod (PR 5) -------------------------------------------------
+    "elastic_restore_total": ("counter", frozenset()),
+    "pod_rank_failures_total": ("counter", frozenset({"kind"})),
+    "pod_restarts_total": ("counter", frozenset()),
+    "pod_straggler_flags_total": ("counter", frozenset()),
+    "pod_world_size": ("gauge", frozenset()),
+    # -- runtime sanitizer (analysis/sanitizer.py) --------------------------
+    "sanitize_donation_canary_trips_total": ("counter", frozenset()),
+    "sanitize_kv_double_free_total": ("counter", frozenset()),
+    "sanitize_kv_use_after_free_total": ("counter", frozenset()),
+    "sanitize_retrace_trips_total": ("counter", frozenset()),
+}
+
+
+def is_canonical(name: str) -> bool:
+    """True when ``name`` (a base instrument name, labels stripped) is in
+    the schema."""
+    return name.split("{", 1)[0] in METRICS

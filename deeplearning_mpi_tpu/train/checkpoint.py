@@ -20,6 +20,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from deeplearning_mpi_tpu.analysis import sanitizer as _sanitizer
 from deeplearning_mpi_tpu.resilience.integrity import (
     CheckpointCorruption,
     corrupt_checkpoint,
@@ -87,6 +88,11 @@ class Checkpointer:
         # Async: Orbax serializes in the background while training continues;
         # ordering across saves is the manager's job, and close() (and any
         # restore) barriers before process exit.
+        # Donation canary (DMT_SANITIZE=1): hash a state leaf before the
+        # save, re-verify after the write barrier — the donated-buffer
+        # aliasing race described under ``integrity`` below flips the
+        # canary where it used to flip checkpoint bytes silently.
+        canary = _sanitizer.donation_canary(state) if _sanitizer.enabled() else None
         self.manager.save(
             epoch, args=ocp.args.StandardSave(_arrays_only(state))
         )
@@ -106,6 +112,12 @@ class Checkpointer:
                 dir_digests(self.directory / str(epoch)),
             )
             self._prune_manifests(keep_also=epoch)
+        if canary is not None:
+            if not self.integrity:
+                # The canary needs the same barrier integrity takes: the
+                # aliasing race only resolves once the serializer is done.
+                self.manager.wait_until_finished()
+            canary.verify(state)
         if self.chaos is not None and self.chaos.should_corrupt(epoch=epoch):
             # Chaos: damage the committed step. Must barrier first — flipping
             # bytes under an in-flight async writer tests a race, not

@@ -1,0 +1,29 @@
+"""Clean fixture: near-miss patterns every rule must NOT flag."""
+import json
+
+import jax
+
+
+def run(params, kv):
+    step = jax.jit(lambda p, k: (k, p), donate_argnums=(1,))
+    kv, out = step(params, kv)  # donated arg rebound by this assignment
+    return kv, out
+
+
+def cold_path(out):
+    return jax.device_get(out)  # not a hot scope: no marker, no hot path
+
+
+def write_report(path, payload):
+    path.write_text(json.dumps(payload))  # not IO-critical: no scope marker
+
+
+def record(registry):
+    registry.counter("serve_decode_steps")  # canonical schema name
+
+
+def stop(procs):
+    alive = [p for p in procs if p.poll() is None]
+    for p in procs:
+        p.kill()  # liveness was snapshotted BEFORE the kill
+    return alive

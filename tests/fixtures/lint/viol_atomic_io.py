@@ -1,0 +1,7 @@
+"""Seeded DMT004: non-atomic JSON write in an IO-critical scope."""
+# dmt-lint: scope=resilience
+import json
+
+
+def write_state(path, payload):
+    path.write_text(json.dumps(payload))  # seeded: DMT004 — torn-file hazard

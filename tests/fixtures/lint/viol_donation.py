@@ -1,0 +1,9 @@
+"""Seeded DMT001: a donated buffer is read after the jitted call (the
+PR 3 aliasing bug class, in miniature)."""
+import jax
+
+
+def run(params, kv):
+    step = jax.jit(lambda p, k: (k, p), donate_argnums=(1,))
+    new_kv, out = step(params, kv)
+    return kv.sum()  # seeded: DMT001 — kv was donated at the call above

@@ -1,0 +1,10 @@
+"""Seeded DMT003: an unaudited host-device sync inside a marked hot loop."""
+import jax
+
+
+def decode_loop(fn, kv, tokens):  # dmt-lint: hot-loop
+    val = None
+    for tok in tokens:
+        kv, out = fn(kv, tok)
+        val = jax.device_get(out)  # seeded: DMT003 — per-step device fetch
+    return val

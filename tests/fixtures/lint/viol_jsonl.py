@@ -1,0 +1,5 @@
+"""Seeded DMT005: raw write-mode open of a JSONL stream outside JsonlSink."""
+
+
+def start_stream(path):
+    return open(path / "events.jsonl", "a")  # seeded: DMT005 — second writer

@@ -1,0 +1,7 @@
+"""Seeded DMT006: survivors computed AFTER the teardown kill (PR 5 bug)."""
+
+
+def teardown(procs):
+    for p in procs:
+        p.kill()
+    return [p for p in procs if p.is_alive()]  # seeded: DMT006 — empty world

@@ -1,0 +1,10 @@
+"""Seeded DMT002: per-call host state (wall clock) inside a jitted body."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t = time.time()  # seeded: DMT002 — traced-in wall clock, varies per call
+    return x + t

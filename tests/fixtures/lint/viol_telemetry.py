@@ -1,0 +1,5 @@
+"""Seeded DMT007: a metric name missing from the canonical schema."""
+
+
+def record(registry):
+    registry.counter("serve_tokens_genrated")  # seeded: DMT007 — typo'd name

@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""``make lint`` entry point — thin shim onto the packaged dmt-lint CLI
+(``deeplearning_mpi_tpu/analysis/lint.py``), runnable from a source
+checkout without an installed wheel."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from deeplearning_mpi_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,12 @@ Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
 - ``fleet_summary`` — the serving fleet's end-of-run record
   (``serving/fleet.py``): completions/shed/dropped, hedge outcomes
   (``serve_hedge_total{outcome=...}``), replica restarts, swap downtime,
-  failover TTFT p50/p99 by phase, and the chaos reconciliation books.
+  failover TTFT p50/p99 by phase, and the chaos reconciliation books;
+- ``sanitize_*`` counters — a ``DMT_SANITIZE=1`` run's tripwire books
+  (``analysis/sanitizer.py``; docs/ANALYSIS.md): KV-pool double-free /
+  use-after-free poison trips, post-warmup retrace trips, and donation
+  canary flips. All-zero is the healthy state; any nonzero row names the
+  contract that fired.
 """
 
 from __future__ import annotations
@@ -176,6 +181,26 @@ def _serving_table(last: dict) -> str:
     return table("Serving", rows)
 
 
+_SANITIZE_LABELS = (
+    ("sanitize_kv_double_free_total", "KV double-free trips"),
+    ("sanitize_kv_use_after_free_total", "KV use-after-free trips"),
+    ("sanitize_retrace_trips_total", "retrace trips (post-warmup)"),
+    ("sanitize_donation_canary_trips_total", "donation canary trips"),
+)
+
+
+def _sanitizer_table(last: dict) -> str:
+    """The runtime sanitizer's tripwire books: any record carrying
+    ``sanitize_*`` counters (a DMT_SANITIZE=1 run summary) renders here."""
+    rows = [(label, _fmt(last[key]))
+            for key, label in _SANITIZE_LABELS if key in last]
+    if rows:
+        total = sum(last.get(k, 0) for k, _ in _SANITIZE_LABELS)
+        rows.append(("sanitizer verdict",
+                     "clean" if total == 0 else f"{_fmt(total)} trip(s)"))
+    return table("Sanitizer (DMT_SANITIZE=1)", rows)
+
+
 def summarize(records: list[dict]) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     epochs = [r for r in records if r.get("kind") == "epoch"]
@@ -275,6 +300,11 @@ def summarize(records: list[dict]) -> str:
     if fleet:
         out.append(_fleet_table(fleet[-1]))
 
+    sanitized = [r for r in records
+                 if any(k.startswith("sanitize_") for k in r)]
+    if sanitized:
+        out.append(_sanitizer_table(sanitized[-1]))
+
     if not out:
         return "no step/epoch/eval/fleet/serving records found\n"
     return "\n".join(out)
@@ -345,6 +375,15 @@ def _selftest() -> int:
             "fault_injected_total": 2, "recovery_total": 2,
             "rollback_total": 0, "chaos_balanced": True,
         })
+        # A DMT_SANITIZE=1 run's tripwire books (analysis/sanitizer.py):
+        # the drill's injections show up as counted trips, a healthy run
+        # renders all-zero with verdict "clean".
+        reg.emit("sanitize_summary", {
+            "sanitize_kv_double_free_total": 1,
+            "sanitize_kv_use_after_free_total": 1,
+            "sanitize_retrace_trips_total": 1,
+            "sanitize_donation_canary_trips_total": 0,
+        })
         reg.close()
         report = summarize(load_records(path))
         print(report)
@@ -353,7 +392,9 @@ def _selftest() -> int:
                        "hedges fired", "replica restarts",
                        "failover recovery p50", "swap downtime",
                        "chaos books", "prefill: TTFT", "decode: TPOT",
-                       "handoffs prefill", "KV pool bytes (int8)"):
+                       "handoffs prefill", "KV pool bytes (int8)",
+                       "KV double-free trips", "retrace trips (post-warmup)",
+                       "donation canary trips", "sanitizer verdict"):
             if needle not in report:
                 print(f"selftest FAILED: '{needle}' missing from report",
                       file=sys.stderr)
