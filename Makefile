@@ -4,19 +4,20 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
-# pod-smoke, overlap-smoke, fleet-smoke, and disagg-smoke prerequisites
-# gate the tier-1 run on the static analyzer, the runtime-sanitizer
-# injection drill, the serving engine's end-to-end parity selftest, the
-# speculative-decode parity/reconciliation drill, the fault-injection
-# recovery drill, the autotune loop, the elastic-pod rank-failure drill,
-# the overlapped-ZeRO-1 bit-equality drill, the serving-fleet
-# replica-failure drill, and the disaggregated prefill/decode drill
-# without touching the ROADMAP command itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke
+# pod-smoke, overlap-smoke, fleet-smoke, disagg-smoke, and prefix-smoke
+# prerequisites gate the tier-1 run on the static analyzer, the
+# runtime-sanitizer injection drill, the serving engine's end-to-end
+# parity selftest, the speculative-decode parity/reconciliation drill,
+# the fault-injection recovery drill, the autotune loop, the elastic-pod
+# rank-failure drill, the overlapped-ZeRO-1 bit-equality drill, the
+# serving-fleet replica-failure drill, the disaggregated prefill/decode
+# drill, and the radix prefix-cache drill without touching the ROADMAP
+# command itself.
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -150,6 +151,16 @@ disagg-smoke:
 		--max_new_tokens 8 --prompt_len_min 3 --prompt_len_max 20 \
 		--max_slots 3 --block_size 8 --num_blocks 32 \
 		--max_blocks_per_seq 6 --prefill_chunk 8
+
+# Radix prefix-cache drill (docs/SERVING.md "Prefix cache &
+# multi-tenancy"): a two-tenant trace whose prompts share a long,
+# non-block-aligned preamble through a colocated engine with the radix
+# cache on and per-tenant budgets. Asserts prefix hits and CoW copies
+# fire, every stream stays bit-identical to offline greedy, the
+# over-budget tenant is shed with reason tenant_budget, and the pool's
+# refcount books balance at drain (flush() returns every block).
+prefix-smoke:
+	env JAX_PLATFORMS=cpu python tools/prefix_drill.py
 
 # Serving-fleet replica-failure drill (docs/SERVING.md "Fault-tolerant
 # fleet", docs/TPU_POD_RUNBOOK.md §8): a 2-replica CPU fleet under a

@@ -851,6 +851,159 @@ def bench_disagg(
     return result
 
 
+def bench_serving_prefix(
+    n_burst: int = 16,
+    n_trickle: int = 8,
+    preamble_len: int = 34,
+    tail_len: int = 8,
+    max_new: int = 6,
+) -> dict:
+    """Radix prefix cache vs the cacheless engine on a shared-preamble
+    trace (ISSUE 12).
+
+    Both arms serve the SAME burst+trickle trace (``n_burst`` requests at
+    t=0, then ``n_trickle`` at 80 ms spacing): every prompt is one shared
+    ``preamble_len``-token preamble plus a unique ``tail_len``-token tail
+    — the "same system prompt, different question" shape, ~80% of each
+    prompt shared. The preamble is deliberately NOT block-aligned, so
+    every adoption also pays a copy-on-write block copy (the honest cost).
+
+    - ``no_cache`` — the plain ``ServingEngine``: reference streams and
+      the TTFT baseline. Every admission re-prefills all
+      ``preamble_len + tail_len`` tokens.
+    - ``prefix_cache`` — the same engine with the radix cache on: after
+      the first completed prefill the preamble's KV blocks are adopted by
+      reference and only the tail (plus one CoW copy) is computed.
+
+    Headline is ``prefill_tokens_reduction_x`` = prompt tokens submitted /
+    prompt tokens actually prefilled (submitted − reused); the ISSUE bar
+    is >= 2x at 80% sharing. ``ttft_p99_ratio_vs_no_cache`` must come in
+    < 1.0 — skipped prefill work is queue time the burst's tail never
+    waits for. Greedy decode is deterministic and adopted blocks hold
+    bit-equal KV (same tokens, same params), so the streams must be
+    BIT-identical between arms — the cache is judged on latency, never
+    allowed to shift tokens. Like bench_fleet/bench_disagg this measures
+    scheduling (admission, adoption, CoW), not model FLOPs: the model is
+    the serve-smoke tiny shape, AOT-warmed, zero compiles in the timed
+    window.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.serving import EngineConfig, ServingEngine
+    from deeplearning_mpi_tpu.telemetry import MetricsRegistry
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=2, head_dim=16,
+        d_model=64, d_ff=128,
+    )
+    dt = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = TransformerLM(config=cfg, dtype=dt)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    base = EngineConfig(
+        max_slots=3, block_size=8, num_blocks=64, max_blocks_per_seq=6,
+        prefill_chunk=8, max_queue=64,
+    )
+
+    rng = np.random.default_rng(7)
+    preamble = rng.integers(1, cfg.vocab_size, size=preamble_len).astype(
+        np.int32
+    )
+    trace = []
+    for i in range(n_burst + n_trickle):
+        tail = rng.integers(1, cfg.vocab_size, size=tail_len).astype(np.int32)
+        trace.append((
+            0.0 if i < n_burst else (i - n_burst + 1) * 0.08,
+            np.concatenate([preamble, tail]),
+        ))
+    prompt_tokens = sum(len(p) for _, p in trace)
+
+    def pct(xs: list, q: float) -> float | None:
+        return round(float(np.percentile(xs, q)), 4) if xs else None
+
+    def run_arm(cached: bool) -> tuple[dict, list]:
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg, params,
+            dataclasses.replace(base, prefix_cache=cached),
+            dtype=dt, registry=registry,
+        )
+        engine.warmup()
+        reqs, pending = [], list(trace)
+        t0 = time.monotonic()
+        while pending or not engine.scheduler.idle():
+            now = time.monotonic() - t0
+            while pending and pending[0][0] <= now:
+                arr, prompt = pending.pop(0)
+                reqs.append(engine.submit(prompt, max_new, arrival=t0 + arr))
+            if not engine.scheduler.idle():
+                engine.step()
+            elif pending:
+                gap = pending[0][0] - (time.monotonic() - t0)
+                if gap > 0:
+                    time.sleep(gap)
+        wall = time.monotonic() - t0
+        snap = registry.snapshot()
+        done = [r for r in reqs if r.t_finished is not None]
+        ttfts = sorted(r.ttft for r in done if r.ttft is not None)
+        reused = int(snap.get("serve_prefix_tokens_reused_total", 0))
+        detail = {
+            "requests_finished": len(done),
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+            "wall_s": round(wall, 2),
+            "prompt_tokens": prompt_tokens,
+            "prefilled_tokens": prompt_tokens - reused,
+            "prefix_hits": int(snap.get("serve_prefix_hits_total", 0)),
+            "prefix_tokens_reused": reused,
+            "cow_copies": int(snap.get("serve_prefix_cow_copies_total", 0)),
+            "evictions": int(snap.get("serve_prefix_evictions_total", 0)),
+        }
+        streams = [
+            [int(t) for t in r.generated]
+            for r in sorted(done, key=lambda r: r.rid)
+        ]
+        return detail, streams
+
+    cold, ref_streams = run_arm(False)
+    warm, warm_streams = run_arm(True)
+
+    result = {
+        "requests": len(trace),
+        "burst": n_burst,
+        "trickle": n_trickle,
+        "shared_fraction": round(preamble_len / (preamble_len + tail_len), 2),
+        "max_new": max_new,
+        "no_cache": cold,
+        "prefix_cache": warm,
+        "bit_identical_to_no_cache": warm_streams == ref_streams,
+        # Prompt tokens submitted / prompt tokens actually prefilled: how
+        # much prefill compute adoption removed (ISSUE bar: >= 2x at ~80%
+        # sharing; the first request of each branch is always cold).
+        "prefill_tokens_reduction_x": (
+            round(prompt_tokens / warm["prefilled_tokens"], 2)
+            if warm["prefilled_tokens"] else None
+        ),
+        "ttft_p99_ratio_vs_no_cache": (
+            round(warm["ttft_p99_s"] / cold["ttft_p99_s"], 2)
+            if warm["ttft_p99_s"] and cold["ttft_p99_s"] else None
+        ),
+        "device": str(jax.devices()[0].device_kind),
+    }
+    from deeplearning_mpi_tpu.compiler import autotune
+
+    db = autotune.default_db()
+    if db is not None and db.consulted:
+        result["tuning_provenance"] = db.consulted
+    return result
+
+
 def _kill_group(proc) -> None:
     """SIGKILL a child's whole process group, then reap it. The child may
     spawn helpers (tunnel client) that inherit the pipes; killing only the
@@ -926,6 +1079,7 @@ def _combined_line(details: dict, error: str | None = None) -> str:
     spec = details.get("lm_spec_decode") or {}
     fleet = details.get("serving_fleet") or {}
     disagg = details.get("serving_disagg") or {}
+    prefix = details.get("serving_prefix") or {}
     allreduce = details.get("allreduce") or {}
     out = {
         "metric": "resnet50_bf16_images_per_sec_per_chip",
@@ -976,6 +1130,14 @@ def _combined_line(details: dict, error: str | None = None) -> str:
         ),
         "kv_int8_resident_seqs_x": disagg.get("resident_seqs_x"),
         "kv_int8_acceptance_rate": disagg.get("int8_acceptance_rate"),
+        # Radix prefix cache headline (ISSUE 12): prefill compute removed
+        # by KV adoption on an ~80%-shared-preamble trace (>= 2x bar) and
+        # the client-visible tail-TTFT ratio vs the cacheless arm (< 1.0
+        # means the saved prefill reached the client).
+        "prefix_prefill_tokens_reduction_x": prefix.get(
+            "prefill_tokens_reduction_x"
+        ),
+        "prefix_ttft_p99_ratio": prefix.get("ttft_p99_ratio_vs_no_cache"),
         "allreduce_latency_ms": allreduce.get("all_reduce_ms_mean"),
         "details": details,
     }
@@ -1000,6 +1162,9 @@ def _build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--skip_disagg", action="store_true",
                         help="skip the disaggregated prefill/decode + "
                         "int8 KV workload")
+    parser.add_argument("--skip_prefix", action="store_true",
+                        help="skip the radix prefix-cache shared-preamble "
+                        "workload")
     parser.add_argument("--spec_batch", type=int, default=32,
                         help="concurrent requests in the lm_spec_decode "
                         "engine arm (the >=5x target holds for 8-32)")
@@ -1067,6 +1232,8 @@ def _child_main(args) -> int:
         detail = bench_fleet()
     elif key == "serving_disagg":
         detail = bench_disagg()
+    elif key == "serving_prefix":
+        detail = bench_serving_prefix()
     elif key == "allreduce":
         detail = bench_allreduce()
     else:
@@ -1265,6 +1432,16 @@ def main() -> None:
             value_key="resident_seqs_x",
             # 3 engine arms (colocated, disagg, disagg+int8), each paying
             # a (cached) warmup compile before its timed replay.
+            budget_s=max(args.workload_timeout, 900.0),
+        )
+
+    if not args.skip_prefix:
+        run(
+            "serving_prefix",
+            metric="serving_prefix_prefill_tokens_reduction_x", unit="x",
+            value_key="prefill_tokens_reduction_x",
+            # 2 engine arms (no_cache, prefix_cache), each paying a
+            # (cached) warmup compile before its timed replay.
             budget_s=max(args.workload_timeout, 900.0),
         )
 

@@ -11,7 +11,13 @@ Three tripwires, each the runtime half of a static rule:
   against a poisoned block is a use-after-free. Both fail loud with
   :class:`SanitizerError` instead of the generic accounting ValueError, so
   a drill (and a production run) can tell "caller freed twice" from
-  "caller never owned it".
+  "caller never owned it". The prefix-cache refcount layer adds two more
+  classes on the same pool: a refcount decremented below zero
+  (``sanitize_kv_refcount_underflow_total`` — the books say nobody owns a
+  block that is still in the used set) and a data/scale write recorded
+  against a block whose refcount is > 1
+  (``sanitize_kv_cow_violation_total`` — a writer skipped the
+  copy-on-write step and is mutating pages another sharer still reads).
 - **Retrace tripwire** (``sanitize_retrace_trips_total``): after a serving
   engine's :meth:`warmup` completes, the zero-compile contract is armed —
   any ``serve_compile_total`` tick raises unless it happens under the
@@ -53,6 +59,8 @@ __all__ = [
 
 KV_DOUBLE_FREE = "sanitize_kv_double_free_total"
 KV_USE_AFTER_FREE = "sanitize_kv_use_after_free_total"
+KV_REFCOUNT_UNDERFLOW = "sanitize_kv_refcount_underflow_total"
+KV_COW_VIOLATION = "sanitize_kv_cow_violation_total"
 RETRACE_TRIPS = "sanitize_retrace_trips_total"
 DONATION_TRIPS = "sanitize_donation_canary_trips_total"
 
@@ -77,8 +85,9 @@ def attach_registry(registry: Any) -> None:
     global _registry
     _registry = registry
     if registry is not None:
-        for name in (KV_DOUBLE_FREE, KV_USE_AFTER_FREE, RETRACE_TRIPS,
-                     DONATION_TRIPS):
+        for name in (KV_DOUBLE_FREE, KV_USE_AFTER_FREE,
+                     KV_REFCOUNT_UNDERFLOW, KV_COW_VIOLATION,
+                     RETRACE_TRIPS, DONATION_TRIPS):
             registry.counter(name)
 
 

@@ -106,6 +106,21 @@ def build_parser() -> argparse.ArgumentParser:
     eng.add_argument("--max_hold_steps", type=int, default=4,
                      help="max consecutive engine steps the scheduler may "
                      "hold decode while forming a larger batch bucket")
+    eng.add_argument("--prefix_cache", action="store_true",
+                     help="radix prefix cache: completed prompt prefixes "
+                     "are indexed by token span and later requests adopt "
+                     "the cached KV blocks (refcounted, copy-on-write) "
+                     "instead of re-prefilling the shared span — streams "
+                     "stay bit-identical to offline greedy")
+    eng.add_argument("--tenants", default="",
+                     help="per-tenant admission policy, e.g. "
+                     "'prod=4096:1,batch=1024:0' — name=budget_tokens"
+                     "[:priority]. budget_tokens bounds the tenant's "
+                     "committed tokens (prompt + max_new over queued + "
+                     "running; 0 = unlimited), over-budget submits are "
+                     "shed with reason tenant_budget; higher priority "
+                     "admits first. Trace entries pick their tenant via a "
+                     "'tenant' field (default 'default')")
     spec = parser.add_argument_group(
         "speculative decoding (exact-greedy-match acceptance: output "
         "streams stay bit-identical to offline greedy regardless of "
@@ -195,6 +210,31 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _parse_tenants(spec: str):
+    """``'prod=4096:1,batch=1024:0'`` -> the scheduler's tenants dict
+    (``{name: {"budget_tokens": int, "priority": float}}``), or None for
+    an empty spec."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    tenants = {}
+    for part in spec.split(","):
+        part = part.strip()
+        try:
+            name, policy = part.split("=", 1)
+            budget, _, priority = policy.partition(":")
+            tenants[name.strip()] = {
+                "budget_tokens": int(budget),
+                "priority": float(priority) if priority else 0.0,
+            }
+        except ValueError:
+            raise SystemExit(
+                f"bad --tenants entry {part!r}: expected "
+                "name=budget_tokens[:priority]"
+            )
+    return tenants
+
+
 def _load_trace(path: str, default_max_new: int, default_deadline: float):
     import numpy as np
 
@@ -219,6 +259,7 @@ def _load_trace(path: str, default_max_new: int, default_deadline: float):
             "prompt": prompt,
             "max_new": int(obj.get("max_new", default_max_new)),
             "deadline": float(obj.get("deadline", default_deadline)),
+            "tenant": str(obj.get("tenant", "default")),
         })
     if not entries:
         raise SystemExit(f"{path}: empty trace")
@@ -266,7 +307,10 @@ def replay(engine, entries, *, poll_s: float = 0.0005):
                 else None
             )
             reqs.append(
-                engine.submit(e["prompt"], e["max_new"], deadline=deadline)
+                engine.submit(
+                    e["prompt"], e["max_new"], deadline=deadline,
+                    tenant=e.get("tenant", "default"),
+                )
             )
         if not idle():
             try:
@@ -324,6 +368,15 @@ def _report(reqs, wall_s, registry, out=sys.stderr):
         ),
         file=out,
     )
+    if "serve_prefix_hits_total" in snap:
+        print(
+            f"prefix cache: {snap['serve_prefix_hits_total']:.0f} hits, "
+            f"{snap.get('serve_prefix_tokens_reused_total', 0):.0f} prefill "
+            f"tokens reused, "
+            f"{snap.get('serve_prefix_cow_copies_total', 0):.0f} CoW copies, "
+            f"{snap.get('serve_prefix_evictions_total', 0):.0f} evictions",
+            file=out,
+        )
     if snap.get("serve_handoffs_total"):
         print(
             f"disagg: {snap['serve_handoffs_total']:.0f} prefill→decode "
@@ -375,6 +428,7 @@ def _run_fleet(args, eos_id) -> int:
         "max_blocks_per_seq": args.max_blocks_per_seq,
         "prefill_chunk": args.prefill_chunk,
         "max_queue": args.max_queue,
+        "prefix_cache": args.prefix_cache,
     }
     if args.trace:
         entries = _load_trace(args.trace, args.max_new_tokens, args.deadline)
@@ -388,7 +442,7 @@ def _run_fleet(args, eos_id) -> int:
         model_spec, engine_spec, args.replicas, fleet_dir,
         seed=args.random_seed, eos_id=eos_id, warmup=True,
         chaos=args.chaos, hedge_ms=args.hedge_ms, registry=registry,
-        disagg=args.disagg, tp=args.tp,
+        disagg=args.disagg, tp=args.tp, tenants=_parse_tenants(args.tenants),
     )
     swap_seed = args.random_seed + 1 if args.swap_at is not None else None
     try:
@@ -688,9 +742,11 @@ def main(argv: list[str] | None = None) -> int:
             decode_buckets=decode_buckets,
             max_hold_steps=args.max_hold_steps,
             kv_dtype=args.kv_dtype,
+            prefix_cache=args.prefix_cache,
         ),
         dtype=dtype, eos_id=eos_id, registry=registry, chaos=chaos,
         draft_config=draft_cfg, draft_params=draft_params,
+        tenants=_parse_tenants(args.tenants),
     )
     if args.warmup:
         t_warm = time.monotonic()

@@ -27,6 +27,10 @@ from deeplearning_mpi_tpu.serving.kv_pool import (
     PagedKVPool,
     init_kv_buffers,
 )
+from deeplearning_mpi_tpu.serving.prefix_cache import (
+    RadixPrefixCache,
+    prefix_signature,
+)
 from deeplearning_mpi_tpu.serving.scheduler import (
     Request,
     RequestState,
@@ -46,6 +50,7 @@ __all__ = [
     "PagedForward",
     "PrefillEngine",
     "PagedKVPool",
+    "RadixPrefixCache",
     "Request",
     "RequestState",
     "Router",
@@ -54,4 +59,5 @@ __all__ = [
     "ServingEngine",
     "SpeculativeDecoder",
     "init_kv_buffers",
+    "prefix_signature",
 ]

@@ -94,6 +94,7 @@ class PrefillEngine(ServingEngine):
         now = self._clock()
         finished: list[Request] = []
         self._phase_admit(now)
+        self._phase_cow()
         self._phase_prefill(finished)
         self._phase_chaos()
         self.steps += 1
@@ -153,6 +154,7 @@ class DisaggregatedEngine:
         chaos: Any = None,
         draft_config: TransformerConfig | None = None,
         draft_params: Any = None,
+        tenants: dict[str, dict[str, Any]] | None = None,
     ) -> None:
         engine = engine or EngineConfig()
         storage = jnp.dtype(engine.kv_dtype) if engine.kv_dtype else None
@@ -182,10 +184,24 @@ class DisaggregatedEngine:
                 draft_config.head_dim,
                 storage if storage is not None else dtype,
             ))
+        # ONE prefix cache over the one shared pool: prefill inserts the
+        # full-block span at prompt completion, decode inserts the frozen
+        # partial tail at finish, and both index the same trie — a hit
+        # admitted at the prefill role adopts pages the decode role's
+        # requests froze. Built here (not per role) so neither engine
+        # constructs a private cache over the shared pool.
+        self.prefix_cache = None
+        if engine.prefix_cache:
+            from deeplearning_mpi_tpu.serving.prefix_cache import (
+                RadixPrefixCache,
+            )
+
+            self.prefix_cache = RadixPrefixCache(self.pool, registry=registry)
         common = dict(
             dtype=dtype, eos_id=eos_id, clock=clock, registry=registry,
             draft_config=draft_config, draft_params=draft_params,
             pool=self.pool, kv_buffers=kvh, draft_kv_buffers=draft_kvh,
+            prefix_cache=self.prefix_cache, tenants=tenants,
         )
         # serve_crash chaos stays with the prefill role — mid-admission +
         # partial prefill is the crash point recover() must untangle; the
@@ -232,8 +248,12 @@ class DisaggregatedEngine:
     def params(self, value: Any) -> None:
         # Hot weight swap (fleet `swap` op): both roles serve the same
         # model, so a swap must land on both atomically w.r.t. step().
+        # Cached prefix KV was computed under the OLD weights — bit-wrong
+        # under the new ones — so the swap flushes the shared cache.
         self.prefill.params = value
         self.decode.params = value
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush()
 
     def step(self) -> list[Request]:
         """One coordinated iteration: prefill step → handoff drain →
@@ -337,7 +357,19 @@ class DisaggregatedEngine:
                 req.slot = None
         for req in reversed(inflight):
             pre.scheduler.requeue(req)
-        stats = self.pool.reconcile(())
+        # Cached pages are proven-landed (each insert follows the owning
+        # prefill's first-token sync), so the shared cache SURVIVES the
+        # crash: reconcile rebuilds the free list and refcounts around it,
+        # and the requeued requests re-match it on re-admission. Pending
+        # CoW pins are dropped (their pinned sources are either cache
+        # references that survive or in-flight privates that reconcile
+        # reclaims).
+        pre.scheduler.clear_pending_cow()
+        dec.scheduler.clear_pending_cow()
+        live: list[int] = []
+        if self.prefix_cache is not None:
+            live = self.prefix_cache.referenced_blocks()
+        stats = self.pool.reconcile(live)
         self.pool.check()
         pre._inc("serve_requeued_total", len(inflight))
         pre._inc("serve_tokens_discarded_total", discarded)

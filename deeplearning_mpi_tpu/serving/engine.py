@@ -148,6 +148,14 @@ class EngineConfig:
     #: inside the jitted gather — an opt-in capacity multiplier whose
     #: output is tolerance-gated, not bit-exact (docs/SERVING.md).
     kv_dtype: str | None = None
+    #: radix prefix cache (``serving/prefix_cache.py``): completed prompt
+    #: prefixes are indexed by token span and later requests with the same
+    #: prefix adopt the KV blocks (refcounted, copy-on-write) instead of
+    #: re-prefilling them. Off by default — the cacheless path stays
+    #: byte-identical to the pre-cache engine; with it on, streams are
+    #: still bit-identical to offline greedy (docs/SERVING.md "Prefix
+    #: cache & multi-tenancy").
+    prefix_cache: bool = False
 
     @property
     def max_seq_len(self) -> int:
@@ -268,6 +276,19 @@ class PagedForward:
             dequantize_kv(k_pool[i][tables], k_scale[i][tables], self.dtype),
             dequantize_kv(v_pool[i][tables], v_scale[i][tables], self.dtype),
         )
+
+    # -- copy-on-write block copy (prefix cache) -----------------------------
+    def copy_block(
+        self, kv: tuple[jax.Array, ...], src: jax.Array, dst: jax.Array
+    ) -> tuple[jax.Array, ...]:
+        """Copy every pool's pages for block ``src`` into block ``dst``
+        (all layers, data AND scales in one program — same atomicity
+        argument as :meth:`_kv_scatter`). The prefix cache's CoW step: an
+        adopter of a partially-matched shared block gets a private copy to
+        write its divergent tail into. ``src``/``dst`` are traced scalars,
+        so one compilation covers every copy."""
+        self._tick()
+        return tuple(buf.at[:, dst].set(buf[:, src]) for buf in kv)
 
     # -- building blocks (mirror TransformerLM numerics) ---------------------
     def _lin(self, x: jax.Array, kernel: jax.Array) -> jax.Array:
@@ -581,6 +602,8 @@ class ServingEngine:
         kv_buffers: KVBuffers | None = None,
         draft_kv_buffers: KVBuffers | None = None,
         role: str | None = None,
+        prefix_cache: Any = None,
+        tenants: dict[str, dict[str, Any]] | None = None,
     ) -> None:
         engine = engine or EngineConfig()
         if config.moe_experts > 0:
@@ -638,6 +661,16 @@ class ServingEngine:
                 f"{engine.num_blocks}x{engine.block_size}"
             )
         self.pool = pool
+        # Radix prefix cache: built here when enabled, or injected shared
+        # (the disaggregated pair indexes ONE cache over its shared pool).
+        # Injection implies enabled regardless of the config flag.
+        self.prefix_cache = prefix_cache
+        if self.prefix_cache is None and engine.prefix_cache:
+            from deeplearning_mpi_tpu.serving.prefix_cache import (
+                RadixPrefixCache,
+            )
+
+            self.prefix_cache = RadixPrefixCache(self.pool, registry=registry)
         self.scheduler = Scheduler(
             self.pool,
             max_slots=engine.max_slots,
@@ -646,6 +679,8 @@ class ServingEngine:
             registry=registry,
             decode_buckets=engine.decode_buckets,
             max_hold_steps=engine.max_hold_steps,
+            prefix_cache=self.prefix_cache,
+            tenants=tenants,
         )
         if kv_buffers is None:
             kv_buffers = KVBuffers(init_kv_buffers(
@@ -700,6 +735,11 @@ class ServingEngine:
                     "spec_blocks_rolled_back_total",
                 ):
                     registry.counter(name)
+            if self.prefix_cache is not None:
+                # Counters live on the cache itself; the occupancy gauges
+                # are set alongside the engine's other gauges each step.
+                registry.gauge("serve_prefix_nodes")
+                registry.gauge("serve_prefix_blocks")
         self._fwd = PagedForward(
             config, engine, dtype,
             tick=lambda: self._inc("serve_compile_total"),
@@ -720,6 +760,15 @@ class ServingEngine:
         self._prefill_jit = jax.jit(
             self._fwd.prefill_chunk, donate_argnums=self._kv_donate
         )
+        # CoW copy program (prefix cache only): kv is argument 0 here, so
+        # the donation index differs from the model-first programs above.
+        self._copy_fn = None
+        if self.prefix_cache is not None:
+            self._copy_jit = jax.jit(
+                self._fwd.copy_block,
+                donate_argnums=(0,) if self._kv_donate else (),
+            )
+            self._copy_fn = self._timed_first_call(self._copy_jit)
         # Lazily-compiling entry points until warmup() swaps in the AOT
         # executables; the wrappers record first-call (= compile) wall time
         # into serve_compile_seconds.
@@ -749,6 +798,7 @@ class ServingEngine:
                 donate=self._kv_donate,
                 kv_dtype=storage,
                 kv_buffers=draft_kv_buffers,
+                prefix_cache=self.prefix_cache is not None,
             )
             self._verify_jit = jax.jit(
                 self._fwd.verify_step, donate_argnums=self._kv_donate
@@ -890,6 +940,12 @@ class ServingEngine:
                 slots_i32, jnp.zeros((e.max_slots,), bool),
             )
             self._spec.register_warmup(reg)
+        if self.prefix_cache is not None:
+            # src/dst are traced scalars: ONE compilation covers every CoW.
+            reg.register(
+                "serve_kv_copy_block", self._copy_jit,
+                self._kv, jnp.int32(0), jnp.int32(0),
+            )
         programs = reg.warm_all()
         if self._metrics is not None:
             for prog in programs.values():
@@ -907,6 +963,10 @@ class ServingEngine:
                 programs["serve_verify_step"], self._verify_jit
             )
             self._spec.adopt_warmup(programs)
+        if self.prefix_cache is not None:
+            self._copy_fn = aot.WarmProgram(
+                programs["serve_kv_copy_block"], self._copy_jit
+            )
         # Pre-trace every narrower gather-width bucket through the jit
         # fallbacks (WarmProgram covers only the full-width avals): an
         # all-inactive batch routes its writes to the scratch block and
@@ -937,6 +997,7 @@ class ServingEngine:
         *,
         deadline: Optional[float] = None,
         arrival: Optional[float] = None,
+        tenant: str = "default",
     ) -> Request:
         """Enqueue one request (or shed it at the door — check
         ``req.state``). ``prompt`` is a 1-D int sequence.
@@ -960,6 +1021,7 @@ class ServingEngine:
             max_new_tokens=max_new_tokens,
             arrival=self._clock() if arrival is None else arrival,
             deadline=deadline,
+            tenant=tenant,
         )
         self._next_rid += 1
         self._inc("serve_requests_submitted")
@@ -991,6 +1053,7 @@ class ServingEngine:
         now = self._clock()
         finished: list[Request] = []
         self._phase_admit(now)
+        self._phase_cow()
         self._phase_prefill(finished)
         self._phase_chaos()
         decoding = self._phase_grow()
@@ -1007,6 +1070,32 @@ class ServingEngine:
         admitted = self.scheduler.admit(now)
         self._inc("serve_requests_admitted", len(admitted))
         return admitted
+
+    def _phase_cow(self) -> None:
+        """Copy-on-write for partially-matched prefix adoptions.
+
+        Runs between admit and prefill: an adopter whose match ends
+        mid-block got the shared source pinned (extra pool ref) and a
+        private destination at admission; the device copy must land before
+        the adopter's first prefill chunk gathers from — and writes into —
+        the destination. The pin is dropped either way; a request that
+        died between admission and here (external cancel) just unpins.
+        """
+        if self.prefix_cache is None:
+            return
+        for src, dst, req in self.scheduler.take_pending_cow():
+            if req.state is RequestState.PREFILL:
+                self._kv = self._copy_fn(
+                    self._kv, jnp.int32(src), jnp.int32(dst)
+                )
+                if self._spec is not None:
+                    # The draft's pools ride the same block tables, so the
+                    # adopted prefix must exist there too — mirror the copy
+                    # (same src/dst ids, draft pools).
+                    self._spec.copy_block(src, dst)
+                self._record_writes([dst])
+                self.prefix_cache.note_cow()
+            self.pool.free([src])  # unpin the CoW source
 
     def _phase_prefill(self, finished: list[Request]) -> None:
         """One prefill chunk for every PREFILL slot."""
@@ -1185,6 +1274,12 @@ class ServingEngine:
                 need = self.pool.blocks_for(req.length + n) - len(req.blocks)
                 if need > 0:
                     got = self.pool.alloc(need)
+                    if got is None and self.prefix_cache is not None:
+                        # Unreferenced cache branches are cheaper than a
+                        # degraded proposal budget — evict before giving up
+                        # (still never evicting a live peer).
+                        if self.prefix_cache.evict(need - self.pool.available):
+                            got = self.pool.alloc(need)
                     if got is not None:
                         req.blocks.extend(got)
                     else:
@@ -1311,8 +1406,17 @@ class ServingEngine:
         discarded = sum(len(r.generated) for r in inflight)
         for req in reversed(inflight):
             self.scheduler.requeue(req)
-        # No sequence owns verified blocks after requeue — free everything.
-        stats = self.pool.reconcile(())
+        # No sequence owns verified blocks after requeue — but the prefix
+        # cache's pages ARE verified (each insert happened after its
+        # owner's first-token device sync), so the cache survives: its
+        # references are the reconcile ground truth, pending CoW pins are
+        # dropped without freeing (reconcile rebuilds every refcount), and
+        # requeued requests can still hit the cache on re-admission.
+        self.scheduler.clear_pending_cow()
+        live: list[int] = []
+        if self.prefix_cache is not None:
+            live = self.prefix_cache.referenced_blocks()
+        stats = self.pool.reconcile(live)
         self.pool.check()
         self._inc("serve_requeued_total", len(inflight))
         self._inc("serve_tokens_discarded_total", discarded)
@@ -1364,6 +1468,18 @@ class ServingEngine:
         self._inc("serve_tokens_generated")
         if self._metrics is not None and req.ttft is not None:
             self._metrics.histogram("serve_ttft_s").observe(req.ttft)
+        if self.prefix_cache is not None:
+            # Index the FULL prompt blocks now: from this point the request
+            # only writes positions >= prompt_len, which never land in a
+            # full prefix block, so those pages are frozen. (The partial
+            # tail block is still being written by decode; it is indexed at
+            # _finish.) The device_get above is the proof the writes
+            # landed — insertion after it makes cached pages crash-safe.
+            n_full = req.prompt_len // e.block_size
+            if n_full:
+                self.prefix_cache.insert(
+                    req.prompt, req.blocks, n_full * e.block_size
+                )
         if self._done(req, tok):
             self._finish(req, req.t_first_token, finished)
         else:
@@ -1391,6 +1507,12 @@ class ServingEngine:
         return len(req.generated) >= req.max_new_tokens
 
     def _finish(self, req: Request, now: float, finished: list[Request]) -> None:
+        if self.prefix_cache is not None and req.prompt_len % self.engine.block_size:
+            # The partial tail block becomes immutable only now (decode was
+            # writing generated positions into it); index its frozen span —
+            # the prompt positions past the last full block — BEFORE the
+            # release below drops the request's own reference.
+            self.prefix_cache.insert(req.prompt, req.blocks, req.prompt_len)
         self.scheduler.finish(req, now)
         finished.append(req)
         self._inc("serve_requests_completed")
@@ -1436,3 +1558,16 @@ class ServingEngine:
         self._metrics.gauge(
             labeled("serve_kv_bytes", dtype=self._kv_dtype_name)
         ).set(nbytes)
+        if self.prefix_cache is not None:
+            self._metrics.gauge("serve_prefix_nodes").set(
+                self.prefix_cache.num_nodes
+            )
+            self._metrics.gauge("serve_prefix_blocks").set(
+                self.prefix_cache.num_blocks_cached
+            )
+        if self.scheduler.tenants:
+            inflight = self.scheduler.tenant_tokens_in_flight()
+            for tenant in self.scheduler.tenants:
+                self._metrics.gauge(
+                    labeled("serve_tenant_tokens_in_flight", tenant=tenant)
+                ).set(inflight.get(tenant, 0))

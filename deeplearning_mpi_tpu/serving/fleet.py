@@ -189,6 +189,7 @@ def worker_main(argv: list[str] | None = None) -> int:
         cfg, params, EngineConfig(**spec["engine"]),
         dtype=jnp.float32, eos_id=spec.get("eos_id"),
         registry=registry, chaos=chaos,
+        tenants=spec.get("tenants") or None,
     )
     if disagg:
         eng_idle = engine.idle
@@ -242,6 +243,7 @@ def worker_main(argv: list[str] | None = None) -> int:
                     req = engine.submit(
                         np.asarray(m["prompt"], np.int32), int(m["max_new"]),
                         deadline=m.get("deadline"), arrival=m.get("arrival"),
+                        tenant=m.get("tenant", "default"),
                     )
                     if req.state is RequestState.SHED:
                         emit({"op": "shed", "rid": rid,
@@ -260,6 +262,13 @@ def worker_main(argv: list[str] | None = None) -> int:
                     # with zero retraces. The ack carries the compile
                     # counter so the supervisor can PROVE that.
                     engine.params = init_params(int(m["seed"]))
+                    # Cached prefix KV was computed under the old weights;
+                    # serving it after the swap would break greedy parity.
+                    # (DisaggregatedEngine flushes in its params setter —
+                    # flushing an already-empty cache is a no-op.)
+                    cache = getattr(engine, "prefix_cache", None)
+                    if cache is not None:
+                        cache.flush()
                     version = int(m["version"])
                     emit({"op": "swapped", "version": version,
                           "compile_total": compile_counter.value})
@@ -355,6 +364,7 @@ class _Req:
     max_new: int
     arrival_abs: float
     deadline_abs: Optional[float]
+    tenant: str = "default"
     holders: set[int] = dataclasses.field(default_factory=set)
     tokens: Optional[list[int]] = None
     version: Optional[int] = None
@@ -420,6 +430,7 @@ class FleetSupervisor:
         env: Mapping[str, str] | None = None,
         disagg: bool = False,
         tp: int = 1,
+        tenants: dict[str, dict[str, Any]] | None = None,
     ) -> None:
         from deeplearning_mpi_tpu.resilience.faults import (
             FLEET_KINDS,
@@ -444,6 +455,10 @@ class FleetSupervisor:
         if tp < 1:
             raise ValueError(f"tp must be >= 1, got {tp}")
         self.tp = int(tp)
+        #: per-tenant admission policy shipped to every worker — the
+        #: scheduler enforces budgets replica-locally (no global ledger;
+        #: the trace's tenant labels ride along with each dispatch).
+        self.tenants = dict(tenants) if tenants else None
         self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
         if self.chaos_spec.strip():
             validate_plan_kinds(
@@ -498,6 +513,7 @@ class FleetSupervisor:
             "warmup": self.warmup,
             "disagg": self.disagg,
             "tp": self.tp,
+            "tenants": self.tenants,
         })
         (rdir / "inbox.jsonl").touch()
         env = dict(os.environ)
@@ -713,15 +729,28 @@ class FleetSupervisor:
             rep.attempt += 1
             self._spawn(rep)
 
+        from deeplearning_mpi_tpu.serving.prefix_cache import prefix_signature
+
+        block_size = int(self.engine_spec.get("block_size", 16))
+
+        def req_sig(rec: _Req) -> Optional[int]:
+            # The supervisor computes the same leading-block signature the
+            # workers' radix caches key their first trie level by, so
+            # affinity routing and cache contents agree cross-process.
+            return prefix_signature(rec.prompt, block_size)
+
         def dispatch(rid: int, target: int, now: float) -> None:
             rec = ledger[rid]
             self._send(replicas[target], {
                 "op": "req", "rid": rid, "prompt": rec.prompt,
                 "max_new": rec.max_new, "arrival": rec.arrival_abs,
-                "deadline": rec.deadline_abs,
+                "deadline": rec.deadline_abs, "tenant": rec.tenant,
             })
             rec.holders.add(target)
-            router.dispatch(rid, target, now, deadline=rec.deadline_abs)
+            router.dispatch(
+                rid, target, now,
+                deadline=rec.deadline_abs, prefix_sig=req_sig(rec),
+            )
 
         def handle_msg(rep: _Replica, m: dict) -> None:
             nonlocal completed, phase, swap_stage
@@ -869,7 +898,9 @@ class FleetSupervisor:
                 # deadline ride along — failover never refreshes a budget).
                 while redispatch_queue:
                     rid = redispatch_queue[0]
-                    target = router.select(now)
+                    target = router.select(
+                        now, prefix_sig=req_sig(ledger[rid])
+                    )
                     if target is None:
                         break  # whole fleet cold; retry next tick
                     redispatch_queue.popleft()
@@ -885,7 +916,7 @@ class FleetSupervisor:
                     self._send(replicas[target], {
                         "op": "req", "rid": rid, "prompt": rec.prompt,
                         "max_new": rec.max_new, "arrival": rec.arrival_abs,
-                        "deadline": rec.deadline_abs,
+                        "deadline": rec.deadline_abs, "tenant": rec.tenant,
                     })
                     rec.holders.add(target)
                     self._log(
@@ -940,7 +971,13 @@ class FleetSupervisor:
 
                 # 8. admit due trace entries.
                 while pending and t0 + pending[0]["arrival"] <= now:
-                    target = router.select(now)
+                    target = router.select(
+                        now,
+                        prefix_sig=prefix_signature(
+                            [int(t) for t in pending[0]["prompt"]],
+                            block_size,
+                        ),
+                    )
                     if target is None:
                         break  # fleet saturated/cold — hold at the door
                     e = pending.popleft()
@@ -956,6 +993,7 @@ class FleetSupervisor:
                             t0 + float(e["arrival"]) + float(deadline)
                             if deadline > 0 else None
                         ),
+                        tenant=str(e.get("tenant", "default")),
                     )
                     dispatch(rid, target, now)
 

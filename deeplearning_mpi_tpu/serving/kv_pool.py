@@ -36,6 +36,17 @@ Invariants (checked by :meth:`PagedKVPool.check`):
 - no block is simultaneously free and allocated, or allocated twice;
 - allocation is all-or-nothing: a request that can't get every block it
   asked for gets none (no partial reservations to leak under load).
+
+**Refcounted sharing (prefix cache).** A block normally has exactly one
+owner; the radix prefix cache (``serving/prefix_cache.py``) makes full
+prompt-prefix blocks shared between the cache and every request that
+adopted them. :meth:`share` increments a per-block refcount, :meth:`free`
+decrements and only returns the block to the free list when the count
+reaches zero, and :meth:`record_fill` / :meth:`record_scale` refuse
+writes to a block whose refcount is > 1 — a sharer that wants to write
+past the frozen span must copy the block first (CoW). The refcount store
+is sparse (only counts > 1 are kept; absent means 1) so the unshared hot
+path stays allocation-free.
 """
 
 from __future__ import annotations
@@ -70,6 +81,9 @@ class PagedKVPool:
         # allocation order, which the tests (and debugging) rely on.
         self._free: list[int] = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
         self._used: set[int] = set()
+        # Sparse refcounts for shared blocks: only counts > 1 are stored;
+        # a block in _used but absent here has exactly one owner.
+        self._refcount: dict[int, int] = {}
         # Monotonic counters for telemetry / the reuse-proving tests.
         self.total_allocated = 0
         self.total_freed = 0
@@ -131,26 +145,89 @@ class PagedKVPool:
             self._san.on_alloc(blocks)
         return blocks
 
+    def share(self, blocks: Iterable[int]) -> None:
+        """Add one owner to each of ``blocks`` (prefix-cache adoption).
+
+        Every block must already be allocated — sharing is always "I now
+        also hold what somebody live holds", never a fresh allocation.
+        Each sharer must eventually :meth:`free` its reference."""
+        blocks = list(blocks)
+        for b in blocks:
+            if b not in self._used:
+                raise ValueError(f"sharing block {b} that is not allocated")
+        for b in blocks:
+            self._refcount[b] = self._refcount.get(b, 1) + 1
+
+    def refcount(self, block: int) -> int:
+        """Owners of ``block`` (0 if it is not allocated at all)."""
+        if block not in self._used:
+            return 0
+        return self._refcount.get(block, 1)
+
     def free(self, blocks: Iterable[int]) -> None:
-        """Return blocks to the free list. Freeing a block that is not
+        """Drop one reference per block; recycle at refcount zero.
+
+        Unshared blocks (the common case) go straight back to the free
+        list. Shared blocks just decrement — the last owner's free is the
+        one that recycles, so evicting one sharer can never release pages
+        another sharer still gathers from. Freeing a block that is not
         allocated (double-free, scratch, out of range) is a caller bug and
         raises — silent tolerance here would mask exactly the accounting
-        errors this class exists to prevent."""
+        errors this class exists to prevent. A refcount already below one
+        on a block still in the used set is corrupted bookkeeping and is
+        classified by the sanitizer as a refcount underflow."""
         blocks = list(blocks)
         if self._san is not None:
             self._san.check_free(blocks, self._used)
+        recycled = []
         for b in blocks:
             if b not in self._used:
                 raise ValueError(f"freeing block {b} that is not allocated")
+            rc = self._refcount.get(b, 1)
+            if rc < 1:
+                msg = (
+                    f"refcount underflow on KV block {b}: count {rc} with "
+                    "the block still in the used set — a sharer was freed "
+                    "twice or the books were torn"
+                )
+                if self._san is not None:
+                    from deeplearning_mpi_tpu.analysis import sanitizer
+
+                    sanitizer.trip(sanitizer.KV_REFCOUNT_UNDERFLOW, msg)
+                raise ValueError(msg)
+            if rc > 1:
+                if rc == 2:
+                    self._refcount.pop(b, None)
+                else:
+                    self._refcount[b] = rc - 1
+                continue
+            self._refcount.pop(b, None)
             self._used.remove(b)
             self._free.append(b)
             self.total_freed += 1
             self._fill_epoch.pop(b, None)
             self._scale_epoch.pop(b, None)
-        if self._san is not None:
-            self._san.on_free(blocks)
+            recycled.append(b)
+        if self._san is not None and recycled:
+            self._san.on_free(recycled)
 
     # -- quantized-pool write accounting ------------------------------------
+    def _check_cow(self, b: int, kind: str) -> None:
+        """Writes to a shared block are forbidden: every sharer reads the
+        same frozen pages, so a writer must copy first (CoW)."""
+        if self._refcount.get(b, 1) <= 1:
+            return
+        msg = (
+            f"{kind} write recorded against shared KV block {b} "
+            f"(refcount {self._refcount[b]}): the writer skipped "
+            "copy-on-write and is mutating pages other sharers still read"
+        )
+        if self._san is not None:
+            from deeplearning_mpi_tpu.analysis import sanitizer
+
+            sanitizer.trip(sanitizer.KV_COW_VIOLATION, msg)
+        raise ValueError(msg)
+
     def record_fill(self, blocks: Iterable[int]) -> None:
         """Note that the engine scattered KV *data* into ``blocks`` this
         step. Paired with :meth:`record_scale` on quantized pools; the
@@ -163,6 +240,7 @@ class PagedKVPool:
                 continue
             if b not in self._used:
                 raise ValueError(f"recording fill of unallocated block {b}")
+            self._check_cow(b, "data")
             self._fill_epoch[b] = self._fill_epoch.get(b, 0) + 1
 
     def record_scale(self, blocks: Iterable[int]) -> None:
@@ -176,6 +254,7 @@ class PagedKVPool:
                 continue
             if b not in self._used:
                 raise ValueError(f"recording scale of unallocated block {b}")
+            self._check_cow(b, "scale")
             self._scale_epoch[b] = self._scale_epoch.get(b, 0) + 1
 
     def reconcile(self, live_blocks: Iterable[int]) -> dict[str, int]:
@@ -191,8 +270,18 @@ class PagedKVPool:
         else becomes free. Returns ``{"reclaimed": leaked, "adopted":
         orphaned}`` for the recovery log; :meth:`check` passes by
         construction afterwards.
+
+        ``live_blocks`` may contain duplicates: each occurrence is one
+        live reference, and the multiplicity becomes the rebuilt refcount
+        (the prefix cache reports its retained blocks alongside any
+        surviving sequences' block tables, so a shared block rebuilds with
+        every owner counted — recovery can neither leak a shared block nor
+        double-free it when the sharers drain).
         """
-        live = set(live_blocks)
+        from collections import Counter
+
+        counts = Counter(live_blocks)
+        live = set(counts)
         if SCRATCH_BLOCK in live:
             raise ValueError("scratch block claimed as live")
         bad = [b for b in live if not (0 < b < self.num_blocks)]
@@ -203,6 +292,7 @@ class PagedKVPool:
         self.total_freed += len(reclaimed)
         self.total_allocated += len(adopted)
         self._used = set(live)
+        self._refcount = {b: c for b, c in counts.items() if c > 1}
         all_ids = set(range(SCRATCH_BLOCK + 1, self.num_blocks))
         self._free = sorted(all_ids - live, reverse=True)
         # Epochs restart from a consistent baseline: reclaimed blocks lose
@@ -237,6 +327,12 @@ class PagedKVPool:
         )
         stray = (set(self._fill_epoch) | set(self._scale_epoch)) - self._used
         assert not stray, f"write epochs recorded for non-live blocks {stray}"
+        rc_stray = set(self._refcount) - self._used
+        assert not rc_stray, f"refcounts recorded for non-live blocks {rc_stray}"
+        rc_bad = {b: c for b, c in self._refcount.items() if c <= 1}
+        assert not rc_bad, (
+            f"non-sparse refcounts {rc_bad}: counts <= 1 must not be stored"
+        )
         if self.quantized:
             torn = [
                 b

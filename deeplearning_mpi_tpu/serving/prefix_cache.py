@@ -1,0 +1,335 @@
+"""Radix prefix cache: token-prefix -> KV block chain, shared copy-on-write.
+
+Millions of requests share system prompts, few-shot preambles, and
+conversation history, yet a cacheless engine re-prefills the full prompt
+into freshly allocated blocks on every admission. This module indexes the
+KV blocks of *completed* prefills by their token prefix so a later request
+with the same prefix adopts the blocks instead of recomputing them
+(vLLM's prefix caching / SGLang's RadixAttention; PAPERS: Gemma-on-TPU
+serving frames the prefill-vs-decode cost split this exploits).
+
+Design, in terms the rest of the repo already speaks:
+
+- **One trie level == one logical block.** The paged pool (``kv_pool.py``)
+  is block-atomic — a physical page holds ``block_size`` token positions
+  and is adopted whole — so the trie is built at block granularity: a
+  node's edge is the exact ``block_size``-token span one block covers, and
+  structural mid-block splits are impossible by construction. Divergence
+  inside a block is handled by *partial* adoption instead: a node's block
+  can be matched for a longest-common-prefix shorter than its span, in
+  which case the adopter copies the block (CoW) and re-prefills only the
+  divergent tail.
+- **Refcounts, not ownership transfer.** The cache holds exactly ONE pool
+  reference per indexed block (:meth:`PagedKVPool.share`); every adopting
+  request holds its own. ``pool.free`` decrements and recycles at zero,
+  so finishing or evicting one sharer can never release pages another
+  sharer (or the cache) still gathers from.
+- **Frozen spans.** A cached block's pages are immutable: the pool refuses
+  ``record_fill``/``record_scale`` on any block with refcount > 1 (a
+  sanitized run classifies the attempt as
+  ``sanitize_kv_cow_violation_total``). Writers past the frozen span get
+  a private copy first — the engine's ``_phase_cow`` performs the device
+  copy before the first prefill chunk of an adopting request runs.
+- **LRU eviction under pool pressure.** :meth:`evict` walks leaf nodes the
+  cache is the *sole* owner of (refcount == 1) and frees the
+  least-recently-matched first; branches pinned by live adopters are
+  skipped (freeing them would not return pages anyway). The scheduler and
+  engine call it when an alloc fails, before shedding or evicting a live
+  request.
+
+Parity argument (why streams stay bit-identical to offline greedy): an
+adopted block's pages were written by a completed prefill of the SAME
+token prefix under the SAME params, and positions at and past the match
+point are freshly prefilled/decoded by the adopter. Stale rows in a CoW'd
+partial block beyond the matched span are either overwritten by the
+adopter's prefill or causally masked (the gather attends only positions
+< length). The cache must therefore be flushed on a weight swap
+(:meth:`flush`) — blocks computed under old params are bit-wrong under
+new ones even though shapes match.
+
+Telemetry: ``serve_prefix_hits_total``, ``serve_prefix_tokens_reused_total``,
+``serve_prefix_cow_copies_total``, ``serve_prefix_evictions_total``
+counters plus ``serve_prefix_nodes`` / ``serve_prefix_blocks`` gauges
+(rendered by ``tools/metrics_report.py``; schema-checked by DMT007).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+from deeplearning_mpi_tpu.serving.kv_pool import PagedKVPool
+
+__all__ = ["RadixPrefixCache", "prefix_signature"]
+
+
+def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+def prefix_signature(tokens: Sequence[int], block_size: int) -> int | None:
+    """Compact signature of the first full block span of ``tokens``.
+
+    Used by the router's prefix-affinity scoring: two requests with the
+    same leading ``block_size`` tokens map to the same signature, so the
+    router can steer them to a replica whose cache already holds the
+    prefix. ``None`` when the prompt has no full block (nothing a remote
+    cache could share). Deterministic across processes (no PYTHONHASHSEED
+    dependence) — the supervisor and workers must agree on it.
+    """
+    if len(tokens) < block_size:
+        return None
+    import zlib
+
+    head = ",".join(str(int(t)) for t in tokens[:block_size])
+    return zlib.crc32(head.encode("ascii"))
+
+
+class _Node:
+    """One cached block: ``span`` is the token span its pages cover.
+
+    ``len(span) == block_size`` for a *full* node (interior or leaf;
+    carries ``children`` / ``partials``); ``len(span) < block_size`` for a
+    *partial* leaf (the frozen tail of a completed prompt — always a
+    leaf, matched by longest common prefix).
+    """
+
+    __slots__ = ("span", "block", "parent", "children", "partials", "last_used")
+
+    def __init__(self, span: tuple[int, ...], block: int, parent: "_Node | None"):
+        self.span = span
+        self.block = block
+        self.parent = parent
+        self.children: dict[tuple[int, ...], _Node] = {}
+        self.partials: list[_Node] = []
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Block-granularity radix index over completed prompt prefixes."""
+
+    def __init__(self, pool: PagedKVPool, *, registry: Any = None) -> None:
+        self.pool = pool
+        self.registry = registry
+        self.block_size = pool.block_size
+        self.root = _Node((), -1, None)
+        self._tick = 0
+        self.num_nodes = 0
+        if registry is not None:
+            for name in (
+                "serve_prefix_hits_total",
+                "serve_prefix_tokens_reused_total",
+                "serve_prefix_cow_copies_total",
+                "serve_prefix_evictions_total",
+            ):
+                registry.counter(name)
+
+    # -- telemetry ----------------------------------------------------------
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self.registry is not None:
+            self.registry.counter(name).inc(n)
+
+    def note_hit(self, tokens_reused: int) -> None:
+        """Called by the scheduler once an adoption actually lands (after
+        the private-tail alloc succeeds — a match that fails admission is
+        not a hit)."""
+        self._inc("serve_prefix_hits_total")
+        self._inc("serve_prefix_tokens_reused_total", tokens_reused)
+
+    def note_cow(self) -> None:
+        """Called by the engine per completed CoW device copy."""
+        self._inc("serve_prefix_cow_copies_total")
+
+    def _touch(self, node: _Node) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    # -- lookup -------------------------------------------------------------
+    def match(
+        self, prompt: Sequence[int]
+    ) -> tuple[int, list[int], tuple[int, int] | None]:
+        """Longest cached prefix of ``prompt``.
+
+        Returns ``(fill, chain, partial)``: ``fill`` matched tokens total
+        (capped at ``len(prompt) - 1`` so the final prompt position is
+        always prefilled — the engine needs its logits to emit the first
+        token), ``chain`` the fully-adopted blocks (``fill // block_size``
+        of them), and ``partial`` either ``None`` or ``(src_block,
+        lcp_len)`` — a block whose first ``lcp_len`` rows match but which
+        must be copied (CoW) before the adopter writes its tail.
+
+        Shares nothing: the caller decides whether the admission goes
+        through and then pins via ``pool.share``.
+        """
+        toks = [int(t) for t in prompt]
+        limit = len(toks) - 1
+        bs = self.block_size
+        node = self.root
+        chain: list[int] = []
+        fill = 0
+        while fill + bs <= limit:
+            child = node.children.get(tuple(toks[fill:fill + bs]))
+            if child is None:
+                break
+            chain.append(child.block)
+            fill += bs
+            node = child
+            self._touch(node)
+        # Partial adoption inside the next block: best longest-common-prefix
+        # over this node's partial leaves AND the leading rows of its full
+        # children (a full block is partially adoptable too).
+        rest = toks[fill:limit]
+        best: _Node | None = None
+        best_len = 0
+        for pn in node.partials:
+            l = _lcp(pn.span, rest)
+            if l > best_len:
+                best, best_len = pn, l
+        for span, child in node.children.items():
+            l = _lcp(span, rest)
+            if l > best_len:
+                best, best_len = child, l
+        if best is not None and best_len > 0:
+            self._touch(best)
+            return fill + best_len, chain, (best.block, best_len)
+        return fill, chain, None
+
+    # -- insertion ----------------------------------------------------------
+    def insert(self, prompt: Sequence[int], blocks: Sequence[int], frozen: int) -> None:
+        """Index the first ``frozen`` positions of ``prompt`` whose KV lives
+        in ``blocks`` (the owning request's logical block list).
+
+        Called twice per request: at prefill completion with ``frozen``
+        rounded DOWN to a block boundary (the full blocks are immutable
+        from that point on), and at finish with ``frozen = prompt_len``
+        (the partial tail block is immutable only once the request stops
+        writing). Existing nodes win — inserting a span that is already
+        indexed shares nothing and keeps the incumbent block.
+        """
+        bs = self.block_size
+        toks = [int(t) for t in prompt[:frozen]]
+        node = self.root
+        i = 0
+        while i + bs <= frozen:
+            key = tuple(toks[i:i + bs])
+            child = node.children.get(key)
+            if child is None:
+                b = blocks[i // bs]
+                self.pool.share([b])
+                child = _Node(key, b, node)
+                node.children[key] = child
+                self.num_nodes += 1
+            self._touch(child)
+            node = child
+            i += bs
+        rem = tuple(toks[i:frozen])
+        if not rem:
+            return
+        b = blocks[i // bs]
+        for pn in node.partials:
+            l = _lcp(pn.span, rem)
+            if l == len(pn.span):
+                if len(rem) > len(pn.span):
+                    # Upgrade: ours freezes strictly more rows of the same
+                    # span. Swap the cache's reference to the longer block;
+                    # live adopters of the old one keep it alive.
+                    self.pool.share([b])
+                    self.pool.free([pn.block])
+                    pn.block = b
+                    pn.span = rem
+                self._touch(pn)
+                return
+            if l == len(rem):
+                # An incumbent already freezes a superspan of ours.
+                self._touch(pn)
+                return
+        self.pool.share([b])
+        pn = _Node(rem, b, node)
+        node.partials.append(pn)
+        self.num_nodes += 1
+        self._touch(pn)
+
+    # -- eviction / teardown ------------------------------------------------
+    def _leaves(self) -> list[_Node]:
+        out: list[_Node] = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                if child.children or child.partials:
+                    stack.append(child)
+                else:
+                    out.append(child)
+            out.extend(node.partials)
+        return out
+
+    def _remove(self, node: _Node) -> None:
+        parent = node.parent
+        assert parent is not None and not node.children and not node.partials
+        if len(node.span) == self.block_size:
+            del parent.children[node.span]
+        else:
+            parent.partials.remove(node)
+        self.pool.free([node.block])
+        self.num_nodes -= 1
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` blocks by pruning least-recently-matched leaves
+        the cache solely owns (refcount == 1 — pruning a branch a live
+        request still shares would decrement without returning pages).
+        Returns how many blocks were actually recycled. O(nodes) per
+        victim; fine at serving scale where eviction is the rare path.
+        """
+        freed = 0
+        while freed < n:
+            victim: _Node | None = None
+            for leaf in self._leaves():
+                if self.pool.refcount(leaf.block) != 1:
+                    continue
+                if victim is None or leaf.last_used < victim.last_used:
+                    victim = leaf
+            if victim is None:
+                break
+            self._remove(victim)
+            freed += 1
+            self._inc("serve_prefix_evictions_total")
+        return freed
+
+    def flush(self) -> int:
+        """Drop every cached block (one pool ref each) and reset the trie.
+
+        Mandatory on a weight swap: cached KV computed under the old
+        params is bit-wrong under the new ones. Also used by drills to
+        prove the refcount books reconcile to zero at drain."""
+        blocks = self.referenced_blocks()
+        if blocks:
+            self.pool.free(blocks)
+        self.root = _Node((), -1, None)
+        self.num_nodes = 0
+        return len(blocks)
+
+    # -- recovery -----------------------------------------------------------
+    def referenced_blocks(self) -> list[int]:
+        """Every block the cache holds a reference to (one entry each).
+
+        Crash recovery passes this to ``pool.reconcile`` alongside the
+        surviving sequences' block tables: cached pages are proven-landed
+        (each insert happens only after the owning prefill's first-token
+        sync), so the cache survives a recovery and requeued requests can
+        still hit it.
+        """
+        out: list[int] = []
+        stack = list(self.root.children.values()) + list(self.root.partials)
+        while stack:
+            node = stack.pop()
+            out.append(node.block)
+            stack.extend(node.children.values())
+            stack.extend(node.partials)
+        return out
+
+    @property
+    def num_blocks_cached(self) -> int:
+        return self.num_nodes

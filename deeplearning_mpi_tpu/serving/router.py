@@ -49,6 +49,11 @@ class _Replica:
     draining: bool = False
     #: monotonic time before which a once-dead replica stays ineligible.
     excluded_until: float = 0.0
+    #: prefix signature -> last dispatch time carrying it. A replica that
+    #: recently served a prompt with this leading-block signature likely
+    #: still holds the prefix in its radix cache, so routing the next
+    #: same-signature request there turns a cold prefill into a hit.
+    prefix_sigs: dict[int, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -110,6 +115,10 @@ class Router:
         state = self._replicas[replica]
         state.dead = True
         state.excluded_until = now + self.exclusion_s
+        # The radix cache died with the process: a respawn starts cold, so
+        # stale affinity would steer same-prefix traffic at a replica that
+        # can no longer hit.
+        state.prefix_sigs.clear()
         orphaned = []
         for t in self._requests.values():
             if t.done:
@@ -157,7 +166,7 @@ class Router:
             if not t.done and (t.primary == replica or t.hedge == replica)
         ]
 
-    def score(self, replica: int) -> float:
+    def score(self, replica: int, *, prefix_sig: Optional[int] = None) -> float:
         """Load score — lower is better. Outstanding dispatches are the
         router's own ledger (fresh); queue depth / active slots / TTFT come
         from the replica's last snapshot (one heartbeat stale).
@@ -170,8 +179,18 @@ class Router:
         work no longer delays a NEW request's TTFT (prefill slots are
         free) but still competes for the decode slots it will eventually
         need.
+
+        Prefix-affinity term: when ``prefix_sig`` (the request's leading-
+        block signature, ``prefix_cache.prefix_signature``) matches one
+        this replica recently served, the score drops by a half-request
+        bonus — a probable radix-cache hit saves the prefill this term
+        trades against. Affinity deliberately stays weaker than one whole
+        outstanding request so it steers ties and near-ties without
+        overriding real load imbalance (a hot shared prefix must not
+        funnel the entire fleet's traffic onto one replica).
         """
-        snap = self._replicas[replica].snapshot
+        state = self._replicas[replica]
+        snap = state.snapshot
         score = (
             len(self.outstanding_on(replica))
             + float(snap.get("queue_depth", 0))
@@ -180,6 +199,8 @@ class Router:
         )
         if self.role(replica) == "disagg":
             score += 0.5 * float(snap.get("handoff_depth", 0))
+        if prefix_sig is not None and prefix_sig in state.prefix_sigs:
+            score -= 0.5
         return score
 
     def select(
@@ -188,11 +209,13 @@ class Router:
         *,
         exclude: tuple[int, ...] = (),
         role: Optional[str] = None,
+        prefix_sig: Optional[int] = None,
     ) -> Optional[int]:
         """The eligible replica with the lowest score (ties → lowest id),
         or None when the whole fleet is dead/draining/excluded. ``role``
         restricts selection to replicas of one topology role (a mixed
-        fleet can pin long-prompt traffic to disaggregated replicas)."""
+        fleet can pin long-prompt traffic to disaggregated replicas);
+        ``prefix_sig`` enables the prefix-affinity bonus in the scorer."""
         now = self._clock() if now is None else now
         candidates = [
             r
@@ -201,7 +224,9 @@ class Router:
         ]
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (self.score(r), r))
+        return min(
+            candidates, key=lambda r: (self.score(r, prefix_sig=prefix_sig), r)
+        )
 
     def dispatch(
         self,
@@ -210,17 +235,28 @@ class Router:
         now: Optional[float] = None,
         *,
         deadline: Optional[float] = None,
+        prefix_sig: Optional[int] = None,
     ) -> None:
         """Record that ``rid`` was sent to ``replica`` (primary copy). A
         re-dispatch after :meth:`mark_dead` lands here again — the original
         dispatch record died with the replica — and MUST carry the original
-        deadline so hedging still sees the true remaining budget."""
+        deadline so hedging still sees the true remaining budget.
+        ``prefix_sig`` (when the request has one) is remembered against the
+        replica so later same-prefix requests score it with the affinity
+        bonus; the history is bounded — oldest signature evicted past 128.
+        """
+        t = self._clock() if now is None else now
         self._requests[rid] = _Tracked(
             rid=rid,
             primary=replica,
-            dispatched_at=self._clock() if now is None else now,
+            dispatched_at=t,
             deadline=deadline,
         )
+        if prefix_sig is not None:
+            sigs = self._replicas[replica].prefix_sigs
+            sigs[prefix_sig] = t
+            if len(sigs) > 128:
+                del sigs[min(sigs, key=sigs.get)]
 
     # -- hedging -------------------------------------------------------------
     def maybe_hedge(

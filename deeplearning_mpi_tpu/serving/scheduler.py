@@ -23,7 +23,13 @@ them is exercised by ``tests/test_serving.py`` under a fake clock):
   serving a reply the client stopped waiting for is pure waste.
 - **FCFS admission**: queued requests enter free slots in arrival order,
   each taking its prompt's KV blocks up front (all-or-nothing, so a
-  half-admitted request can't deadlock the pool).
+  half-admitted request can't deadlock the pool). With a prefix cache
+  attached, a matched prompt prefix adopts cached blocks instead of
+  allocating + re-prefilling them (``serving/prefix_cache.py``).
+- **Per-tenant budgets and priorities** (``tenants=``): a tenant whose
+  committed tokens (prompt + max_new over queued + running) would exceed
+  its budget is shed at submit with reason ``tenant_budget``; non-zero
+  priorities reorder admission (higher first, arrival ties FCFS).
 - **Oldest-first eviction on OOM pressure**: when a decoding sequence
   needs one more KV block and the pool is empty, the OLDEST running
   request is shed and its blocks reclaimed. Oldest-first is the
@@ -76,11 +82,15 @@ class Request:
     max_new_tokens: int
     arrival: float = 0.0
     deadline: Optional[float] = None  # absolute time; None = no deadline
+    #: multi-tenant accounting/priority key; budgets and priorities are
+    #: configured per tenant on the Scheduler, not per request
+    tenant: str = "default"
 
     state: RequestState = RequestState.QUEUED
     #: why a SHED request was shed: "queue_full" | "too_long" | "deadline"
     #: | "evicted" | "spec_overflow" (KV pool could not cover the request's
     #: own next position while assembling a speculative verify batch)
+    #: | "tenant_budget" (the tenant's committed-token budget is spent)
     shed_reason: Optional[str] = None
     slot: Optional[int] = None
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -134,6 +144,8 @@ class Scheduler:
         registry: Any = None,
         decode_buckets: tuple[int, ...] = (),
         max_hold_steps: int = 4,
+        prefix_cache: Any = None,
+        tenants: dict[str, dict[str, Any]] | None = None,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -151,6 +163,19 @@ class Scheduler:
         self.slots: list[Optional[Request]] = [None] * max_slots
         self.shed_count = 0
         self.evicted_count = 0
+        #: optional RadixPrefixCache (serving/prefix_cache.py) consulted at
+        #: admission; shared with the engine, and in the disaggregated
+        #: topology with the sibling role's scheduler.
+        self.prefix_cache = prefix_cache
+        #: per-tenant config: name -> {"budget_tokens": int (0 = unlimited),
+        #: "priority": float (higher admits first)}. Unknown tenants get
+        #: unlimited budget at priority 0.
+        self.tenants: dict[str, dict[str, Any]] = dict(tenants or {})
+        #: pending copy-on-write jobs from matched-prefix admissions:
+        #: (src_block, dst_block, request). The engine drains this each
+        #: step (``_phase_cow``) BEFORE prefilling; src carries an extra
+        #: pool reference (pin) until the copy lands or the request dies.
+        self.pending_cow: list[tuple[int, int, Request]] = []
         if registry is not None:
             # Pre-create so a shed-free run still reports an explicit 0.
             registry.counter("serve_shed_total")
@@ -165,9 +190,31 @@ class Scheduler:
         if len(self.queue) >= self.max_queue:
             self._shed(req, "queue_full")
             return False
+        budget = int(self.tenants.get(req.tenant, {}).get("budget_tokens", 0))
+        if budget > 0:
+            committed = self.tenant_tokens_in_flight().get(req.tenant, 0)
+            if committed + total > budget:
+                self._shed(req, "tenant_budget")
+                return False
         req.state = RequestState.QUEUED
         self.queue.append(req)
         return True
+
+    # -- multi-tenancy ------------------------------------------------------
+    def tenant_tokens_in_flight(self) -> dict[str, int]:
+        """Committed tokens (``prompt + max_new``) per tenant over queued +
+        running requests — the quantity budgets are enforced against.
+        Committed (not consumed-so-far) makes the budget a worst-case HBM
+        and compute bound a tenant cannot exceed by racing submissions."""
+        out: dict[str, int] = {}
+        for req in list(self.queue) + self.running():
+            out[req.tenant] = (
+                out.get(req.tenant, 0) + req.prompt_len + req.max_new_tokens
+            )
+        return out
+
+    def _tenant_priority(self, req: Request) -> float:
+        return float(self.tenants.get(req.tenant, {}).get("priority", 0.0))
 
     # -- per-step phases ----------------------------------------------------
     def shed_expired(self, now: float) -> list[Request]:
@@ -184,26 +231,74 @@ class Scheduler:
         return shed
 
     def admit(self, now: float) -> list[Request]:
-        """Move queued requests into free slots, oldest first, each taking
-        its prompt's KV blocks up front. Stops at the first request the
-        pool can't serve (FCFS — skipping ahead would starve long
-        prompts)."""
+        """Move queued requests into free slots, each taking its prompt's
+        KV blocks up front. Order is arrival (FCFS) unless tenant
+        priorities are configured, in which case higher-priority tenants
+        admit first (ties broken by arrival, then rid — deterministic).
+        Stops at the first request the pool can't serve (skipping ahead
+        would starve long prompts). With a prefix cache attached, a
+        matched prompt prefix adopts the cached blocks (shared,
+        refcounted) and only the private tail is allocated — the request
+        enters PREFILL with ``prefilled`` already at the match point."""
         admitted = []
-        while self.queue and None in self.slots:
-            req = self.queue[0]
-            blocks = self.pool.alloc(self.pool.blocks_for(req.prompt_len))
-            if blocks is None:
+        if any(
+            float(cfg.get("priority", 0.0)) != 0.0
+            for cfg in self.tenants.values()
+        ):
+            order = sorted(
+                self.queue,
+                key=lambda r: (-self._tenant_priority(r), r.arrival, r.rid),
+            )
+        else:
+            order = list(self.queue)
+        for req in order:
+            if None not in self.slots:
+                break
+            if not self._admit_one(req, now):
                 break  # KV pressure: stays queued, retried next step
-            self.queue.popleft()
-            slot = self.slots.index(None)
-            req.slot = slot
-            req.blocks = blocks
-            req.state = RequestState.PREFILL
-            req.prefilled = 0
-            req.t_admitted = now
-            self.slots[slot] = req
+            self.queue.remove(req)
             admitted.append(req)
         return admitted
+
+    def _admit_one(self, req: Request, now: float) -> bool:
+        """Allocate (or adopt) blocks for ``req`` and seat it. Returns
+        False when the pool cannot cover the private tail even after
+        evicting unreferenced cache branches."""
+        n_total = self.pool.blocks_for(req.prompt_len)
+        fill, chain, partial = 0, [], None
+        if self.prefix_cache is not None:
+            fill, chain, partial = self.prefix_cache.match(req.prompt)
+        n_full = fill // self.pool.block_size
+        priv = self.pool.alloc(n_total - n_full)
+        if priv is None and self.prefix_cache is not None:
+            deficit = (n_total - n_full) - self.pool.available
+            if self.prefix_cache.evict(deficit) > 0:
+                # Eviction may have pruned the very branch we matched (the
+                # cache was its sole owner until the share below) — re-match
+                # rather than adopt freed blocks.
+                fill, chain, partial = self.prefix_cache.match(req.prompt)
+                n_full = fill // self.pool.block_size
+                priv = self.pool.alloc(n_total - n_full)
+        if priv is None:
+            return False
+        if n_full:
+            self.pool.share(chain)
+        if partial is not None:
+            # Pin the CoW source with an extra reference until the engine
+            # copies it into priv[0]; _release unpins if the request dies
+            # before the copy runs.
+            self.pool.share([partial[0]])
+            self.pending_cow.append((partial[0], priv[0], req))
+        if fill:
+            self.prefix_cache.note_hit(fill)
+        slot = self.slots.index(None)
+        req.slot = slot
+        req.blocks = chain + priv
+        req.state = RequestState.PREFILL
+        req.prefilled = fill
+        req.t_admitted = now
+        self.slots[slot] = req
+        return True
 
     def grow(self, req: Request, *, shed_reason: str = "evicted") -> bool:
         """Give ``req`` one more KV block, evicting under OOM pressure.
@@ -221,6 +316,8 @@ class Scheduler:
             if blocks is not None:
                 req.blocks.extend(blocks)
                 return True
+            if self.prefix_cache is not None and self.prefix_cache.evict(1):
+                continue  # an unreferenced cache branch paid for the block
             victim = self._oldest_running()
             if victim is None or victim is req:
                 # Nothing older to evict: shed the requester. (victim is
@@ -344,8 +441,33 @@ class Scheduler:
         running = self.running()
         return min(running, key=lambda r: r.arrival) if running else None
 
+    def take_pending_cow(self) -> list[tuple[int, int, Request]]:
+        """Drain the CoW job list (engine ``_phase_cow``)."""
+        jobs, self.pending_cow = self.pending_cow, []
+        return jobs
+
+    def clear_pending_cow(self) -> None:
+        """Drop pending CoW jobs WITHOUT unpinning (crash recovery only:
+        ``pool.reconcile`` is about to rebuild every refcount from ground
+        truth, so freeing the pins here would double-count)."""
+        self.pending_cow = []
+
     def _release(self, req: Request) -> None:
+        if self.pending_cow:
+            # A request dying between admission and its CoW copy must unpin
+            # the copy source, or the pin would strand the cached block.
+            keep = []
+            for src, dst, owner in self.pending_cow:
+                if owner is req:
+                    self.pool.free([src])
+                else:
+                    keep.append((src, dst, owner))
+            self.pending_cow = keep
         if req.blocks:
+            # pool.free is refcount-aware: shared prefix blocks just
+            # decrement (the cache / other sharers keep them); private
+            # blocks recycle. Evicting one sharer can never release
+            # another tenant's live prefix pages.
             self.pool.free(req.blocks)
             # Keep the ids for post-mortem (which blocks did this request
             # hold?) — the reuse-proving test reads them — but hand
@@ -382,3 +504,7 @@ class Scheduler:
 
             self.registry.counter("serve_shed_total").inc()
             self.registry.counter(labeled("serve_shed_total", reason=reason)).inc()
+            if reason == "tenant_budget":
+                self.registry.counter(
+                    labeled("serve_tenant_shed_total", tenant=req.tenant)
+                ).inc()

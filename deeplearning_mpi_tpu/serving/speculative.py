@@ -78,6 +78,7 @@ class SpeculativeDecoder:
         donate: tuple[int, ...] = (),
         kv_dtype: Any = None,
         kv_buffers: Any = None,
+        prefix_cache: bool = False,
     ) -> None:
         if config.vocab_size != target_config.vocab_size:
             raise ValueError(
@@ -126,6 +127,18 @@ class SpeculativeDecoder:
         )
         self._decode_fn: Callable[..., Any] = self._decode_jit
         self._prefill_fn: Callable[..., Any] = self._prefill_jit
+        # Prefix-cache CoW mirror: an adopted prefix exists in the draft's
+        # pools too (written by the original prefill through the shared
+        # tables), so the engine mirrors every target-pool block copy here
+        # — cache hits then keep the draft's prefix KV valid and the
+        # acceptance rate intact.
+        self._copy_jit = None
+        self._copy_fn: Callable[..., Any] | None = None
+        if prefix_cache:
+            self._copy_jit = jax.jit(
+                self._fwd.copy_block, donate_argnums=(0,) if donate else ()
+            )
+            self._copy_fn = self._copy_jit
 
     @property
     def _kv(self) -> tuple[Any, ...]:
@@ -153,6 +166,11 @@ class SpeculativeDecoder:
             jnp.zeros((e.prefill_chunk,), jnp.int32),
             jnp.int32(0), jnp.int32(1),
         )
+        if self._copy_jit is not None:
+            reg.register(
+                "serve_draft_copy_block", self._copy_jit,
+                self._kv, jnp.int32(0), jnp.int32(0),
+            )
 
     def adopt_warmup(self, programs: dict[str, Any]) -> None:
         from deeplearning_mpi_tpu.compiler import aot
@@ -163,6 +181,10 @@ class SpeculativeDecoder:
         self._prefill_fn = aot.WarmProgram(
             programs["serve_draft_prefill_chunk"], self._prefill_jit
         )
+        if self._copy_jit is not None:
+            self._copy_fn = aot.WarmProgram(
+                programs["serve_draft_copy_block"], self._copy_jit
+            )
 
     def pretrace_width(
         self, tables: Any, idle: Any, off: Any
@@ -175,6 +197,12 @@ class SpeculativeDecoder:
         )
 
     # -- engine hooks --------------------------------------------------------
+    def copy_block(self, src: int, dst: int) -> None:
+        """Mirror the target pools' CoW copy in the draft pools (engine
+        ``_phase_cow``; same physical block ids — the tables are shared)."""
+        assert self._copy_fn is not None, "draft built without prefix_cache"
+        self._kv = self._copy_fn(self._kv, jnp.int32(src), jnp.int32(dst))
+
     def prefill_chunk(
         self,
         table: np.ndarray,

@@ -21,7 +21,7 @@ __all__ = ["LABEL_KEYS", "METRICS", "is_canonical"]
 
 #: Every label key any ``labeled(...)`` call may use.
 LABEL_KEYS: frozenset[str] = frozenset(
-    {"dtype", "kind", "outcome", "reason", "replica", "role"}
+    {"dtype", "kind", "outcome", "reason", "replica", "role", "tenant"}
 )
 
 #: name -> (kind, {allowed label keys}). Kind is one of
@@ -47,6 +47,15 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "serve_kv_blocks_in_use": ("gauge", frozenset({"role"})),
     "serve_kv_bytes": ("gauge", frozenset({"dtype", "role"})),
     "serve_prefill_chunks": ("counter", frozenset()),
+    # -- radix prefix cache + multi-tenancy (PR 11) --------------------------
+    "serve_prefix_blocks": ("gauge", frozenset()),
+    "serve_prefix_cow_copies_total": ("counter", frozenset()),
+    "serve_prefix_evictions_total": ("counter", frozenset()),
+    "serve_prefix_hits_total": ("counter", frozenset()),
+    "serve_prefix_nodes": ("gauge", frozenset()),
+    "serve_prefix_tokens_reused_total": ("counter", frozenset()),
+    "serve_tenant_shed_total": ("counter", frozenset({"tenant"})),
+    "serve_tenant_tokens_in_flight": ("gauge", frozenset({"tenant"})),
     "serve_queue_depth": ("gauge", frozenset({"role"})),
     "serve_requests_admitted": ("counter", frozenset()),
     "serve_requests_completed": ("counter", frozenset()),
@@ -86,7 +95,9 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "pod_world_size": ("gauge", frozenset()),
     # -- runtime sanitizer (analysis/sanitizer.py) --------------------------
     "sanitize_donation_canary_trips_total": ("counter", frozenset()),
+    "sanitize_kv_cow_violation_total": ("counter", frozenset()),
     "sanitize_kv_double_free_total": ("counter", frozenset()),
+    "sanitize_kv_refcount_underflow_total": ("counter", frozenset()),
     "sanitize_kv_use_after_free_total": ("counter", frozenset()),
     "sanitize_retrace_trips_total": ("counter", frozenset()),
 }

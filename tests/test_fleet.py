@@ -114,6 +114,49 @@ class TestRouterExclusion:
         assert (verdict, loser) == ("win", None)
 
 
+class TestPrefixAffinity:
+    def test_affinity_steers_an_otherwise_tied_selection(self):
+        """After replica 1 served a request with this leading-block
+        signature, a later same-signature request breaks the idle tie
+        toward it (instead of the lowest-id default) — and an unrelated
+        signature still falls back to the default."""
+        router, clock = _router()
+        router.dispatch(0, 1, clock(), prefix_sig=42)
+        assert router.on_complete(0, 1, clock())[0] == "win"
+        assert router.select(clock()) == 0  # no signature: lowest id
+        assert router.select(clock(), prefix_sig=42) == 1
+        assert router.select(clock(), prefix_sig=7) == 0  # unknown sig
+
+    def test_affinity_is_weaker_than_real_load(self):
+        """The bonus is half a request: a probable cache hit must steer
+        ties, not funnel a hot shared prefix's whole traffic onto one
+        busy replica."""
+        router, clock = _router()
+        router.dispatch(0, 1, clock(), prefix_sig=42)  # still outstanding
+        assert router.select(clock(), prefix_sig=42) == 0
+
+    def test_mark_dead_clears_affinity(self):
+        """The radix cache died with the process — a respawn starts cold,
+        so its old signatures must not attract same-prefix traffic."""
+        router, clock = _router(exclusion_s=0.5)
+        router.dispatch(0, 1, clock(), prefix_sig=42)
+        assert router.on_complete(0, 1, clock())[0] == "win"
+        router.mark_dead(1, clock())
+        router.mark_alive(1, clock())
+        clock.advance(1.0)
+        assert router.eligible(clock()) == [0, 1]
+        assert router.select(clock(), prefix_sig=42) == 0
+
+    def test_signature_history_is_bounded(self):
+        router, clock = _router(n=1)
+        for i in range(200):
+            router.dispatch(i, 0, clock(), prefix_sig=i)
+            clock.advance(0.01)
+        sigs = router._replicas[0].prefix_sigs
+        assert len(sigs) == 128
+        assert 199 in sigs and 0 not in sigs  # oldest evicted first
+
+
 class TestHedging:
     def test_fires_only_past_threshold(self):
         registry = MetricsRegistry()

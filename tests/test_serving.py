@@ -37,6 +37,7 @@ from deeplearning_mpi_tpu.serving import (
     DisaggregatedEngine,
     EngineConfig,
     PagedKVPool,
+    RadixPrefixCache,
     Request,
     RequestState,
     Scheduler,
@@ -998,3 +999,424 @@ class TestDisaggregatedServing:
         assert engine.pool.in_use == 0
         engine.pool.check()
         assert not engine.cancel(req)  # already shed
+
+
+# -- radix prefix cache -------------------------------------------------------
+
+class TestPoolRefcounts:
+    """The sharing layer under the prefix cache: refcounted free, frozen
+    shared blocks (CoW), and multiplicity-aware crash reconciliation."""
+
+    def test_share_requires_allocated_block(self):
+        pool = PagedKVPool(8, 4)
+        with pytest.raises(ValueError):
+            pool.share([3])  # never allocated: sharing is never an alloc
+
+    def test_shared_block_survives_first_free(self):
+        pool = PagedKVPool(8, 4)
+        (b,) = pool.alloc(1)
+        pool.share([b])
+        assert pool.refcount(b) == 2
+        pool.free([b])  # one sharer drops out ...
+        assert pool.refcount(b) == 1
+        assert pool.in_use == 1  # ... pages still live for the other
+        pool.free([b])  # last owner recycles
+        assert pool.refcount(b) == 0
+        assert pool.available == pool.capacity
+        pool.check()
+
+    def test_refcount_underflow_raises(self):
+        pool = PagedKVPool(8, 4)
+        torn = pool.alloc(1)
+        pool._refcount[torn[0]] = 0  # corrupted books (double-freed sharer)
+        with pytest.raises(ValueError, match="underflow"):
+            pool.free(torn)
+
+    def test_write_to_shared_block_requires_cow(self):
+        pool = PagedKVPool(8, 4)
+        shared = pool.alloc(1)
+        pool.share(shared)
+        with pytest.raises(ValueError, match="copy-on-write"):
+            pool.record_fill(shared)
+        pool.free(shared)  # back to sole ownership:
+        pool.record_fill(shared)  # writes legal again
+        pool.free(shared)
+        pool.check()
+
+    def test_reconcile_multiplicity_rebuilds_refcounts(self):
+        """Recovery reports one entry per live REFERENCE (cache + each
+        adopter), so a shared block must rebuild with every owner counted
+        — and then drain with exactly that many frees."""
+        pool = PagedKVPool(8, 4)
+        a, b, leaked = pool.alloc(3)
+        stats = pool.reconcile([a, a, b])
+        assert stats == {"reclaimed": 1, "adopted": 0}
+        assert pool.refcount(a) == 2
+        assert pool.refcount(b) == 1
+        assert pool.refcount(leaked) == 0  # reclaimed to the free list
+        pool.check()
+        pool.free([a, b])
+        assert pool.in_use == 1  # a still held by its second owner
+        pool.free([a])
+        assert pool.in_use == 0
+        pool.check()
+
+
+class TestRadixPrefixCacheTrie:
+    """Trie mechanics against a bare pool (no model): block-granularity
+    matching, partial (CoW) adoption, upgrade/superspan tails, LRU
+    eviction, flush."""
+
+    BS = 4
+
+    def _cache(self, num_blocks=32):
+        pool = PagedKVPool(num_blocks, self.BS)
+        return RadixPrefixCache(pool), pool
+
+    def _complete(self, cache, pool, prompt, frozen):
+        """Simulate a finished request: alloc its blocks, index the frozen
+        span, then drop the request's own references (the cache keeps its
+        shares alive)."""
+        blocks = pool.alloc(pool.blocks_for(len(prompt)))
+        cache.insert(prompt, blocks, frozen)
+        pool.free(blocks)
+        return blocks
+
+    def test_miss_on_empty_cache(self):
+        cache, _ = self._cache()
+        assert cache.match(list(range(1, 10))) == (0, [], None)
+
+    def test_full_block_adoption(self):
+        cache, pool = self._cache()
+        prompt = list(range(10, 23))  # 13 tokens: 3 full blocks + 1 row
+        blocks = self._complete(cache, pool, prompt, frozen=12)
+        fill, chain, partial = cache.match(prompt)
+        assert (fill, chain, partial) == (12, blocks[:3], None)
+        # The cache holds exactly one reference per indexed block.
+        assert sorted(cache.referenced_blocks()) == sorted(blocks[:3])
+        assert pool.in_use == 3  # the unfrozen 4th block was recycled
+
+    def test_fill_caps_before_last_position(self):
+        """An exact-prompt rematch must leave the final position
+        unprefilled (the engine needs its logits for the first token) —
+        the last matched block degrades to a partial CoW adoption."""
+        cache, pool = self._cache()
+        prompt = list(range(1, 13))  # 12 tokens, block-aligned
+        blocks = self._complete(cache, pool, prompt, frozen=12)
+        fill, chain, partial = cache.match(prompt)
+        assert fill == 11 and chain == blocks[:2]
+        assert partial == (blocks[2], 3)  # rows 8..10 of the third block
+
+    def test_divergent_tail_partial_adoption(self):
+        cache, pool = self._cache()
+        a = [1, 2, 3, 4, 5, 6, 7, 8]
+        blocks = self._complete(cache, pool, a, frozen=8)
+        b = [1, 2, 3, 4, 5, 6, 99, 98, 97, 96]  # shares 6 of 8
+        fill, chain, partial = cache.match(b)
+        assert fill == 6 and chain == blocks[:1]
+        assert partial == (blocks[1], 2)  # copy, keep 2 rows, re-prefill rest
+
+    def test_partial_upgrade_swaps_to_longer_tail(self):
+        cache, pool = self._cache()
+        base = [1, 2, 3, 4, 5, 6]
+        self._complete(cache, pool, base, frozen=6)  # partial tail: 2 rows
+        ext = [1, 2, 3, 4, 5, 6, 7, 8]
+        blocks2 = self._complete(cache, pool, ext, frozen=7)  # 3-row tail
+        fill, _, partial = cache.match(ext)
+        assert fill == 7  # the longer frozen tail won the node
+        assert partial == (blocks2[1], 3)
+        # The shorter tail's block lost its cache reference and recycled.
+        assert pool.in_use == len(cache.referenced_blocks()) == 2
+        pool.check()
+
+    def test_superspan_incumbent_is_kept(self):
+        cache, pool = self._cache()
+        ext = [1, 2, 3, 4, 5, 6, 7, 8]
+        blocks1 = self._complete(cache, pool, ext, frozen=7)
+        nodes_before = cache.num_nodes
+        self._complete(cache, pool, [1, 2, 3, 4, 5, 6], frozen=6)
+        assert cache.num_nodes == nodes_before  # subspan shares nothing
+        fill, _, partial = cache.match(ext)
+        assert fill == 7 and partial == (blocks1[1], 3)
+
+    def test_evict_lru_sole_owner_only(self):
+        cache, pool = self._cache()
+        a = self._complete(cache, pool, [1, 2, 3, 4, 9], frozen=4)
+        b = self._complete(cache, pool, [5, 6, 7, 8, 9], frozen=4)
+        pool.share(a[:1])  # a live adopter pins A's block
+        assert cache.evict(2) == 1  # only B (sole-owned) can be pruned
+        assert cache.referenced_blocks() == a[:1]
+        assert cache.match([5, 6, 7, 8, 9]) == (0, [], None)
+        pool.free(a[:1])  # adopter finishes: A becomes evictable
+        assert cache.evict(1) == 1
+        assert pool.in_use == 0
+        pool.check()
+
+    def test_evict_prefers_least_recently_matched(self):
+        cache, pool = self._cache()
+        a = self._complete(cache, pool, [1, 2, 3, 4, 9], frozen=4)
+        b = self._complete(cache, pool, [5, 6, 7, 8, 9], frozen=4)
+        cache.match([1, 2, 3, 4, 9])  # touch A: B is now the LRU leaf
+        assert cache.evict(1) == 1
+        assert cache.referenced_blocks() == a[:1]
+        assert b[0] not in cache.referenced_blocks()
+
+    def test_flush_drops_everything(self):
+        cache, pool = self._cache()
+        self._complete(cache, pool, list(range(1, 14)), frozen=12)
+        assert cache.flush() == 3
+        assert pool.in_use == 0
+        assert cache.num_nodes == 0
+        assert cache.match(list(range(1, 14))) == (0, [], None)
+        pool.check()
+
+
+class TestTenantAdmission:
+    def _sched(self, *, tenants, max_slots=2, num_blocks=33):
+        pool = PagedKVPool(num_blocks, 4)
+        registry = MetricsRegistry()
+        sched = Scheduler(
+            pool, max_slots=max_slots, max_seq_len=64, registry=registry,
+            tenants=tenants,
+        )
+        return sched, registry
+
+    def test_budget_sheds_over_committed_submit(self):
+        """Budgets bound COMMITTED tokens (prompt + max_new over queued +
+        running), so a tenant cannot exceed its worst-case footprint by
+        racing submissions — and the budget frees as its requests leave."""
+        sched, registry = self._sched(
+            tenants={"burst": {"budget_tokens": 20}}
+        )
+        first = _req(0, 10, max_new=4)
+        first.tenant = "burst"
+        assert sched.submit(first)  # 14 committed <= 20
+        second = _req(1, 10, max_new=4)
+        second.tenant = "burst"
+        assert not sched.submit(second)  # 28 > 20
+        assert second.state is RequestState.SHED
+        assert second.shed_reason == "tenant_budget"
+        snap = registry.snapshot()
+        assert snap['serve_shed_total{reason="tenant_budget"}'] == 1
+        assert snap['serve_tenant_shed_total{tenant="burst"}'] == 1
+        assert sched.tenant_tokens_in_flight() == {"burst": 14}
+        # The shed request never entered the books; draining the first
+        # frees the whole budget.
+        sched.admit(0.0)
+        sched.evict(first, reason="test_drain")
+        assert sched.tenant_tokens_in_flight() == {}
+        third = _req(2, 10, max_new=4)
+        third.tenant = "burst"
+        assert sched.submit(third)
+
+    def test_unknown_and_zero_budget_tenants_are_unlimited(self):
+        sched, _ = self._sched(
+            tenants={"capped": {"budget_tokens": 10},
+                     "free": {"budget_tokens": 0}}
+        )
+        for rid, tenant in enumerate(["free", "free", "nobody", "nobody"]):
+            req = _req(rid, 10, max_new=4)
+            req.tenant = tenant
+            assert sched.submit(req), tenant
+
+    def test_priority_orders_admission(self):
+        """With a priority configured, the high-priority tenant admits
+        first even when it arrived last; ties fall back to arrival."""
+        sched, _ = self._sched(
+            tenants={"vip": {"priority": 1.0}}, max_slots=1
+        )
+        late_default = _req(0, 8, arrival=0.0)
+        vip = _req(1, 8, arrival=5.0)
+        vip.tenant = "vip"
+        assert sched.submit(late_default) and sched.submit(vip)
+        admitted = sched.admit(now=6.0)
+        assert [r.rid for r in admitted] == [1]  # vip took the only slot
+
+    def test_no_priorities_preserves_fcfs(self):
+        sched, _ = self._sched(
+            tenants={"a": {"budget_tokens": 100}}, max_slots=2
+        )
+        r0, r1 = _req(0, 8, arrival=0.0), _req(1, 8, arrival=1.0)
+        r1.tenant = "a"
+        assert sched.submit(r0) and sched.submit(r1)
+        assert [r.rid for r in sched.admit(now=2.0)] == [0, 1]
+
+
+SHARED_PREAMBLE_LEN = 18  # 4 full blocks + 2 rows: adoption always CoWs
+
+
+@pytest.fixture(scope="module")
+def prefix_parity_run(tiny_lm):
+    """Six prod requests sharing an 18-token preamble (plus distinct
+    5-token tails) through an engine with the radix cache on, plus a
+    two-submit burst tenant whose second submit must shed on budget."""
+    cfg, model, params = tiny_lm
+    rng = np.random.default_rng(21)
+    preamble = rng.integers(1, 255, size=SHARED_PREAMBLE_LEN).astype(np.int32)
+    prompts = [
+        np.concatenate([preamble, rng.integers(1, 255, size=5).astype(np.int32)])
+        for _ in range(8)
+    ]
+    offline = [_offline_greedy(model, params, p, MAX_NEW) for p in prompts]
+
+    registry = MetricsRegistry()
+    engine = ServingEngine(
+        cfg, params,
+        dataclasses.replace(ENGINE_CFG, prefix_cache=True),
+        dtype=jnp.float32, registry=registry,
+        tenants={
+            "prod": {"budget_tokens": 0, "priority": 1.0},
+            # One burst request commits 23 + 4 = 27 tokens: budget 30
+            # holds exactly one in flight.
+            "burst": {"budget_tokens": 30, "priority": 0.0},
+        },
+    )
+    reqs = [engine.submit(p, MAX_NEW, tenant="prod") for p in prompts[:6]]
+    reqs.append(engine.submit(prompts[6], MAX_NEW, tenant="burst"))
+    shed = engine.submit(prompts[7], MAX_NEW, tenant="burst")
+    engine.run_until_idle()
+    return {
+        "engine": engine, "reqs": reqs, "shed": shed,
+        "offline": offline, "snapshot": registry.snapshot(),
+    }
+
+
+class TestPrefixCacheServing:
+    def test_streams_bit_identical_to_cold_oracle(self, prefix_parity_run):
+        """The tentpole's correctness bar: adopted blocks, CoW copies, and
+        skipped prefill must be invisible in the tokens — every stream
+        matches the offline greedy decode of a COLD model."""
+        for req, expect in zip(
+            prefix_parity_run["reqs"], prefix_parity_run["offline"]
+        ):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == expect, (
+                f"rid={req.rid}: cached {req.generated} != cold {expect}"
+            )
+
+    def test_cache_actually_worked(self, prefix_parity_run):
+        snap = prefix_parity_run["snapshot"]
+        assert snap["serve_prefix_hits_total"] > 0
+        assert snap["serve_prefix_tokens_reused_total"] > 0
+        # 18 % block_size != 0: every adoption crosses a CoW boundary.
+        assert snap["serve_prefix_cow_copies_total"] > 0
+        assert snap["serve_prefix_blocks"] > 0  # gauge: retained at drain
+
+    def test_burst_tenant_shed_on_budget(self, prefix_parity_run):
+        shed = prefix_parity_run["shed"]
+        assert shed.state is RequestState.SHED
+        assert shed.shed_reason == "tenant_budget"
+        snap = prefix_parity_run["snapshot"]
+        assert snap['serve_tenant_shed_total{tenant="burst"}'] == 1
+
+    def test_refcount_books_balance_at_drain(self, prefix_parity_run):
+        """LAST in this class (mutates the fixture): with every request
+        gone, the pool's only references are the cache's; flush reconciles
+        the books to exactly zero."""
+        engine = prefix_parity_run["engine"]
+        cache = engine.prefix_cache
+        assert engine.pool.in_use == len(cache.referenced_blocks()) > 0
+        cache.flush()
+        assert engine.pool.in_use == 0
+        assert engine.pool.total_allocated == engine.pool.total_freed > 0
+        engine.pool.check()
+
+    def test_cow_storm_with_eviction_parity(self, tiny_lm):
+        """A pool far too small to retain the working set: admissions
+        force LRU eviction of cached branches mid-run (and re-match after
+        pruning). Token parity and the refcount books must survive the
+        churn."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(5)
+        preambles = [
+            rng.integers(1, 255, size=10).astype(np.int32) for _ in range(3)
+        ]
+        prompts = [
+            np.concatenate(
+                [preambles[i % 3], rng.integers(1, 255, size=4).astype(np.int32)]
+            )
+            for i in range(9)
+        ]
+        registry = MetricsRegistry()
+        engine = ServingEngine(
+            cfg, params,
+            dataclasses.replace(
+                ENGINE_CFG, num_blocks=13, max_slots=2, prefix_cache=True
+            ),
+            dtype=jnp.float32, registry=registry,
+        )
+        reqs = [engine.submit(p, MAX_NEW) for p in prompts]
+        engine.run_until_idle()
+        snap = registry.snapshot()
+        assert snap["serve_prefix_evictions_total"] > 0
+        for req, prompt in zip(reqs, prompts):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == _offline_greedy(
+                model, params, prompt, MAX_NEW
+            )
+        cache = engine.prefix_cache
+        assert engine.pool.in_use == len(cache.referenced_blocks())
+        cache.flush()
+        assert engine.pool.in_use == 0
+        engine.pool.check()
+
+
+class TestPrefixCacheDisagg:
+    def test_shared_prefix_crosses_handoff(self, tiny_lm):
+        """Both roles consult ONE cache over the shared pool: a request
+        admitted with adopted blocks prefills on the prefill engine, hands
+        off, and decodes — bit-identical, with hits and handoffs > 0."""
+        cfg, model, params = tiny_lm
+        rng = np.random.default_rng(13)
+        preamble = rng.integers(1, 255, size=SHARED_PREAMBLE_LEN).astype(
+            np.int32
+        )
+        prompts = [
+            np.concatenate(
+                [preamble, rng.integers(1, 255, size=4).astype(np.int32)]
+            )
+            for _ in range(4)
+        ]
+        registry = MetricsRegistry()
+        engine = DisaggregatedEngine(
+            cfg, params,
+            dataclasses.replace(ENGINE_CFG, prefix_cache=True),
+            dtype=jnp.float32, registry=registry,
+        )
+        assert (
+            engine.prefill.scheduler.prefix_cache
+            is engine.decode.scheduler.prefix_cache
+            is engine.prefix_cache
+        )
+        reqs = [engine.submit(p, MAX_NEW) for p in prompts]
+        engine.run_until_idle()
+        snap = registry.snapshot()
+        assert snap["serve_prefix_hits_total"] > 0
+        assert snap["serve_handoffs_total"] > 0
+        for req, prompt in zip(reqs, prompts):
+            assert req.state is RequestState.FINISHED
+            assert req.generated == _offline_greedy(
+                model, params, prompt, MAX_NEW
+            )
+        assert engine.pool.in_use == len(engine.prefix_cache.referenced_blocks())
+        engine.prefix_cache.flush()
+        assert engine.pool.in_use == 0
+        engine.pool.check()
+
+    def test_weight_swap_flushes_cache(self, tiny_lm):
+        """Cached KV computed under old params is bit-wrong under new ones
+        — the params setter must flush before the next admission."""
+        cfg, _, params = tiny_lm
+        engine = DisaggregatedEngine(
+            cfg, params,
+            dataclasses.replace(ENGINE_CFG, prefix_cache=True),
+            dtype=jnp.float32,
+        )
+        req = engine.submit(np.arange(1, 20, dtype=np.int32), MAX_NEW)
+        engine.run_until_idle()
+        assert req.state is RequestState.FINISHED
+        assert engine.prefix_cache.num_blocks_cached > 0
+        engine.params = params  # swap (same values: flush is what matters)
+        assert engine.prefix_cache.num_blocks_cached == 0
+        assert engine.pool.in_use == 0
+        engine.pool.check()

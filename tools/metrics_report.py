@@ -181,9 +181,43 @@ def _serving_table(last: dict) -> str:
     return table("Serving", rows)
 
 
+def _prefix_table(last: dict) -> str:
+    """The radix prefix cache's books (``serving/prefix_cache.py``) plus
+    per-tenant admission accounting: hit rate over admissions, prefill
+    tokens saved, CoW copies, LRU evictions, end-of-run trie footprint,
+    and any ``{tenant="..."}`` shed/in-flight series present."""
+    hits = last.get("serve_prefix_hits_total")
+    if hits is None:
+        return ""
+    rows = [("prefix hits", _fmt(hits))]
+    admitted = last.get("serve_requests_admitted")
+    if admitted:
+        rows.append(("hit rate (of admissions)", f"{hits / admitted:.1%}"))
+    rows += [("prefill tokens reused",
+              _fmt(last.get("serve_prefix_tokens_reused_total"))),
+             ("copy-on-write copies",
+              _fmt(last.get("serve_prefix_cow_copies_total"))),
+             ("LRU evictions", _fmt(last.get("serve_prefix_evictions_total"))),
+             ("cached nodes (end of run)", _fmt(last.get("serve_prefix_nodes"))),
+             ("cached blocks (end of run)",
+              _fmt(last.get("serve_prefix_blocks")))]
+    for key in sorted(last):
+        if key.startswith("serve_tenant_shed_total{tenant="):
+            tenant = key.split("=", 1)[1].strip('"}')
+            rows.append((f"tenant {tenant}: budget sheds", _fmt(last[key])))
+    for key in sorted(last):
+        if key.startswith("serve_tenant_tokens_in_flight{tenant="):
+            tenant = key.split("=", 1)[1].strip('"}')
+            rows.append((f"tenant {tenant}: tokens in flight (end)",
+                         _fmt(last[key])))
+    return table("Prefix cache", rows)
+
+
 _SANITIZE_LABELS = (
     ("sanitize_kv_double_free_total", "KV double-free trips"),
     ("sanitize_kv_use_after_free_total", "KV use-after-free trips"),
+    ("sanitize_kv_refcount_underflow_total", "KV refcount underflow trips"),
+    ("sanitize_kv_cow_violation_total", "KV CoW violation trips"),
     ("sanitize_retrace_trips_total", "retrace trips (post-warmup)"),
     ("sanitize_donation_canary_trips_total", "donation canary trips"),
 )
@@ -296,6 +330,9 @@ def summarize(records: list[dict]) -> str:
 
     if serving:
         out.append(_serving_table(serving[-1]))
+        prefix = _prefix_table(serving[-1])
+        if prefix:
+            out.append(prefix)
 
     if fleet:
         out.append(_fleet_table(fleet[-1]))
@@ -355,6 +392,17 @@ def _selftest() -> int:
             'serve_kv_blocks_in_use{role="prefill"}': 0,
             'serve_kv_blocks_in_use{role="decode"}': 0,
             'serve_kv_bytes{dtype="int8"}': 81920,
+            # Prefix-cache + tenancy books (serving/prefix_cache.py): the
+            # hit/reuse/CoW/eviction counters and per-tenant series must
+            # render their own table.
+            "serve_requests_admitted": 8,
+            "serve_prefix_hits_total": 5,
+            "serve_prefix_tokens_reused_total": 170,
+            "serve_prefix_cow_copies_total": 3,
+            "serve_prefix_evictions_total": 1,
+            "serve_prefix_nodes": 4, "serve_prefix_blocks": 4,
+            'serve_tenant_shed_total{tenant="burst"}': 2,
+            'serve_tenant_tokens_in_flight{tenant="burst"}': 0,
         })
         # A serving-fleet run's end-of-run record (serving/fleet.py run()):
         # the hedge/restart/swap columns must render alongside the
@@ -381,6 +429,8 @@ def _selftest() -> int:
         reg.emit("sanitize_summary", {
             "sanitize_kv_double_free_total": 1,
             "sanitize_kv_use_after_free_total": 1,
+            "sanitize_kv_refcount_underflow_total": 1,
+            "sanitize_kv_cow_violation_total": 1,
             "sanitize_retrace_trips_total": 1,
             "sanitize_donation_canary_trips_total": 0,
         })
@@ -393,7 +443,11 @@ def _selftest() -> int:
                        "failover recovery p50", "swap downtime",
                        "chaos books", "prefill: TTFT", "decode: TPOT",
                        "handoffs prefill", "KV pool bytes (int8)",
+                       "hit rate (of admissions)", "prefill tokens reused",
+                       "copy-on-write copies", "LRU evictions",
+                       "tenant burst: budget sheds",
                        "KV double-free trips", "retrace trips (post-warmup)",
+                       "KV refcount underflow trips", "KV CoW violation trips",
                        "donation canary trips", "sanitizer verdict"):
             if needle not in report:
                 print(f"selftest FAILED: '{needle}' missing from report",

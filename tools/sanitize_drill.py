@@ -3,7 +3,7 @@
 
 The static analyzer (``tools/lint.py``) proves the *source* honors the
 repo's contracts; this drill proves the ``DMT_SANITIZE=1`` runtime half
-actually fires on live state. Four injections, each a past bug class
+actually fires on live state. Six injections, each a past bug class
 (docs/ANALYSIS.md "Runtime sanitizer"):
 
 - **KV double-free** — free the same blocks twice; the poison set must
@@ -11,6 +11,11 @@ actually fires on live state. Four injections, each a past bug class
   accounting ValueError).
 - **KV use-after-free** — record a data write against freed blocks; must
   trip ``sanitize_kv_use_after_free_total``.
+- **KV refcount underflow** — tear a shared block's refcount below one
+  and free it; must trip ``sanitize_kv_refcount_underflow_total``.
+- **KV CoW violation** — record a write against a block with refcount > 1
+  (a prefix-cache sharer skipping copy-on-write); must trip
+  ``sanitize_kv_cow_violation_total``.
 - **post-warmup retrace** — warm a tiny serving engine, serve one request
   (ZERO trips allowed: the clean path must stay clean), then call the
   decode program at a gather width warmup never pretraced. The resulting
@@ -84,16 +89,43 @@ def drill_kv_pool() -> None:
         "use after free",
         lambda: pool.record_fill(stale),
     )
-    # Clean path: a full alloc/fill/free/realloc cycle must trip nothing.
+    # Refcount underflow: tear the books directly (a count below one on a
+    # block still in the used set is exactly the corruption a double-freed
+    # SHARER produces) and require the next free to classify it.
+    torn = pool.alloc(1)
+    pool._refcount[torn[0]] = 0
+    expect_trip(
+        sanitizer.KV_REFCOUNT_UNDERFLOW,
+        "refcount underflow",
+        lambda: pool.free(torn),
+    )
+    del pool._refcount[torn[0]]
+    pool.free(torn)
+    # CoW violation: share a block (refcount 2, prefix-cache adoption) and
+    # record a data write against it without copying first.
+    shared = pool.alloc(1)
+    pool.share(shared)
+    expect_trip(
+        sanitizer.KV_COW_VIOLATION,
+        "write to shared block without CoW",
+        lambda: pool.record_fill(shared),
+    )
+    pool.free(shared)  # drop the cache's reference (count 2 -> 1) ...
+    pool.record_fill(shared)  # ... sole owner again: writes are legal
+    pool.free(shared)
+    # Clean path: a full alloc/fill/free/realloc cycle must trip nothing,
+    # including a share/free cycle that never writes while shared.
     before = dict(sanitizer.trip_counts())
     again = pool.alloc(3)
     pool.record_fill(again)
+    pool.share(again[:1])
     pool.free(again)
+    pool.free(again[:1])
     pool.alloc(1)
     pool.check()
     check(
         sanitizer.trip_counts() == before,
-        "clean alloc/fill/free cycle trips nothing",
+        "clean alloc/fill/share/free cycle trips nothing",
     )
 
 
