@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
@@ -15,9 +15,9 @@ SHELL := /bin/bash
 # the fault-injection recovery drill, the autotune loop, the elastic-pod
 # rank-failure drill, the overlapped-ZeRO-1 bit-equality drill, the
 # serving-fleet replica-failure drill, the disaggregated prefill/decode
-# drill, and the radix prefix-cache drill without touching the ROADMAP
-# command itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke
+# drill, the radix prefix-cache drill, and the fleet-autoscaler surge
+# drill without touching the ROADMAP command itself.
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -174,3 +174,17 @@ prefix-smoke:
 fleet-smoke:
 	env JAX_PLATFORMS=cpu python tools/fleet_drill.py --fault kill_hang \
 		--root /tmp/dmt_fleet_smoke
+
+# Fleet-autoscaler surge drill (docs/SERVING.md "Load-adaptive
+# autoscaling", docs/TPU_POD_RUNBOOK.md §9): a 1-replica fleet under a
+# burst+spike trace must scale up (supervised spawn, warmed and
+# ready-acked before the router sees it) while a planned SIGKILL races the
+# first scale-up, then drain-retire back toward the floor on the trickle
+# tail — zero drops, every completed stream bit-identical to offline
+# greedy, and the scale books reconciling
+# (scale_events == spawned + retired + vetoed). The brownout ladder has
+# its own drill mode (--fault brownout); the smoke runs surge only to
+# keep the verify gate fast.
+autoscale-smoke:
+	env JAX_PLATFORMS=cpu python tools/autoscale_drill.py --fault surge \
+		--root /tmp/dmt_autoscale_smoke

@@ -1031,22 +1031,30 @@ def _device_responsive(
     entry, not the round: later workloads re-probe and still report if the
     tunnel recovers (or fail individually if it doesn't).
 
-    ``DMT_BENCH_WEDGE_PROBE=<workload key or "all">`` substitutes a child
-    that sleeps forever — the wedge drill ``tests/test_bench.py`` runs to
-    pin the salvage behavior. A CPU run normally skips the probe (no
-    tunnel to wedge) but still honors the simulation so the drill doesn't
-    need a TPU.
+    ``DMT_BENCH_WEDGE_PROBE=<workload key or "all">[:inside]`` substitutes
+    a child that sleeps forever — the wedge drill ``tests/test_bench.py``
+    runs to pin the salvage behavior. The bare form hangs before the jax
+    import (process never gets going); the ``:inside`` suffix hangs AFTER
+    jax is imported, the shape a wedged tunnel actually takes on hardware
+    (the device query itself blocks). A CPU run normally skips the probe
+    (no tunnel to wedge) but still honors the simulation so the drill
+    doesn't need a TPU.
     """
     wedge = os.environ.get("DMT_BENCH_WEDGE_PROBE", "")
-    wedged = wedge in (workload, "all") if wedge else False
+    target, _, wedge_mode = wedge.partition(":")
+    wedged = target in (workload, "all") if target else False
     if platform == "cpu" and not wedged:
         return None
     # jax.devices() alone detects the wedge (it hung too) without paying a
     # remote compile on every healthy run.
-    code = (
-        "import time; time.sleep(1000000)" if wedged
-        else "import jax; print(jax.devices())"
-    )
+    if wedged:
+        code = (
+            "import jax, time; time.sleep(1000000)"
+            if wedge_mode == "inside"
+            else "import time; time.sleep(1000000)"
+        )
+    else:
+        code = "import jax; print(jax.devices())"
     proc = subprocess.Popen(
         [sys.executable, "-c", code],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -1251,7 +1259,10 @@ def _child_main(args) -> int:
     return 0
 
 
-def _run_isolated(key: str, argv: list[str], budget_s: float) -> dict:
+def _run_isolated(
+    key: str, argv: list[str], budget_s: float,
+    env: dict[str, str] | None = None,
+) -> dict:
     """Run one workload as ``bench.py --only <key>`` in its own process
     group under a wall-clock budget.
 
@@ -1266,6 +1277,7 @@ def _run_isolated(key: str, argv: list[str], budget_s: float) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--only", key, *argv]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, text=True, start_new_session=True,
+        env=env,
     )  # stderr inherits: compile/progress noise stays live on the console
     try:
         stdout, _ = proc.communicate(timeout=budget_s)
@@ -1311,12 +1323,41 @@ def main() -> None:
     child_argv = sys.argv[1:]
     details: dict = {}
 
+    # Serving workloads measure control-plane behavior (supervision,
+    # re-dispatch, KV paging, routing) that runs on host processes —
+    # bench_fleet even forces CPU workers by design. When the accelerator
+    # probe dies, these entries degrade to the CPU harness instead of
+    # failing: the round still reports serving metrics, each explicitly
+    # flagged ``degraded`` so nobody mistakes them for TPU numbers
+    # (ROADMAP item 4: a dead tunnel should cost fidelity, not coverage).
+    cpu_fallback = frozenset({
+        "lm_serving_2k", "lm_spec_decode", "serving_fleet",
+        "serving_disagg", "serving_prefix",
+    })
+
     def run(key: str, *, metric: str, unit: str, value_key: str,
             budget_s: float | None = None):
         probe_error = _device_responsive(
             key, args.probe_timeout, args.platform
         )
         if probe_error is not None:
+            if key in cpu_fallback:
+                # --platform appended last wins over any earlier flag; the
+                # env pin covers children that never read the flag.
+                r = _run_isolated(
+                    key, [*child_argv, "--platform", "cpu"],
+                    budget_s or args.workload_timeout,
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                )
+                if "failed" not in r:
+                    r["degraded"] = f"cpu harness fallback: {probe_error}"
+                    details[key] = r
+                    print(json.dumps(
+                        {"metric": metric, "value": r.get(value_key),
+                         "unit": unit, "degraded": True,
+                         "error": probe_error}
+                    ), flush=True)
+                    return r
             details[key] = {"failed": probe_error}
             print(json.dumps({"metric": metric, "value": None, "unit": unit,
                               "error": probe_error}), flush=True)

@@ -171,6 +171,21 @@ def build_parser() -> argparse.ArgumentParser:
                        "(1 = single in-process engine); fleet mode implies "
                        "--selftest semantics (random-init model, parity "
                        "check against offline greedy)")
+    fleet.add_argument("--autoscale", action="store_true",
+                       help="closed-loop fleet sizing: spawn/retire "
+                       "replicas from measured load (queue depth + backlog "
+                       "per ready replica), with hysteresis + cooldown, a "
+                       "hard --min_replicas floor, and the overload "
+                       "brownout ladder (docs/SERVING.md); implies fleet "
+                       "mode even with --replicas 1")
+    fleet.add_argument("--min_replicas", type=int, default=1,
+                       help="autoscaler floor: scale-down is vetoed at this "
+                       "ready-replica count (a concurrent replica death "
+                       "can never race the fleet to zero)")
+    fleet.add_argument("--max_replicas", type=int, default=4,
+                       help="autoscaler ceiling: scale-up is vetoed here; "
+                       "sustained overload at the ceiling climbs the "
+                       "brownout ladder instead")
     fleet.add_argument("--hedge_ms", type=float, default=0.0,
                        help="hedged-retry threshold: a request outstanding "
                        "this long (with deadline budget left) is duplicated "
@@ -438,11 +453,19 @@ def _run_fleet(args, eos_id) -> int:
     registry = MetricsRegistry()
     if args.metrics_file:
         registry.add_sink(JsonlSink(args.metrics_file))
+    autoscale = None
+    if args.autoscale:
+        from deeplearning_mpi_tpu.serving import AutoscalerConfig
+
+        autoscale = AutoscalerConfig(
+            min_replicas=args.min_replicas, max_replicas=args.max_replicas
+        )
     sup = FleetSupervisor(
         model_spec, engine_spec, args.replicas, fleet_dir,
         seed=args.random_seed, eos_id=eos_id, warmup=True,
         chaos=args.chaos, hedge_ms=args.hedge_ms, registry=registry,
         disagg=args.disagg, tp=args.tp, tenants=_parse_tenants(args.tenants),
+        autoscale=autoscale,
     )
     swap_seed = args.random_seed + 1 if args.swap_at is not None else None
     try:
@@ -466,6 +489,16 @@ def _run_fleet(args, eos_id) -> int:
                 outcome = k.split("=", 1)[1].strip('"}')
                 parts.append(f"{snap[k]:.0f} {outcome}")
         print("hedges: " + ", ".join(parts), file=sys.stderr)
+    if result.scale:
+        print(
+            f"autoscale: {result.scale['spawned']} spawned, "
+            f"{result.scale['retired']} retired, "
+            f"{result.scale['vetoed']} vetoed "
+            f"({result.scale['events']} decisions), brownout max stage "
+            f"{result.scale['brownout_stage_max']}, final fleet "
+            f"{result.scale['replicas_final']}",
+            file=sys.stderr,
+        )
     if result.swap["requested"]:
         print(
             f"swap: performed={result.swap['performed']} "
@@ -527,9 +560,12 @@ def _run_fleet(args, eos_id) -> int:
             file=sys.stderr,
         )
         return 1
+    peak = args.replicas
+    if result.scale:
+        peak = max(peak, args.replicas + result.scale["spawned"])
     print(
         f"fleet OK: {result.completed} requests bit-identical to offline "
-        f"greedy across {args.replicas} replicas",
+        f"greedy across {peak} replica(s)",
         file=sys.stderr,
     )
     return 0
@@ -550,13 +586,17 @@ def main(argv: list[str] | None = None) -> int:
     chaos_spec = args.chaos or _os.environ.get("DMT_CHAOS") or ""
     if chaos_spec.strip():
         from deeplearning_mpi_tpu.resilience import (
+            AUTOSCALE_KINDS,
             DISAGG_KINDS,
             FLEET_KINDS,
             SERVE_KINDS,
             validate_plan_kinds,
         )
 
-        if args.replicas > 1:
+        if args.autoscale:
+            supported = FLEET_KINDS | AUTOSCALE_KINDS
+            workload = "autoscaled serving fleet"
+        elif args.replicas > 1:
             supported, workload = FLEET_KINDS, "serving fleet"
         elif args.disagg:
             supported, workload = DISAGG_KINDS, "disaggregated serving"
@@ -567,11 +607,11 @@ def main(argv: list[str] | None = None) -> int:
         except ValueError as e:
             print(f"--chaos: {e}", file=sys.stderr)
             return 1
-    if args.replicas > 1:
+    if args.replicas > 1 or args.autoscale:
         if args.kv_dtype:
             # Fleet parity is a bit-exact bar (failover must be invisible
             # in the tokens); a lossy KV cache would make it vacuous.
-            print("--kv_dtype does not compose with --replicas > 1: fleet "
+            print("--kv_dtype does not compose with fleet mode: fleet "
                   "parity is bit-exact", file=sys.stderr)
             return 1
         if args.platform:

@@ -24,6 +24,7 @@ rollback_total`` is the chaos harness's own acceptance check
 """
 
 from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
+    AUTOSCALE_KINDS,
     DISAGG_KINDS,
     FLEET_KINDS,
     SERVE_KINDS,
@@ -62,6 +63,7 @@ from deeplearning_mpi_tpu.resilience.supervisor import (  # noqa: F401
 from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F401
 
 __all__ = [
+    "AUTOSCALE_KINDS",
     "ChaosInjector",
     "CheckpointCorruption",
     "DISAGG_KINDS",

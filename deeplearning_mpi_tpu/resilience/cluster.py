@@ -1,0 +1,318 @@
+"""Unified supervision core shared by the pod and fleet supervisors.
+
+ROADMAP item 3 observed that :mod:`~.pod` (training) and
+:mod:`~deeplearning_mpi_tpu.serving.fleet` (serving) grew as two parallel
+supervisors with the same bones: per-worker heartbeat aggregation, the
+dead/hung/slow classification built on :class:`LivenessTracker`,
+SIGKILL+respawn process lifecycle, supervisor-owned chaos fire/recovery
+books, and newline-delimited JSON as the only wire format. This module IS
+those bones, extracted so both supervisors wrap one core — and so the
+Podracer end-state (one control plane repurposing chips between trainer
+ranks and serving replicas under load) has a single place to grow from.
+
+What lives here:
+
+- :class:`LivenessTracker` — progress-seq liveness over heartbeat payloads
+  (moved verbatim from ``pod.py``; ``pod`` re-exports it for callers).
+- :func:`tail_jsonl` — offset-tailing reader for append-only JSONL IPC
+  files that consumes only newline-terminated records (moved from
+  ``fleet.py``): a mid-write SIGKILL can truncate at most the final,
+  unconsumed line.
+- :func:`sigkill_group` / :func:`reap` / :func:`kill_and_reap` — the
+  process-group teardown contract (workers are spawned with
+  ``start_new_session=True``; SIGKILL goes to the whole group).
+- :func:`scrub_rendezvous_env` — strip jax distributed-rendezvous vars
+  from a child env: a lone process (serving replica, world-of-one pod
+  survivor) must never inherit a coordinator address and wait for peers.
+- :class:`ClusterSupervisor` — the shared supervisor base: chaos spec
+  resolution + injector construction, registry ownership, the heartbeat
+  cadence knobs, the per-supervisor JSONL metrics sink, and tracker
+  construction. :class:`~.pod.PodSupervisor` keeps the world re-form
+  semantics; :class:`~deeplearning_mpi_tpu.serving.fleet.FleetSupervisor`
+  keeps the mailbox/router semantics; both are pinned bit-identical by
+  ``make pod-smoke`` / ``make fleet-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import statistics
+import subprocess
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Mapping, MutableMapping, Optional
+
+from deeplearning_mpi_tpu.resilience.faults import ChaosInjector, FaultPlan
+from deeplearning_mpi_tpu.telemetry.registry import JsonlSink, MetricsRegistry
+
+__all__ = [
+    "ENV_HEARTBEAT_DIR",
+    "ENV_HEARTBEAT_INTERVAL",
+    "ClusterSupervisor",
+    "LivenessTracker",
+    "kill_and_reap",
+    "reap",
+    "scrub_rendezvous_env",
+    "sigkill_group",
+    "tail_jsonl",
+]
+
+#: directory workers write per-rank ``heartbeat-{rank}.json`` files into —
+#: the supervisor↔worker contract (``utils/config.py::build_observability``
+#: switches to this layout when the var is set).
+ENV_HEARTBEAT_DIR = "DMT_HEARTBEAT_DIR"
+#: heartbeat interval override (seconds) — drills crank it down to 0.2s.
+ENV_HEARTBEAT_INTERVAL = "DMT_HEARTBEAT_INTERVAL_S"
+
+#: env vars of the jax distributed-rendezvous contract
+#: (``runtime/bootstrap.py``) — scrubbed from lone-process children.
+RENDEZVOUS_VARS = ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID")
+
+
+def tail_jsonl(path: Path, offset: int) -> tuple[list[dict], int]:
+    """Read the complete JSONL records appended past ``offset``. Only
+    newline-terminated lines are consumed — a partial trailing line (the
+    writer died mid-write, or the write raced this read) stays unread
+    until its newline lands."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return [], offset
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    chunk = data[: end + 1]
+    out = []
+    for line in chunk.splitlines():
+        if line.strip():
+            out.append(json.loads(line))
+    return out, offset + len(chunk)
+
+
+def sigkill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL ``proc``'s whole process group (it was spawned with
+    ``start_new_session=True``); fall back to killing the process alone
+    when the group is already gone or not ours."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()
+
+
+def reap(proc: subprocess.Popen, timeout_s: float = 10.0) -> None:
+    """Wait for ``proc`` to exit, bounded — a SIGKILL'd group should reap
+    promptly; if it does not, leave the zombie rather than hang teardown."""
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        pass
+
+
+def kill_and_reap(proc: subprocess.Popen, timeout_s: float = 10.0) -> None:
+    """The single-process teardown: SIGKILL the group iff still running,
+    then reap."""
+    if proc.poll() is None:
+        sigkill_group(proc)
+        reap(proc, timeout_s)
+
+
+def scrub_rendezvous_env(env: MutableMapping[str, str]) -> None:
+    """Remove distributed-rendezvous vars from a child env in place: a
+    process launched as a world of one (serving replica, lone pod
+    survivor) would otherwise wait forever for peers that never come."""
+    for k in RENDEZVOUS_VARS:
+        env.pop(k, None)
+
+
+class LivenessTracker:
+    """Pod-level liveness view over per-rank heartbeat payloads.
+
+    All stall math uses THIS process's ``clock`` (injectable for tests) and
+    timestamps of observed ``progress_seq`` *changes* — never the payload's
+    own ``monotonic``/``time`` fields, which belong to another host's clock.
+
+    Three verdicts per rank:
+
+    - **stalled**: no heartbeat file within ``grace_s`` of tracker start
+      (worker never came up), no first progress within ``grace_s`` (wedged
+      in startup/compile), or no progress change within ``deadline_s``
+      after progressing at least once — the hung-collective signature.
+    - **straggler**: progressing, but its current progress age exceeds
+      ``straggler_factor`` × the median observed inter-progress interval
+      across ranks (and is still under the deadline) — slow, not dead.
+    - healthy otherwise.
+    """
+
+    def __init__(
+        self,
+        ranks: Iterable[int],
+        *,
+        deadline_s: float,
+        grace_s: float,
+        straggler_factor: float = 4.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.deadline_s = deadline_s
+        self.grace_s = grace_s
+        self.straggler_factor = straggler_factor
+        self._clock = clock
+        self._start = clock()
+        self._ranks = list(ranks)
+        self._last_seq: dict[int, Any] = {}
+        self._last_change: dict[int, float] = {}
+        self._last_step: dict[int, float] = {}
+        self._interval_ema: dict[int, float] = {}
+        self._seen_progress: set[int] = set()
+
+    def observe(self, rank: int, payload: Mapping[str, Any] | None) -> None:
+        """Feed one heartbeat read (``None`` = file missing/unreadable)."""
+        if payload is None:
+            return
+        now = self._clock()
+        if isinstance(payload.get("step"), (int, float)):
+            self._last_step[rank] = float(payload["step"])
+        seq = payload.get("progress_seq", payload.get("time"))
+        prev = self._last_seq.get(rank)
+        if prev is None:
+            self._last_seq[rank] = seq
+            self._last_change[rank] = now
+            if isinstance(seq, (int, float)) and seq and seq > 0:
+                # First read already shows training-loop progress (a fast
+                # worker beat us to it) — count it as progress, not baseline.
+                self._seen_progress.add(rank)
+            return
+        if seq != prev:
+            interval = now - self._last_change[rank]
+            if rank in self._seen_progress:
+                ema = self._interval_ema.get(rank)
+                self._interval_ema[rank] = (
+                    interval if ema is None else 0.5 * ema + 0.5 * interval
+                )
+            self._seen_progress.add(rank)
+            self._last_seq[rank] = seq
+            self._last_change[rank] = now
+
+    def any_progress(self) -> bool:
+        """True once ANY rank's training loop has demonstrably advanced —
+        the supervisor's "the re-formed world is alive" signal that closes
+        pending chaos recoveries."""
+        return bool(self._seen_progress)
+
+    def progress_age_s(self, rank: int) -> float:
+        """Seconds (supervisor clock) since ``rank`` last changed state."""
+        return self._clock() - self._last_change.get(rank, self._start)
+
+    def stalled(self, rank: int) -> bool:
+        if rank not in self._seen_progress:
+            # Startup (spawn + import + compile) gets the grace window,
+            # whether or not the heartbeat file has appeared yet.
+            return self._clock() - self._start > self.grace_s
+        return self.progress_age_s(rank) > self.deadline_s
+
+    def hang_culprits(self, stalled: Iterable[int]) -> list[int]:
+        """Pick the rank(s) that CAUSED a stall from the ranks exhibiting one.
+
+        One wedged rank stalls the whole world: every peer eventually blocks
+        inside a collective waiting for it, so after the deadline ALL ranks
+        look hung. Timing cannot break the tie (the cascade completes within
+        milliseconds), but progress content can: the culprit froze *before*
+        its step, while peers dispatched at least one step further (async
+        dispatch keeps their host loop — and progress marks — running until
+        a device fetch blocks). The culprit is therefore the stalled rank
+        with the LOWEST last-reported progress ``step``; a rank that never
+        reported a step (wedged in startup) is always a culprit. Ties mean
+        the signal is ambiguous — every tied rank is treated as a culprit
+        rather than guessing.
+        """
+        stalled = list(stalled)
+        if not stalled:
+            return []
+        steps = {r: self._last_step.get(r, float("-inf")) for r in stalled}
+        lowest = min(steps.values())
+        return [r for r in stalled if steps[r] == lowest]
+
+    def stragglers(self, active: Iterable[int]) -> list[int]:
+        known = [v for v in self._interval_ema.values() if v > 0]
+        if not known:
+            return []
+        threshold = self.straggler_factor * statistics.median(known)
+        out = []
+        for rank in active:
+            if rank not in self._seen_progress:
+                continue
+            age = self.progress_age_s(rank)
+            if threshold < age <= self.deadline_s:
+                out.append(rank)
+        return out
+
+
+class ClusterSupervisor:
+    """Shared supervisor bones: chaos spec + injector, registry ownership,
+    heartbeat cadence, and the per-run JSONL metrics sink.
+
+    Subclasses own the domain semantics (the pod re-forms a collective
+    world; the fleet routes a request ledger through replica mailboxes) —
+    the core owns everything that was duplicated between them. The
+    ``log_name`` class attribute prefixes every supervisor log line.
+    """
+
+    log_name = "cluster"
+
+    def __init__(
+        self,
+        root_dir: str | Path,
+        *,
+        chaos: str | None = None,
+        heartbeat_deadline_s: float,
+        heartbeat_interval_s: float,
+        spawn_grace_s: float,
+        poll_interval_s: float,
+        registry: MetricsRegistry | None = None,
+        env: Mapping[str, str] | None = None,
+    ) -> None:
+        self.dir = Path(root_dir)
+        self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
+        self.heartbeat_deadline_s = heartbeat_deadline_s
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.spawn_grace_s = spawn_grace_s
+        self.poll_interval_s = poll_interval_s
+        self.extra_env = dict(env or {})
+        self._own_registry = registry is None
+        self.registry = registry or MetricsRegistry()
+
+    def _log(self, msg: str) -> None:
+        print(f"{self.log_name}: {msg}", flush=True)
+
+    def _open_books(self, sink_name: str) -> Optional[ChaosInjector]:
+        """Create the run directory + JSONL metrics sink, and the chaos
+        injector when a spec is present. Call once at the top of ``run``."""
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.registry.add_sink(JsonlSink(self.dir / sink_name))
+        if self.chaos_spec.strip():
+            return ChaosInjector(
+                FaultPlan.parse(self.chaos_spec), registry=self.registry
+            )
+        return None
+
+    def new_tracker(
+        self,
+        ranks: Iterable[int],
+        *,
+        grace_s: float | None = None,
+        straggler_factor: float = 4.0,
+    ) -> LivenessTracker:
+        """A :class:`LivenessTracker` on this supervisor's cadence knobs."""
+        return LivenessTracker(
+            ranks,
+            deadline_s=self.heartbeat_deadline_s,
+            grace_s=self.spawn_grace_s if grace_s is None else grace_s,
+            straggler_factor=straggler_factor,
+        )
+
+    def _close_registry(self) -> None:
+        if self._own_registry:
+            self.registry.close()

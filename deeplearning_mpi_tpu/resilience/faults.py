@@ -46,6 +46,13 @@ kind             unit    injection site
 ``handoff_stall`` step   the prefill→decode handoff queue of a disaggregated
                          engine wedges: completed prefills pile up undrained
                          until the coordinator notices and un-sticks it
+``load_spike``    step   the fleet supervisor injects a synthetic request
+                         burst once ``at`` requests have completed — the
+                         autoscaler's scale-up path must absorb it
+``scale_during_failure`` step  the supervisor SIGKILLs a live replica during
+                         its ``at``-th scale-up, while the new replica is
+                         still warming — failover and autoscaling must
+                         compose without thrashing
 ===============  ======  =====================================================
 
 ``rank_kill``/``rank_hang`` are *pod-level* kinds (:data:`POD_KINDS`): the
@@ -84,6 +91,7 @@ from typing import Any, Optional
 from deeplearning_mpi_tpu.telemetry.registry import labeled
 
 __all__ = [
+    "AUTOSCALE_KINDS",
     "ChaosInjector",
     "DISAGG_KINDS",
     "ENV_RANK",
@@ -121,6 +129,8 @@ FAULT_UNITS = {
     "replica_hang": "step",
     "replica_slow": "step",
     "handoff_stall": "step",
+    "load_spike": "step",
+    "scale_during_failure": "step",
 }
 
 #: kinds whose accounting lives in the pod supervisor, not the worker: the
@@ -140,6 +150,13 @@ SERVE_KINDS = frozenset({"serve_crash"})
 #: distinct from :data:`SERVE_KINDS` so a colocated run handed
 #: ``handoff_stall`` still fails loud at validation.
 DISAGG_KINDS = SERVE_KINDS | frozenset({"handoff_stall"})
+
+#: autoscaler drill kinds — detonated by the fleet supervisor itself, never
+#: shipped to workers (``fleet_entries`` filters on :data:`FLEET_KINDS`, so
+#: per-replica ``DMT_CHAOS`` can't carry them). ``load_spike`` injects a
+#: synthetic request burst; ``scale_during_failure`` SIGKILLs a live replica
+#: mid-scale-up. Only valid with the autoscaler enabled.
+AUTOSCALE_KINDS = frozenset({"load_spike", "scale_during_failure"})
 
 #: exit code of a rank_kill'd worker — distinguishable from collateral
 #: crashes (a peer's collective erroring out) in the supervisor's logs.

@@ -32,33 +32,42 @@ fired entry from the spec before respawning (workers restart their step
 count at 0, so an unstripped entry would re-fire every attempt). The
 pod-level reconciliation invariant — ``fault_injected_total ==
 recovery_total + rollback_total`` — lands in ``pod_metrics.jsonl``.
+
+The mechanics shared with the serving fleet — heartbeat liveness
+(:class:`LivenessTracker`), SIGKILL+reap teardown, chaos books, rendezvous
+env scrubbing — live in the unified supervision core
+(:mod:`~.cluster`); this module keeps only the world re-form semantics.
+``LivenessTracker`` and the heartbeat env constants are re-exported here
+for their historical import path.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-import signal
 import socket
-import statistics
 import subprocess
 import time
 from pathlib import Path
-from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
+from typing import Any, Mapping, Optional, Sequence
 
+from deeplearning_mpi_tpu.resilience.cluster import (
+    ENV_HEARTBEAT_DIR,
+    ENV_HEARTBEAT_INTERVAL,
+    ClusterSupervisor,
+    LivenessTracker,
+    reap,
+    scrub_rendezvous_env,
+    sigkill_group,
+)
 from deeplearning_mpi_tpu.resilience.faults import (
     ENV_RANK,
     ChaosInjector,
-    FaultPlan,
     pod_entries,
     strip_entries,
 )
 from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
-from deeplearning_mpi_tpu.telemetry.registry import (
-    JsonlSink,
-    MetricsRegistry,
-    labeled,
-)
+from deeplearning_mpi_tpu.telemetry.registry import MetricsRegistry, labeled
 
 __all__ = [
     "ENV_HEARTBEAT_DIR",
@@ -72,13 +81,6 @@ __all__ = [
     "PodResult",
     "PodSupervisor",
 ]
-
-#: directory workers write per-rank ``heartbeat-{rank}.json`` files into —
-#: the supervisor↔worker contract (``utils/config.py::build_observability``
-#: switches to this layout when the var is set).
-ENV_HEARTBEAT_DIR = "DMT_HEARTBEAT_DIR"
-#: heartbeat interval override (seconds) — drills crank it down to 0.2s.
-ENV_HEARTBEAT_INTERVAL = "DMT_HEARTBEAT_INTERVAL_S"
 
 POD_RANK_FAILURES = "pod_rank_failures_total"
 POD_RESTARTS = "pod_restarts_total"
@@ -97,128 +99,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-class LivenessTracker:
-    """Pod-level liveness view over per-rank heartbeat payloads.
-
-    All stall math uses THIS process's ``clock`` (injectable for tests) and
-    timestamps of observed ``progress_seq`` *changes* — never the payload's
-    own ``monotonic``/``time`` fields, which belong to another host's clock.
-
-    Three verdicts per rank:
-
-    - **stalled**: no heartbeat file within ``grace_s`` of tracker start
-      (worker never came up), no first progress within ``grace_s`` (wedged
-      in startup/compile), or no progress change within ``deadline_s``
-      after progressing at least once — the hung-collective signature.
-    - **straggler**: progressing, but its current progress age exceeds
-      ``straggler_factor`` × the median observed inter-progress interval
-      across ranks (and is still under the deadline) — slow, not dead.
-    - healthy otherwise.
-    """
-
-    def __init__(
-        self,
-        ranks: Iterable[int],
-        *,
-        deadline_s: float,
-        grace_s: float,
-        straggler_factor: float = 4.0,
-        clock: Callable[[], float] = time.monotonic,
-    ) -> None:
-        self.deadline_s = deadline_s
-        self.grace_s = grace_s
-        self.straggler_factor = straggler_factor
-        self._clock = clock
-        self._start = clock()
-        self._ranks = list(ranks)
-        self._last_seq: dict[int, Any] = {}
-        self._last_change: dict[int, float] = {}
-        self._last_step: dict[int, float] = {}
-        self._interval_ema: dict[int, float] = {}
-        self._seen_progress: set[int] = set()
-
-    def observe(self, rank: int, payload: Mapping[str, Any] | None) -> None:
-        """Feed one heartbeat read (``None`` = file missing/unreadable)."""
-        if payload is None:
-            return
-        now = self._clock()
-        if isinstance(payload.get("step"), (int, float)):
-            self._last_step[rank] = float(payload["step"])
-        seq = payload.get("progress_seq", payload.get("time"))
-        prev = self._last_seq.get(rank)
-        if prev is None:
-            self._last_seq[rank] = seq
-            self._last_change[rank] = now
-            if isinstance(seq, (int, float)) and seq and seq > 0:
-                # First read already shows training-loop progress (a fast
-                # worker beat us to it) — count it as progress, not baseline.
-                self._seen_progress.add(rank)
-            return
-        if seq != prev:
-            interval = now - self._last_change[rank]
-            if rank in self._seen_progress:
-                ema = self._interval_ema.get(rank)
-                self._interval_ema[rank] = (
-                    interval if ema is None else 0.5 * ema + 0.5 * interval
-                )
-            self._seen_progress.add(rank)
-            self._last_seq[rank] = seq
-            self._last_change[rank] = now
-
-    def any_progress(self) -> bool:
-        """True once ANY rank's training loop has demonstrably advanced —
-        the supervisor's "the re-formed world is alive" signal that closes
-        pending chaos recoveries."""
-        return bool(self._seen_progress)
-
-    def progress_age_s(self, rank: int) -> float:
-        """Seconds (supervisor clock) since ``rank`` last changed state."""
-        return self._clock() - self._last_change.get(rank, self._start)
-
-    def stalled(self, rank: int) -> bool:
-        if rank not in self._seen_progress:
-            # Startup (spawn + import + compile) gets the grace window,
-            # whether or not the heartbeat file has appeared yet.
-            return self._clock() - self._start > self.grace_s
-        return self.progress_age_s(rank) > self.deadline_s
-
-    def hang_culprits(self, stalled: Iterable[int]) -> list[int]:
-        """Pick the rank(s) that CAUSED a stall from the ranks exhibiting one.
-
-        One wedged rank stalls the whole world: every peer eventually blocks
-        inside a collective waiting for it, so after the deadline ALL ranks
-        look hung. Timing cannot break the tie (the cascade completes within
-        milliseconds), but progress content can: the culprit froze *before*
-        its step, while peers dispatched at least one step further (async
-        dispatch keeps their host loop — and progress marks — running until
-        a device fetch blocks). The culprit is therefore the stalled rank
-        with the LOWEST last-reported progress ``step``; a rank that never
-        reported a step (wedged in startup) is always a culprit. Ties mean
-        the signal is ambiguous — every tied rank is treated as a culprit
-        rather than guessing.
-        """
-        stalled = list(stalled)
-        if not stalled:
-            return []
-        steps = {r: self._last_step.get(r, float("-inf")) for r in stalled}
-        lowest = min(steps.values())
-        return [r for r in stalled if steps[r] == lowest]
-
-    def stragglers(self, active: Iterable[int]) -> list[int]:
-        known = [v for v in self._interval_ema.values() if v > 0]
-        if not known:
-            return []
-        threshold = self.straggler_factor * statistics.median(known)
-        out = []
-        for rank in active:
-            if rank not in self._seen_progress:
-                continue
-            age = self.progress_age_s(rank)
-            if threshold < age <= self.deadline_s:
-                out.append(rank)
-        return out
-
-
 @dataclasses.dataclass
 class PodResult:
     """What a :meth:`PodSupervisor.run` accomplished."""
@@ -231,7 +111,7 @@ class PodResult:
     chaos_balanced: Optional[bool]  # None when no chaos spec was given
 
 
-class PodSupervisor:
+class PodSupervisor(ClusterSupervisor):
     """Spawn one worker per simulated host, watch liveness, re-form on loss.
 
     ``worker_cmd`` is the full training command (e.g. ``[sys.executable,
@@ -247,6 +127,8 @@ class PodSupervisor:
     graceful drain is impossible by construction; recovery is the previous
     checkpoint, which is exactly what the elastic restore path replays.
     """
+
+    log_name = "pod"
 
     def __init__(
         self,
@@ -265,23 +147,22 @@ class PodSupervisor:
         registry: MetricsRegistry | None = None,
         env: Mapping[str, str] | None = None,
     ) -> None:
+        super().__init__(
+            pod_dir,
+            chaos=chaos,
+            heartbeat_deadline_s=heartbeat_deadline_s,
+            heartbeat_interval_s=heartbeat_interval_s,
+            spawn_grace_s=spawn_grace_s,
+            poll_interval_s=poll_interval_s,
+            registry=registry,
+            env=env,
+        )
         self.worker_cmd = list(worker_cmd)
         self.num_processes = num_processes
-        self.pod_dir = Path(pod_dir)
-        self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
-        self.heartbeat_deadline_s = heartbeat_deadline_s
-        self.heartbeat_interval_s = heartbeat_interval_s
-        self.spawn_grace_s = spawn_grace_s
-        self.poll_interval_s = poll_interval_s
+        self.pod_dir = self.dir
         self.min_world_size = min_world_size
         self.max_pod_restarts = max_pod_restarts
         self.straggler_factor = straggler_factor
-        self.extra_env = dict(env or {})
-        self._own_registry = registry is None
-        self.registry = registry or MetricsRegistry()
-
-    def _log(self, msg: str) -> None:
-        print(f"pod: {msg}", flush=True)
 
     def _chaos_target(self, spec: str, world: int) -> Optional[int]:
         """Rank a planned ``rank_kill``/``rank_hang`` detonates on, or None.
@@ -322,8 +203,7 @@ class PodSupervisor:
         else:
             # A world of one needs no rendezvous — and leftover coordinator
             # vars would make the lone survivor wait for peers forever.
-            for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
-                base.pop(k, None)
+            scrub_rendezvous_env(base)
         procs: dict[int, subprocess.Popen] = {}
         handles: list[Any] = []
         for rank in range(world):
@@ -350,25 +230,13 @@ class PodSupervisor:
     def _kill_all(procs: dict[int, subprocess.Popen]) -> None:
         for proc in procs.values():
             if proc.poll() is None:
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    proc.kill()
+                sigkill_group(proc)
         for proc in procs.values():
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
+            reap(proc)
 
     # -- the supervision loop ------------------------------------------------
     def run(self) -> PodResult:
-        self.pod_dir.mkdir(parents=True, exist_ok=True)
-        self.registry.add_sink(JsonlSink(self.pod_dir / "pod_metrics.jsonl"))
-        injector: ChaosInjector | None = None
-        if self.chaos_spec.strip():
-            injector = ChaosInjector(
-                FaultPlan.parse(self.chaos_spec), registry=self.registry
-            )
+        injector = self._open_books("pod_metrics.jsonl")
         for name in (POD_RANK_FAILURES, POD_RESTARTS, POD_STRAGGLERS):
             self.registry.counter(name)
         world = self.num_processes
@@ -386,11 +254,8 @@ class PodSupervisor:
             while True:
                 world_sizes.append(world)
                 procs, handles, hb_dir = self._spawn(attempt, world, spec)
-                tracker = LivenessTracker(
-                    procs,
-                    deadline_s=self.heartbeat_deadline_s,
-                    grace_s=self.spawn_grace_s,
-                    straggler_factor=self.straggler_factor,
+                tracker = self.new_tracker(
+                    procs, straggler_factor=self.straggler_factor
                 )
                 flagged: set[int] = set()
                 dead: list[int] = []
@@ -574,8 +439,7 @@ class PodSupervisor:
             self._result(False, world_sizes, restarts, rank_failures, injector)
             raise
         finally:
-            if self._own_registry:
-                self.registry.close()
+            self._close_registry()
 
     def _result(
         self,

@@ -6,6 +6,11 @@ Entry points: :class:`~deeplearning_mpi_tpu.serving.engine.ServingEngine`
 is ``deeplearning_mpi_tpu.cli.serve_lm``. Design doc: ``docs/SERVING.md``.
 """
 
+from deeplearning_mpi_tpu.serving.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerPolicy,
+    LoadSignal,
+)
 from deeplearning_mpi_tpu.serving.disagg import (
     DecodeEngine,
     DisaggregatedEngine,
@@ -40,6 +45,8 @@ from deeplearning_mpi_tpu.serving.router import Router
 from deeplearning_mpi_tpu.serving.speculative import SpeculativeDecoder
 
 __all__ = [
+    "AutoscalerConfig",
+    "AutoscalerPolicy",
     "DecodeEngine",
     "DisaggregatedEngine",
     "EngineConfig",
@@ -47,6 +54,7 @@ __all__ = [
     "FleetResult",
     "FleetSupervisor",
     "KVBuffers",
+    "LoadSignal",
     "PagedForward",
     "PrefillEngine",
     "PagedKVPool",

@@ -1,0 +1,248 @@
+"""Load-adaptive fleet autoscaler policy: scale decisions + brownout ladder.
+
+The policy half of closed-loop fleet sizing (ROADMAP item 1). Like the
+router and the scheduler, this module is pure host-side Python — every
+decision is a deterministic function of (config, clock, load signal), so
+``tests/test_autoscaler.py`` drives all of it under a fake clock. The
+supervisor (:class:`~deeplearning_mpi_tpu.serving.fleet.FleetSupervisor`
+with ``autoscale=``) owns the mechanism: supervised spawn + warmup +
+ready-ack before router inclusion on scale-up, and the zero-drop drain
+path (borrowed from the rolling weight swap) on scale-down.
+
+Three stabilizers keep the loop from thrashing:
+
+- **Hysteresis**: a scale signal must PERSIST for ``hysteresis_s`` before
+  a decision fires — one bursty heartbeat is not a trend. After any
+  decision (including a veto) the signal must re-arm from scratch AND a
+  cooldown starts, so a standing veto is recorded once per cooldown, not
+  once per tick. While spawned capacity is still warming
+  (``LoadSignal.warming``), up-decisions hold without firing at all —
+  the load number divides by READY replicas only, so scaling again
+  before the last spawn serves would double-count the same overload.
+- **Cooldown**: after any scale event *or failover respawn*
+  (:meth:`note_respawn` — the supervisor calls it from its failure
+  handler), further decisions wait ``cooldown_s``. A chaos kill already
+  changes fleet capacity; scaling on top of an in-flight respawn is how
+  control loops oscillate.
+- **Floor/ceiling clamps**: scale-down is vetoed at ``min_replicas``
+  against *ready* capacity (so a concurrent replica death can never race
+  the fleet to zero), scale-up at ``max_replicas`` against *total*
+  membership including still-warming spawns.
+
+When the fleet is pinned at ``max_replicas`` and overload persists, the
+**brownout ladder** (:meth:`brownout`) escalates one stage per
+``brownout_hold_s`` of sustained saturation: (1) shed lowest-priority
+tenants at the admission door, (2) additionally disable speculative
+drafts, (3) additionally raise the deadline floor. It resets to 0 only
+after ``brownout_clear_s`` of calm — degrading is fast, un-degrading is
+deliberately slow (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+__all__ = ["AutoscalerConfig", "AutoscalerPolicy", "LoadSignal"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Knobs for :class:`AutoscalerPolicy`. Defaults suit the drills'
+    compressed clocks; production wants seconds-to-minutes values."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: scale up when load per ready replica exceeds this...
+    up_load_per_replica: float = 3.0
+    #: ...and down when it falls below this (the gap between the two IS
+    #: the static half of the hysteresis).
+    down_load_per_replica: float = 0.25
+    #: how long a signal must persist before a decision fires.
+    hysteresis_s: float = 0.3
+    #: quiet period after any scale event or failover respawn.
+    cooldown_s: float = 1.0
+    #: load per ready replica that counts as saturation for the brownout
+    #: ladder (only consulted while pinned at ``max_replicas``).
+    brownout_load_per_replica: float = 6.0
+    #: sustained saturation needed to climb one brownout stage.
+    brownout_hold_s: float = 0.5
+    #: sustained calm needed to clear the ladder back to stage 0.
+    brownout_clear_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) < "
+                f"min_replicas ({self.min_replicas})"
+            )
+        if self.down_load_per_replica >= self.up_load_per_replica:
+            raise ValueError(
+                "down_load_per_replica must sit strictly below "
+                f"up_load_per_replica, got {self.down_load_per_replica} >= "
+                f"{self.up_load_per_replica}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSignal:
+    """One tick's measured load, assembled by the supervisor from its
+    request ledger and the replicas' heartbeat telemetry snapshots."""
+
+    #: supervisor-side backlog: due-but-unadmitted trace entries plus the
+    #: re-dispatch queue (work that exists but no replica holds yet).
+    backlog: int = 0
+    #: sum of worker-reported queue depths (one heartbeat stale).
+    queue_depth: int = 0
+    #: replicas that are ready AND not retiring — real serving capacity.
+    ready: int = 1
+    #: replicas alive but not yet ready (warmup after spawn/respawn) —
+    #: capacity that is already on its way.
+    warming: int = 0
+    #: total fleet membership including still-warming spawns and the
+    #: retiring replica — what the max_replicas ceiling clamps.
+    total: int = 1
+    #: cumulative sheds observed (context for logs; not a decision input).
+    shed_total: int = 0
+    #: fleet-wide TTFT p50 seconds from worker heartbeats (0 = unknown).
+    ttft_p50: float = 0.0
+    #: committed tokens in flight across tenants (context for logs).
+    tokens_in_flight: int = 0
+
+    @property
+    def load_per_replica(self) -> float:
+        """Outstanding work per unit of actual capacity — the one number
+        the thresholds compare against."""
+        return (self.backlog + self.queue_depth) / max(self.ready, 1)
+
+
+class AutoscalerPolicy:
+    """The decision core. The supervisor feeds it one :class:`LoadSignal`
+    per control tick; it answers "scale now?" and "what brownout stage?".
+    Every decision — including vetoes — is returned so the supervisor can
+    account it (``scale_events == spawned + retired + vetoed``)."""
+
+    def __init__(self, config: AutoscalerConfig) -> None:
+        self.config = config
+        #: monotonic time scale signals became (and stayed) armed, or None.
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        #: end of the current cooldown window.
+        self._cooldown_until = float("-inf")
+        #: brownout ladder state.
+        self.stage = 0
+        self._hot_since: Optional[float] = None
+        self._calm_since: Optional[float] = None
+
+    # -- cooldown sources ----------------------------------------------------
+    def note_scale_event(self, now: float) -> None:
+        self._cooldown_until = now + self.config.cooldown_s
+
+    def note_respawn(self, now: float) -> None:
+        """A failover respawn just happened. Capacity is already in
+        flux — hold further scale decisions for one cooldown so the
+        recovery and the autoscaler don't fight."""
+        self._cooldown_until = now + self.config.cooldown_s
+
+    def in_cooldown(self, now: float) -> bool:
+        return now < self._cooldown_until
+
+    # -- scale decision ------------------------------------------------------
+    def decide(
+        self, now: float, sig: LoadSignal
+    ) -> Optional[tuple[str, str]]:
+        """One control tick. Returns ``None`` (no decision due) or
+        ``(direction, outcome)`` with direction ``"up"``/``"down"`` and
+        outcome ``"ok"`` or ``"vetoed:<why>"``. An ``"ok"`` means the
+        caller MUST perform the scale action (and call
+        :meth:`note_scale_event`); a veto is a decision that fired and
+        was clamped — it re-arms the hysteresis window like any other."""
+        cfg = self.config
+        load = sig.load_per_replica
+        # Arm/disarm the persistent-signal windows every tick, even during
+        # cooldown — cooldown delays the decision, not the measurement.
+        if load > cfg.up_load_per_replica:
+            self._up_since = now if self._up_since is None else self._up_since
+        else:
+            self._up_since = None
+        if load < cfg.down_load_per_replica and sig.backlog == 0:
+            self._down_since = (
+                now if self._down_since is None else self._down_since
+            )
+        else:
+            self._down_since = None
+
+        if self.in_cooldown(now):
+            return None
+        if (
+            self._up_since is not None
+            and now - self._up_since >= cfg.hysteresis_s
+        ):
+            if sig.warming > 0:
+                # Capacity is already materializing: hold the armed signal
+                # (no veto, no re-arm) until the spawn reaches ready —
+                # load divides by ready replicas, so firing again now
+                # would double-count the same overload.
+                return None
+            self._up_since = None  # decision fired: re-arm from scratch
+            if sig.total >= cfg.max_replicas:
+                self.note_scale_event(now)  # standing veto: once/cooldown
+                return "up", "vetoed:max_replicas"
+            return "up", "ok"
+        if (
+            self._down_since is not None
+            and now - self._down_since >= cfg.hysteresis_s
+        ):
+            self._down_since = None
+            # Clamp against READY capacity as well as total membership: if
+            # a replica just died, total may still read above the floor
+            # while actual capacity is already at (or below) it — retiring
+            # another replica then could race the fleet to zero.
+            if sig.ready <= cfg.min_replicas or sig.total <= cfg.min_replicas:
+                self.note_scale_event(now)
+                return "down", "vetoed:min_replicas"
+            return "down", "ok"
+        return None
+
+    # -- retire victim selection ---------------------------------------------
+    @staticmethod
+    def pick_retire(costs: Mapping[int, tuple[int, int]]) -> int:
+        """Choose the cheapest replica to retire. ``costs`` maps replica
+        id -> (prefix_ledger_size, outstanding): the coldest radix cache
+        loses the least locality, fewest outstanding drains fastest; ties
+        break on lowest id (deterministic)."""
+        if not costs:
+            raise ValueError("pick_retire needs at least one candidate")
+        return min(costs, key=lambda r: (costs[r][0], costs[r][1], r))
+
+    # -- brownout ladder -----------------------------------------------------
+    def brownout(self, now: float, sig: LoadSignal) -> int:
+        """Advance/clear the overload ladder; returns the current stage.
+        Only saturation WHILE PINNED at max_replicas escalates — if the
+        fleet can still scale up, scaling is the answer, not degradation."""
+        cfg = self.config
+        hot = (
+            sig.total >= cfg.max_replicas
+            and sig.warming == 0  # pinned AND everything already serving
+            and sig.load_per_replica > cfg.brownout_load_per_replica
+        )
+        if hot:
+            self._calm_since = None
+            if self._hot_since is None:
+                self._hot_since = now
+            if self.stage < 3 and now - self._hot_since >= cfg.brownout_hold_s:
+                self.stage += 1
+                self._hot_since = now  # each rung needs its own hold period
+        else:
+            self._hot_since = None
+            if self.stage > 0:
+                if self._calm_since is None:
+                    self._calm_since = now
+                if now - self._calm_since >= cfg.brownout_clear_s:
+                    self.stage = 0
+                    self._calm_since = None
+        return self.stage

@@ -236,6 +236,13 @@ class DisaggregatedEngine:
             return True
         return self.prefill.cancel(req) or self.decode.cancel(req)
 
+    def set_brownout(self, stage: int) -> None:
+        """Apply the brownout ladder to both roles. Admission only happens
+        at the prefill door, but the decode scheduler carries the stage
+        too so telemetry and policy reads agree across the split."""
+        self.prefill.set_brownout(stage)
+        self.decode.set_brownout(stage)
+
     @property
     def handoff_depth(self) -> int:
         return len(self.prefill.handoff)

@@ -786,6 +786,10 @@ class ServingEngine:
             _sanitizer.attach_registry(registry)
         self._spec = None
         self._verify_fn = None
+        #: brownout stage 2+ (``set_brownout``) suspends speculative
+        #: drafts — the verify/accept loop's greedy parity makes falling
+        #: back to plain decode a throughput change, never a token change.
+        self.spec_suspended = False
         if engine.spec_k > 0:
             from deeplearning_mpi_tpu.serving.speculative import (
                 SpeculativeDecoder,
@@ -1037,6 +1041,14 @@ class ServingEngine:
             return True
         return False
 
+    def set_brownout(self, stage: int) -> None:
+        """Apply the overload brownout ladder (fleet autoscaler): stage 1+
+        sheds lowest-priority tenants at the admission door, stage 2+
+        additionally suspends speculative drafts, stage 3 raises the
+        deadline floor (all door policy lives in the scheduler)."""
+        self.scheduler.set_brownout(stage)
+        self.spec_suspended = stage >= 2
+
     def step(self) -> list[Request]:
         """One engine iteration: shed expired → admit → one prefill chunk
         per PREFILL slot → grow/evict for KV pressure → one batched decode
@@ -1148,7 +1160,7 @@ class ServingEngine:
             self._inc("serve_decode_held_steps")
             decoding = []
         if decoding:
-            if self._spec is not None:
+            if self._spec is not None and not self.spec_suspended:
                 self._spec_decode(decoding, finished)
             else:
                 self._plain_decode(decoding, finished)

@@ -57,7 +57,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -65,38 +64,27 @@ from collections import deque
 from pathlib import Path
 from typing import Any, Mapping, Optional
 
+from deeplearning_mpi_tpu.resilience.cluster import (
+    ClusterSupervisor,
+    kill_and_reap,
+    scrub_rendezvous_env,
+    tail_jsonl,
+)
+
 __all__ = ["FleetFailure", "FleetResult", "FleetSupervisor", "worker_main"]
 
 FLEET_RESTARTS = "fleet_replica_restarts_total"
 FLEET_FAILURES = "fleet_replica_failures_total"
 FLEET_REDISPATCH = "fleet_redispatch_total"
 
+# The JSONL-tail reader moved into the unified supervision core
+# (resilience/cluster.py); the historical name stays importable here.
+_tail_jsonl = tail_jsonl
+
 
 class FleetFailure(RuntimeError):
     """The fleet cannot meet its contract (restart budget spent, run
     timeout, every replica gone)."""
-
-
-def _tail_jsonl(path: Path, offset: int) -> tuple[list[dict], int]:
-    """Read the complete JSONL records appended past ``offset``. Only
-    newline-terminated lines are consumed — a partial trailing line (the
-    writer died mid-write, or the write raced this read) stays unread
-    until its newline lands."""
-    try:
-        with open(path, "rb") as f:
-            f.seek(offset)
-            data = f.read()
-    except OSError:
-        return [], offset
-    end = data.rfind(b"\n")
-    if end < 0:
-        return [], offset
-    chunk = data[: end + 1]
-    out = []
-    for line in chunk.splitlines():
-        if line.strip():
-            out.append(json.loads(line))
-    return out, offset + len(chunk)
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +260,11 @@ def worker_main(argv: list[str] | None = None) -> int:
                     version = int(m["version"])
                     emit({"op": "swapped", "version": version,
                           "compile_total": compile_counter.value})
+                elif op == "brownout":
+                    # Overload ladder from the autoscaler: door policy is
+                    # replica-local (each scheduler sheds at its own door),
+                    # so a stage broadcast reaches every admission point.
+                    engine.set_brownout(int(m["stage"]))
                 elif op == "stop":
                     stop = True
 
@@ -353,6 +346,9 @@ class _Replica:
     compile_at_ready: Optional[float] = None
     compile_flat: bool = True
     stopped: Optional[dict] = None
+    #: last heartbeat payload observed — the autoscaler's load signal
+    #: (queue_depth et al.) reads it without re-parsing the file.
+    last_hb: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -394,9 +390,20 @@ class FleetResult:
     swap: dict[str, Any]
     requests: dict[int, dict]  # rid -> {"tokens", "version", ...} (wins only)
     snapshot: dict[str, Any]
+    #: autoscaler accounting (empty when autoscaling is off):
+    #: {"events", "spawned", "retired", "vetoed", "brownout_stage_max",
+    #:  "replicas_final"} — events == spawned + retired + vetoed is a
+    #: reconciliation invariant checked into ``ok``.
+    scale: dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: tenant -> {shed_reason -> count} over the supervisor's ledger — the
+    #: brownout acceptance check reads it (only the lowest-priority tier
+    #: may shed with reason "brownout").
+    shed_by_tenant: dict[str, dict[str, int]] = dataclasses.field(
+        default_factory=dict
+    )
 
 
-class FleetSupervisor:
+class FleetSupervisor(ClusterSupervisor):
     """Spawn N replica workers, route a trace through them, survive
     replica loss, and prove the books balance.
 
@@ -405,7 +412,15 @@ class FleetSupervisor:
     so replicas are constructed from *specs*, never pickled arrays
     (params rebuild from ``(config, seed, version)``; a weight swap ships
     a new seed the same way).
+
+    The supervision bones — liveness tracking, SIGKILL+reap teardown,
+    chaos books, JSONL IPC tailing — come from the unified core
+    (:class:`~deeplearning_mpi_tpu.resilience.cluster.ClusterSupervisor`),
+    shared with the training pod supervisor; this class owns the
+    mailbox/router/ledger semantics.
     """
+
+    log_name = "fleet"
 
     def __init__(
         self,
@@ -431,19 +446,30 @@ class FleetSupervisor:
         disagg: bool = False,
         tp: int = 1,
         tenants: dict[str, dict[str, Any]] | None = None,
+        autoscale: Any = None,
     ) -> None:
         from deeplearning_mpi_tpu.resilience.faults import (
+            AUTOSCALE_KINDS,
             FLEET_KINDS,
             validate_plan_kinds,
         )
-        from deeplearning_mpi_tpu.telemetry import MetricsRegistry
 
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        super().__init__(
+            fleet_dir,
+            chaos=chaos,
+            heartbeat_deadline_s=heartbeat_deadline_s,
+            heartbeat_interval_s=heartbeat_interval_s,
+            spawn_grace_s=spawn_grace_s,
+            poll_interval_s=poll_interval_s,
+            registry=registry,
+            env=env,
+        )
         self.model_spec = dict(model_spec)
         self.engine_spec = dict(engine_spec)
         self.num_replicas = num_replicas
-        self.fleet_dir = Path(fleet_dir)
+        self.fleet_dir = self.dir
         self.seed = seed
         self.eos_id = eos_id
         self.warmup = warmup
@@ -459,25 +485,29 @@ class FleetSupervisor:
         #: scheduler enforces budgets replica-locally (no global ledger;
         #: the trace's tenant labels ride along with each dispatch).
         self.tenants = dict(tenants) if tenants else None
-        self.chaos_spec = chaos or os.environ.get("DMT_CHAOS") or ""
-        if self.chaos_spec.strip():
-            validate_plan_kinds(
-                self.chaos_spec, FLEET_KINDS, workload="serving fleet"
+        #: AutoscalerConfig enabling closed-loop fleet sizing; None keeps
+        #: the fixed-size fleet bit-identical to its pre-autoscaler self.
+        self.autoscale = autoscale
+        if autoscale is not None and not (
+            autoscale.min_replicas <= num_replicas <= autoscale.max_replicas
+        ):
+            raise ValueError(
+                f"num_replicas ({num_replicas}) outside the autoscale band "
+                f"[{autoscale.min_replicas}, {autoscale.max_replicas}]"
             )
+        if self.chaos_spec.strip():
+            supported = FLEET_KINDS
+            workload = "serving fleet"
+            if autoscale is not None:
+                # The supervisor-detonated drill kinds are only meaningful
+                # with the control loop running.
+                supported = FLEET_KINDS | AUTOSCALE_KINDS
+                workload = "autoscaled serving fleet"
+            validate_plan_kinds(self.chaos_spec, supported, workload=workload)
         self.hedge_ms = hedge_ms
-        self.heartbeat_deadline_s = heartbeat_deadline_s
-        self.heartbeat_interval_s = heartbeat_interval_s
-        self.spawn_grace_s = spawn_grace_s
-        self.poll_interval_s = poll_interval_s
         self.exclusion_s = exclusion_s
         self.max_replica_restarts = max_replica_restarts
         self.timeout_s = timeout_s
-        self.extra_env = dict(env or {})
-        self._own_registry = registry is None
-        self.registry = registry or MetricsRegistry()
-
-    def _log(self, msg: str) -> None:
-        print(f"fleet: {msg}", flush=True)
 
     # -- spawning ------------------------------------------------------------
     def _replica_chaos(self) -> dict[int, str]:
@@ -492,7 +522,7 @@ class FleetSupervisor:
         return {k: ",".join(v) for k, v in per.items()}
 
     def _spawn(self, rep: _Replica) -> None:
-        from deeplearning_mpi_tpu.resilience.pod import (
+        from deeplearning_mpi_tpu.resilience.cluster import (
             ENV_HEARTBEAT_INTERVAL,
         )
 
@@ -525,8 +555,7 @@ class FleetSupervisor:
             env.pop("DMT_CHAOS", None)
         # A replica is a lone process — leftover rendezvous vars from a
         # surrounding pod run would make its jax runtime wait for peers.
-        for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
-            env.pop(k, None)
+        scrub_rendezvous_env(env)
         log_path = self.fleet_dir / f"replica{rep.idx}-a{rep.attempt}.log"
         rep.log = log_path.open("w")  # dmt-lint: disable=DMT004 — stdout capture stream, not a consumed JSON artifact
         rep.proc = subprocess.Popen(
@@ -545,13 +574,7 @@ class FleetSupervisor:
         rep.ready = False
         rep.compile_at_ready = None
         rep.inbox = (rdir / "inbox.jsonl").open("a")
-        from deeplearning_mpi_tpu.resilience.pod import LivenessTracker
-
-        rep.tracker = LivenessTracker(
-            [0],
-            deadline_s=self.heartbeat_deadline_s,
-            grace_s=self.spawn_grace_s,
-        )
+        rep.tracker = self.new_tracker([0])
         self._log(
             f"replica {rep.idx} attempt {rep.attempt}: spawned pid "
             f"{rep.proc.pid} (version {rep.version}, "
@@ -564,15 +587,8 @@ class FleetSupervisor:
 
     @staticmethod
     def _kill(rep: _Replica) -> None:
-        if rep.proc is not None and rep.proc.poll() is None:
-            try:
-                os.killpg(rep.proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                rep.proc.kill()
-            try:
-                rep.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                pass
+        if rep.proc is not None:
+            kill_and_reap(rep.proc)
         if rep.log is not None:
             rep.log.close()
             rep.log = None
@@ -594,26 +610,24 @@ class FleetSupervisor:
         ``swap_seed`` set, a rolling :meth:`swap_weights` begins once
         ``swap_at`` requests have completed — under live load, by design.
         """
-        from deeplearning_mpi_tpu.resilience.faults import (
-            ChaosInjector,
-            FaultPlan,
-        )
         from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
         from deeplearning_mpi_tpu.serving.router import Router
-        from deeplearning_mpi_tpu.telemetry import JsonlSink
         from deeplearning_mpi_tpu.telemetry.registry import labeled
 
-        self.fleet_dir.mkdir(parents=True, exist_ok=True)
-        self.registry.add_sink(
-            JsonlSink(self.fleet_dir / "fleet_metrics.jsonl")
-        )
-        injector: ChaosInjector | None = None
-        if self.chaos_spec.strip():
-            injector = ChaosInjector(
-                FaultPlan.parse(self.chaos_spec), registry=self.registry
-            )
+        injector = self._open_books("fleet_metrics.jsonl")
         for name in (FLEET_RESTARTS, FLEET_FAILURES, FLEET_REDISPATCH):
             self.registry.counter(name)
+        policy = None
+        if self.autoscale is not None:
+            from deeplearning_mpi_tpu.serving.autoscaler import (
+                AutoscalerPolicy,
+                LoadSignal,
+            )
+
+            policy = AutoscalerPolicy(self.autoscale)
+            # Explicit zeros so a scale-free autoscaled run still reports.
+            self.registry.counter("fleet_scale_total")
+            self.registry.counter("fleet_brownout_total")
         router = Router(
             range(self.num_replicas),
             hedge_ms=self.hedge_ms,
@@ -633,7 +647,13 @@ class FleetSupervisor:
             router.exclude(rep.idx)  # ineligible until its ready lands
             self._spawn(rep)
 
-        t0 = time.monotonic()
+        start = time.monotonic()
+        # The trace clock starts at the fleet's first ready-ack, not at
+        # spawn: arrival offsets time SERVING traffic, and a cold-cache
+        # warmup that outlasted the trickle window would collapse every
+        # trace into one undifferentiated burst (and hand the autoscaler
+        # a huge "backlog" on a fleet that cannot serve anything yet).
+        t0: Optional[float] = None
         pending = deque(sorted(entries, key=lambda e: e["arrival"]))
         ledger: dict[int, _Req] = {}
         next_rid = 0
@@ -663,6 +683,14 @@ class FleetSupervisor:
         swap_mark = 0
         target_version = 0
         stopping = False
+        # -- autoscaler state (all inert when policy is None) --
+        next_idx = self.num_replicas  # replica ids are never reused
+        scale_events = spawned = retired = vetoed = 0
+        scale_ups = 0  # ordinal for the scale_during_failure trigger
+        brownout_stage = 0
+        brownout_stage_max = 0
+        retiring: Optional[int] = None  # replica mid-drain, at most one
+        retire_stop_sent = False
 
         def close_recovery(pr: dict, now: float) -> None:
             if injector is not None:
@@ -728,6 +756,11 @@ class FleetSupervisor:
                 rep.chaos_spec = strip_entries(rep.chaos_spec, fired)
             rep.attempt += 1
             self._spawn(rep)
+            if policy is not None:
+                # Capacity is already in flux from the respawn: hold scale
+                # decisions for one cooldown so failover can't thrash the
+                # autoscaler (and vice versa).
+                policy.note_respawn(now)
 
         from deeplearning_mpi_tpu.serving.prefix_cache import prefix_signature
 
@@ -785,7 +818,10 @@ class FleetSupervisor:
                 for pr in list(pending_recoveries):
                     if pr["rids"] and rid in pr["rids"]:
                         pr["rids"].discard(rid)
-                        if not pr["rids"]:
+                        # load_spike recoveries also wait for every spike
+                        # entry to be ADMITTED ("awaiting"), not just for
+                        # the already-admitted rids to resolve.
+                        if not pr["rids"] and not pr.get("awaiting"):
                             close_recovery(pr, now)
                     elif (
                         pr["kind"] == "replica_slow"
@@ -805,7 +841,7 @@ class FleetSupervisor:
                 for pr in list(pending_recoveries):
                     if pr["rids"] and rid in pr["rids"] and rec.resolved:
                         pr["rids"].discard(rid)
-                        if not pr["rids"]:
+                        if not pr["rids"] and not pr.get("awaiting"):
                             close_recovery(pr, now)
             elif op == "fault":
                 hit = (
@@ -850,7 +886,11 @@ class FleetSupervisor:
         try:
             while True:
                 now = time.monotonic()
-                if now - t0 > self.timeout_s:
+                if t0 is None and any(
+                    r.ready for r in replicas.values()
+                ):
+                    t0 = now
+                if now - start > self.timeout_s:
                     raise FleetFailure(
                         f"run exceeded timeout_s={self.timeout_s}"
                     )
@@ -861,6 +901,7 @@ class FleetSupervisor:
                     rep.tracker.observe(0, payload)
                     if payload is not None:
                         router.observe(rep.idx, payload)
+                        rep.last_hb = payload
 
                 # 2. worker messages.
                 for rep in replicas.values():
@@ -969,8 +1010,292 @@ class FleetSupervisor:
                         "mid-swap)"
                     )
 
-                # 8. admit due trace entries.
-                while pending and t0 + pending[0]["arrival"] <= now:
+                # 7.5 autoscale control tick (inert without a policy, and
+                # held until the trace clock starts — scaling a fleet that
+                # has never served would react to warmup, not load).
+                if policy is not None and t0 is not None:
+                    # load_spike chaos: a planned synthetic burst detonates
+                    # once `at` requests have completed — the scale-up path
+                    # must absorb it (recovery closes when every spike
+                    # request resolves).
+                    if injector is not None:
+                        for s in injector.plan.specs:
+                            if (
+                                s.kind == "load_spike"
+                                and not s.fired
+                                and completed >= s.at
+                            ):
+                                injector.fire_observed("load_spike")
+                                hi = max(
+                                    int(
+                                        self.model_spec.get(
+                                            "vocab_size", 256
+                                        )
+                                    )
+                                    - 1,
+                                    2,
+                                )
+                                burst = [
+                                    {
+                                        "arrival": now - t0,
+                                        "prompt": [
+                                            (13 * i + j) % hi
+                                            for j in range(8)
+                                        ],
+                                        "max_new": 4,
+                                        "spike": True,
+                                    }
+                                    for i in range(8)
+                                ]
+                                pending = deque(sorted(
+                                    list(pending) + burst,
+                                    key=lambda e: e["arrival"],
+                                ))
+                                pending_recoveries.append({
+                                    "kind": "load_spike", "replica": -1,
+                                    "detected": now, "rids": set(),
+                                    "awaiting": len(burst),
+                                })
+                                phase = "during"
+                                self._log(
+                                    f"chaos: load_spike — injected "
+                                    f"{len(burst)} synthetic request(s)"
+                                )
+
+                    # Retire drain progression (at most one in flight).
+                    if retiring is not None:
+                        vrep = replicas[retiring]
+                        if vrep.stopped is not None:
+                            self._kill(vrep)
+                            del replicas[retiring]
+                            router.remove_replica(retiring)
+                            retired += 1
+                            self._log(
+                                f"autoscale: replica {retiring} retired "
+                                f"(fleet now {len(replicas)})"
+                            )
+                            retiring = None
+                            retire_stop_sent = False
+                        elif not vrep.ready:
+                            # Died mid-drain and was respawned by the
+                            # failure path: re-drain once it's back.
+                            retire_stop_sent = False
+                        elif (
+                            not retire_stop_sent
+                            and not router.outstanding_on(retiring)
+                        ):
+                            # Zero-drop drain complete: ask it to stop.
+                            self._send(vrep, {"op": "stop"})
+                            retire_stop_sent = True
+
+                    # Assemble this tick's load signal. Queue pressure per
+                    # replica is max(worker-reported depth, router
+                    # outstanding minus slot capacity): heartbeats lag one
+                    # interval, but the router's dispatch ledger is fresh
+                    # THIS tick — without the floor, a just-dispatched
+                    # burst reads as zero load until the next beat and a
+                    # fast engine can drain before the up-signal ever
+                    # persists.
+                    due = sum(
+                        1 for e in pending if t0 + e["arrival"] <= now
+                    )
+                    slots_cap = int(self.engine_spec.get("max_slots", 1))
+                    sig = LoadSignal(
+                        backlog=due + len(redispatch_queue),
+                        queue_depth=sum(
+                            max(
+                                int(r.last_hb.get("queue_depth", 0))
+                                if r.last_hb is not None else 0,
+                                len(router.outstanding_on(r.idx))
+                                - slots_cap,
+                            )
+                            for r in replicas.values()
+                            if r.ready and r.idx != retiring
+                        ),
+                        ready=sum(
+                            1
+                            for r in replicas.values()
+                            if r.ready
+                            and r.idx != retiring
+                            and r.proc is not None
+                            and r.proc.poll() is None
+                        ),
+                        warming=sum(
+                            1
+                            for r in replicas.values()
+                            if not r.ready
+                            and r.proc is not None
+                            and r.proc.poll() is None
+                        ),
+                        total=len(replicas),
+                        shed_total=sum(
+                            1
+                            for rec in ledger.values()
+                            if rec.shed_reason is not None
+                        ),
+                        ttft_p50=max(
+                            [
+                                float(r.last_hb.get("ttft_p50") or 0.0)
+                                for r in replicas.values()
+                                if r.last_hb is not None
+                            ]
+                            or [0.0]
+                        ),
+                        tokens_in_flight=sum(
+                            len(rec.prompt) + rec.max_new
+                            for rec in ledger.values()
+                            if not rec.resolved
+                        ),
+                    )
+                    self.registry.gauge("fleet_replicas").set(len(replicas))
+
+                    decision = (
+                        policy.decide(now, sig)
+                        if retiring is None and sig.ready > 0
+                        else None
+                    )
+                    if decision is not None:
+                        direction, outcome = decision
+                        victim: Optional[int] = None
+                        if direction == "down" and outcome == "ok":
+                            cand = {
+                                r.idx: (
+                                    router.prefix_ledger_size(r.idx),
+                                    len(router.outstanding_on(r.idx)),
+                                )
+                                for r in replicas.values()
+                                if r.ready
+                                and r.proc is not None
+                                and r.proc.poll() is None
+                            }
+                            if cand:
+                                victim = policy.pick_retire(cand)
+                            else:
+                                outcome = "vetoed:no_ready_candidate"
+                                policy.note_scale_event(now)
+                        scale_events += 1
+                        self.registry.counter("fleet_scale_total").inc()
+                        self.registry.counter(labeled(
+                            "fleet_scale_total",
+                            direction=direction,
+                            outcome="ok" if outcome == "ok" else "vetoed",
+                        )).inc()
+                        if outcome != "ok":
+                            vetoed += 1
+                            self._log(
+                                f"autoscale: {direction} {outcome} "
+                                f"(load/replica "
+                                f"{sig.load_per_replica:.2f})"
+                            )
+                        elif direction == "up":
+                            policy.note_scale_event(now)
+                            newr = _Replica(
+                                idx=next_idx,
+                                # Spawn at the fleet's CURRENT weights —
+                                # a scale-up during/after a rolling swap
+                                # must serve the target version.
+                                seed=(
+                                    swap_seed
+                                    if target_version > 0 else self.seed
+                                ),
+                                version=target_version,
+                            )
+                            next_idx += 1
+                            replicas[newr.idx] = newr
+                            router.add_replica(
+                                newr.idx,
+                                role="disagg" if self.disagg else None,
+                            )
+                            # A cold replica never eats live traffic:
+                            # excluded until its ready-ack lands (the
+                            # ready handler includes it).
+                            router.exclude(newr.idx)
+                            self._spawn(newr)
+                            spawned += 1
+                            scale_ups += 1
+                            self._log(
+                                f"autoscale: scale-up -> replica "
+                                f"{newr.idx} warming (load/replica "
+                                f"{sig.load_per_replica:.2f}, fleet "
+                                f"{len(replicas)})"
+                            )
+                            # scale_during_failure chaos: SIGKILL a live
+                            # replica during the `at`-th scale-up, while
+                            # the new replica is still warming.
+                            if injector is not None:
+                                for s in injector.plan.specs:
+                                    if (
+                                        s.kind == "scale_during_failure"
+                                        and not s.fired
+                                        and s.at <= scale_ups
+                                    ):
+                                        live = [
+                                            r
+                                            for r in replicas.values()
+                                            if r.idx != newr.idx
+                                            and r.idx != retiring
+                                            and r.ready
+                                            and r.proc is not None
+                                            and r.proc.poll() is None
+                                        ]
+                                        if live:
+                                            handle_failure(
+                                                min(
+                                                    live,
+                                                    key=lambda r: r.idx,
+                                                ),
+                                                "scale_during_failure",
+                                                "chaos SIGKILL "
+                                                "mid-scale-up",
+                                            )
+                                        break
+                        else:
+                            policy.note_scale_event(now)
+                            retiring = victim
+                            retire_stop_sent = False
+                            router.mark_retired(victim)
+                            self._log(
+                                f"autoscale: scale-down — retiring "
+                                f"replica {victim} (prefix ledger "
+                                f"{cand[victim][0]}, outstanding "
+                                f"{cand[victim][1]})"
+                            )
+
+                    # Brownout ladder: escalate/clear + broadcast changes
+                    # (held while nothing is ready — a fleet that cannot
+                    # serve is cold, not saturated).
+                    stage = (
+                        policy.brownout(now, sig)
+                        if sig.ready > 0 else brownout_stage
+                    )
+                    if stage != brownout_stage:
+                        self.registry.counter("fleet_brownout_total").inc()
+                        self.registry.counter(labeled(
+                            "fleet_brownout_total", stage=str(stage)
+                        )).inc()
+                        self._log(
+                            f"brownout: stage {brownout_stage} -> {stage} "
+                            f"(load/replica {sig.load_per_replica:.2f})"
+                        )
+                        for r in replicas.values():
+                            if (
+                                r.proc is not None
+                                and r.proc.poll() is None
+                            ):
+                                self._send(
+                                    r,
+                                    {"op": "brownout", "stage": stage},
+                                )
+                        brownout_stage = stage
+                        brownout_stage_max = max(brownout_stage_max, stage)
+
+                # 8. admit due trace entries (held until the trace clock
+                # starts at first ready).
+                while (
+                    t0 is not None
+                    and pending
+                    and t0 + pending[0]["arrival"] <= now
+                ):
                     target = router.select(
                         now,
                         prefix_sig=prefix_signature(
@@ -995,6 +1320,17 @@ class FleetSupervisor:
                         ),
                         tenant=str(e.get("tenant", "default")),
                     )
+                    if e.get("spike"):
+                        # Tie the admitted spike request back to its open
+                        # load_spike recovery.
+                        for pr in pending_recoveries:
+                            if (
+                                pr["kind"] == "load_spike"
+                                and pr.get("awaiting")
+                            ):
+                                pr["awaiting"] -= 1
+                                pr["rids"].add(rid)
+                                break
                     dispatch(rid, target, now)
 
                 # 9. done?
@@ -1002,6 +1338,7 @@ class FleetSupervisor:
                     not pending
                     and not redispatch_queue
                     and swap_stage is None
+                    and retiring is None
                     and all(r.resolved for r in ledger.values())
                     and (swap["performed"] or swap_seed is None)
                 ):
@@ -1042,9 +1379,12 @@ class FleetSupervisor:
             return d[int(q * (len(d) - 1))]
 
         shed: dict[str, int] = {}
+        shed_by_tenant: dict[str, dict[str, int]] = {}
         for rec in ledger.values():
             if rec.shed_reason is not None:
                 shed[rec.shed_reason] = shed.get(rec.shed_reason, 0) + 1
+                per = shed_by_tenant.setdefault(rec.tenant, {})
+                per[rec.shed_reason] = per.get(rec.shed_reason, 0) + 1
         dropped = sum(1 for rec in ledger.values() if not rec.resolved)
         compile_flat = all(r.compile_flat for r in replicas.values())
         chaos_balanced = injector.balanced() if injector else None
@@ -1055,11 +1395,13 @@ class FleetSupervisor:
             for ph, vals in ttft_by_phase.items()
             for name, q in (("p50", 0.50), ("p99", 0.99))
         }
+        scale_balanced = scale_events == spawned + retired + vetoed
         ok = (
             dropped == 0
             and compile_flat
             and (chaos_balanced is not False)
             and (swap["performed"] or swap_seed is None)
+            and scale_balanced
         )
         values: dict[str, Any] = {
             **self.registry.snapshot(),
@@ -1074,6 +1416,25 @@ class FleetSupervisor:
             "swap_completions_during": swap["completions_during"],
             "compile_flat": compile_flat,
         }
+        scale_summary: dict[str, Any] = {}
+        if self.autoscale is not None:
+            scale_summary = {
+                "events": scale_events,
+                "spawned": spawned,
+                "retired": retired,
+                "vetoed": vetoed,
+                "brownout_stage_max": brownout_stage_max,
+                "replicas_final": len(replicas),
+            }
+            values.update({
+                "scale_events": scale_events,
+                "scale_spawned": spawned,
+                "scale_retired": retired,
+                "scale_vetoed": vetoed,
+                "scale_balanced": scale_balanced,
+                "brownout_stage_max": brownout_stage_max,
+                "replicas_final": len(replicas),
+            })
         if chaos_balanced is not None:
             values["chaos_balanced"] = chaos_balanced
         for key, v in ttft_summary.items():
@@ -1100,11 +1461,14 @@ class FleetSupervisor:
                     "max_new": rec.max_new,
                     "redispatched": rec.redispatched,
                     "ttft": rec.ttft,
+                    "tenant": rec.tenant,
                 }
                 for rid, rec in ledger.items()
                 if rec.tokens is not None
             },
             snapshot=self.registry.snapshot(),
+            scale=scale_summary,
+            shed_by_tenant=shed_by_tenant,
         )
         if self._own_registry:
             self.registry.close()

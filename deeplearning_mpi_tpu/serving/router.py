@@ -47,6 +47,11 @@ class _Replica:
     dead: bool = False
     #: manual drain flag (rolling weight swap): excluded until include()d.
     draining: bool = False
+    #: terminal drain flag (autoscaler scale-down): the replica is being
+    #: retired and will be removed once its outstanding work finishes.
+    #: Unlike ``draining``, retirement is one-way — ``include`` cannot
+    #: resurrect a retired replica.
+    retired: bool = False
     #: monotonic time before which a once-dead replica stays ineligible.
     excluded_until: float = 0.0
     #: prefix signature -> last dispatch time carrying it. A replica that
@@ -99,6 +104,40 @@ class Router:
 
     def role(self, replica: int) -> str:
         return self._roles.get(replica, "colocated")
+
+    # -- membership (autoscaler) ---------------------------------------------
+    def add_replica(self, replica: int, *, role: Optional[str] = None) -> None:
+        """Register a scale-up replica. It starts cold — callers should
+        :meth:`exclude` it until its ready-ack arrives."""
+        replica = int(replica)
+        if replica in self._replicas:
+            raise ValueError(f"replica {replica} already registered")
+        self._replicas[replica] = _Replica()
+        if role is not None:
+            self._roles[replica] = role
+
+    def mark_retired(self, replica: int) -> list[int]:
+        """Begin retiring ``replica`` (scale-down): no new dispatches, ever
+        again — including via prefix affinity, so its signature ledger is
+        cleared NOW, not at removal (affinity scoring must not steer new
+        same-prefix requests at a replica mid-drain). Returns the rids
+        still outstanding on it, which the caller drains to zero before
+        :meth:`remove_replica`."""
+        state = self._replicas[replica]
+        state.retired = True
+        state.prefix_sigs.clear()
+        return self.outstanding_on(replica)
+
+    def remove_replica(self, replica: int) -> None:
+        """Drop a fully drained, retired replica from the fleet view."""
+        self._replicas.pop(replica, None)
+        self._roles.pop(replica, None)
+
+    def prefix_ledger_size(self, replica: int) -> int:
+        """How many prefix signatures this replica's affinity ledger holds
+        — the autoscaler's retire-victim cost signal (fewest signatures =
+        coldest radix cache = cheapest to lose)."""
+        return len(self._replicas[replica].prefix_sigs)
 
     # -- telemetry in --------------------------------------------------------
     def observe(self, replica: int, snapshot: dict) -> None:
@@ -155,7 +194,10 @@ class Router:
         return [
             r
             for r, s in sorted(self._replicas.items())
-            if not s.dead and not s.draining and now >= s.excluded_until
+            if not s.dead
+            and not s.draining
+            and not s.retired
+            and now >= s.excluded_until
         ]
 
     # -- selection -----------------------------------------------------------

@@ -91,6 +91,8 @@ class Request:
     #: | "evicted" | "spec_overflow" (KV pool could not cover the request's
     #: own next position while assembling a speculative verify batch)
     #: | "tenant_budget" (the tenant's committed-token budget is spent)
+    #: | "brownout" (overload ladder: low-priority or tight-deadline
+    #: traffic rejected at the door while the fleet is saturated)
     shed_reason: Optional[str] = None
     slot: Optional[int] = None
     blocks: list[int] = dataclasses.field(default_factory=list)
@@ -146,6 +148,7 @@ class Scheduler:
         max_hold_steps: int = 4,
         prefix_cache: Any = None,
         tenants: dict[str, dict[str, Any]] | None = None,
+        brownout_min_deadline_s: float = 0.25,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -171,6 +174,12 @@ class Scheduler:
         #: "priority": float (higher admits first)}. Unknown tenants get
         #: unlimited budget at priority 0.
         self.tenants: dict[str, dict[str, Any]] = dict(tenants or {})
+        #: overload brownout ladder stage (``set_brownout``): 0 = off,
+        #: 1+ = shed lowest-priority tenants at the door, 2+ = the engine
+        #: additionally disables speculative drafts, 3 = additionally shed
+        #: requests whose deadline budget is under the floor below.
+        self.brownout_stage = 0
+        self.brownout_min_deadline_s = brownout_min_deadline_s
         #: pending copy-on-write jobs from matched-prefix admissions:
         #: (src_block, dst_block, request). The engine drains this each
         #: step (``_phase_cow``) BEFORE prefilling; src carries an extra
@@ -189,6 +198,30 @@ class Scheduler:
             return False
         if len(self.queue) >= self.max_queue:
             self._shed(req, "queue_full")
+            return False
+        if self.brownout_stage >= 1 and self.tenants:
+            # Stage 1+: shed only tenants strictly BELOW the top priority
+            # tier — paying / deadline-priority tenants keep admitting
+            # until capacity itself runs out (queue_full / tenant_budget
+            # still apply). With no tiers configured (or all tiers equal)
+            # there is no "lowest tenant" to sacrifice and the gate is
+            # inert; stages 2-3 still bite via the draft kill-switch and
+            # the deadline floor.
+            top = max(
+                float(c.get("priority", 0.0)) for c in self.tenants.values()
+            )
+            if self._tenant_priority(req) < top:
+                self._shed(req, "brownout")
+                return False
+        if (
+            self.brownout_stage >= 3
+            and req.deadline is not None
+            and req.deadline - req.arrival < self.brownout_min_deadline_s
+        ):
+            # Stage 3: raise the deadline floor — a request with almost no
+            # SLO budget left would burn prefill only to be deadline-shed;
+            # reject it at the door instead.
+            self._shed(req, "brownout")
             return False
         budget = int(self.tenants.get(req.tenant, {}).get("budget_tokens", 0))
         if budget > 0:
@@ -215,6 +248,12 @@ class Scheduler:
 
     def _tenant_priority(self, req: Request) -> float:
         return float(self.tenants.get(req.tenant, {}).get("priority", 0.0))
+
+    def set_brownout(self, stage: int) -> None:
+        """Move the overload brownout ladder (0 clears it). Monotonic per
+        call site only by convention — the supervisor drives both
+        escalation and the clear."""
+        self.brownout_stage = int(stage)
 
     # -- per-step phases ----------------------------------------------------
     def shed_expired(self, now: float) -> list[Request]:
@@ -504,7 +543,10 @@ class Scheduler:
 
             self.registry.counter("serve_shed_total").inc()
             self.registry.counter(labeled("serve_shed_total", reason=reason)).inc()
-            if reason == "tenant_budget":
+            if reason in ("tenant_budget", "brownout"):
+                # Per-tenant attribution for door-level policy sheds: the
+                # brownout acceptance check ("only low-priority tenants
+                # shed before any deadline-priority request") reads this.
                 self.registry.counter(
                     labeled("serve_tenant_shed_total", tenant=req.tenant)
                 ).inc()

@@ -21,7 +21,17 @@ __all__ = ["LABEL_KEYS", "METRICS", "is_canonical"]
 
 #: Every label key any ``labeled(...)`` call may use.
 LABEL_KEYS: frozenset[str] = frozenset(
-    {"dtype", "kind", "outcome", "reason", "replica", "role", "tenant"}
+    {
+        "direction",
+        "dtype",
+        "kind",
+        "outcome",
+        "reason",
+        "replica",
+        "role",
+        "stage",
+        "tenant",
+    }
 )
 
 #: name -> (kind, {allowed label keys}). Kind is one of
@@ -81,6 +91,10 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "fleet_replica_failures_total": ("counter", frozenset({"kind"})),
     "fleet_replica_restarts_total": ("counter", frozenset()),
     "serve_hedge_total": ("counter", frozenset({"outcome"})),
+    # -- fleet autoscaler (PR 13) -------------------------------------------
+    "fleet_brownout_total": ("counter", frozenset({"stage"})),
+    "fleet_replicas": ("gauge", frozenset()),
+    "fleet_scale_total": ("counter", frozenset({"direction", "outcome"})),
     # -- chaos / resilience (PR 3/5) ----------------------------------------
     "fault_injected_total": ("counter", frozenset({"kind"})),
     "recovery_latency_s": ("histogram", frozenset()),

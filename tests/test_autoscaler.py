@@ -1,0 +1,426 @@
+"""Autoscaler policy, retire routing, and brownout admission — all on
+fake clocks. The policy half of fleet autoscaling is pure host-side
+Python (deterministic function of config + clock + load signal), so every
+stabilizer — hysteresis, cooldown-after-respawn, floor/ceiling clamps,
+warming hold, brownout ladder — is pinned here without spawning a fleet.
+The mechanism half (supervised spawn, zero-drop drain) lives in
+``tools/autoscale_drill.py`` / ``tests/test_multiprocess.py``.
+"""
+
+import pytest
+
+from deeplearning_mpi_tpu.resilience.faults import (
+    AUTOSCALE_KINDS,
+    FAULT_UNITS,
+    FLEET_KINDS,
+)
+from deeplearning_mpi_tpu.serving.autoscaler import (
+    AutoscalerConfig,
+    AutoscalerPolicy,
+    LoadSignal,
+)
+from deeplearning_mpi_tpu.serving.kv_pool import PagedKVPool
+from deeplearning_mpi_tpu.serving.router import Router
+from deeplearning_mpi_tpu.serving.scheduler import Request, Scheduler
+
+
+def _cfg(**kw):
+    base = dict(
+        min_replicas=1,
+        max_replicas=4,
+        up_load_per_replica=3.0,
+        down_load_per_replica=0.25,
+        hysteresis_s=1.0,
+        cooldown_s=5.0,
+        brownout_load_per_replica=6.0,
+        brownout_hold_s=1.0,
+        brownout_clear_s=2.0,
+    )
+    base.update(kw)
+    return AutoscalerConfig(**base)
+
+
+def _sig(load, *, ready=2, total=None, warming=0, backlog=None):
+    """LoadSignal with load_per_replica == ``load`` (expressed entirely
+    as worker queue depth unless ``backlog`` is forced)."""
+    qd = int(load * ready) if backlog is None else 0
+    return LoadSignal(
+        backlog=backlog or 0,
+        queue_depth=qd,
+        ready=ready,
+        warming=warming,
+        total=total if total is not None else ready + warming,
+    )
+
+
+class TestConfigValidation:
+    def test_rejects_zero_floor(self):
+        with pytest.raises(ValueError):
+            _cfg(min_replicas=0)
+
+    def test_rejects_ceiling_below_floor(self):
+        with pytest.raises(ValueError):
+            _cfg(min_replicas=3, max_replicas=2)
+
+    def test_rejects_inverted_thresholds(self):
+        with pytest.raises(ValueError):
+            _cfg(down_load_per_replica=3.0, up_load_per_replica=3.0)
+
+
+class TestHysteresis:
+    def test_one_hot_tick_is_not_a_trend(self):
+        p = AutoscalerPolicy(_cfg())
+        assert p.decide(0.0, _sig(10.0)) is None  # arms
+        assert p.decide(0.5, _sig(10.0)) is None  # still inside the window
+
+    def test_sustained_signal_fires_after_window(self):
+        p = AutoscalerPolicy(_cfg())
+        p.decide(0.0, _sig(10.0))
+        assert p.decide(1.0, _sig(10.0)) == ("up", "ok")
+
+    def test_signal_dropout_rearms_from_scratch(self):
+        p = AutoscalerPolicy(_cfg())
+        p.decide(0.0, _sig(10.0))
+        p.decide(0.9, _sig(0.5))  # dipped below: window resets
+        assert p.decide(1.0, _sig(10.0)) is None  # re-armed at t=1.0
+        assert p.decide(1.9, _sig(10.0)) is None
+        assert p.decide(2.0, _sig(10.0)) == ("up", "ok")
+
+    def test_decision_rearms_the_window(self):
+        p = AutoscalerPolicy(_cfg(cooldown_s=0.0))
+        p.decide(0.0, _sig(10.0))
+        assert p.decide(1.0, _sig(10.0)) == ("up", "ok")
+        # Even with no cooldown, the very next tick must re-persist.
+        assert p.decide(1.01, _sig(10.0)) is None
+        assert p.decide(2.5, _sig(10.0)) == ("up", "ok")
+
+
+class TestCooldown:
+    def test_cooldown_after_scale_event(self):
+        p = AutoscalerPolicy(_cfg())
+        p.decide(0.0, _sig(10.0))
+        assert p.decide(1.0, _sig(10.0)) == ("up", "ok")
+        p.note_scale_event(1.0)
+        # Armed again at 1.01, window met at 2.01 — but cooldown runs to
+        # 6.0 and delays the DECISION, not the measurement.
+        for t in (1.01, 2.01, 5.9):
+            assert p.decide(t, _sig(10.0)) is None
+        assert p.decide(6.0, _sig(10.0)) == ("up", "ok")
+
+    def test_failover_respawn_holds_scaling(self):
+        """A chaos kill already changes capacity — the supervisor's
+        failure handler must be able to pause the autoscaler so the two
+        loops don't fight."""
+        p = AutoscalerPolicy(_cfg())
+        p.decide(0.0, _sig(10.0))
+        p.note_respawn(0.5)  # cooldown until 5.5
+        assert p.decide(1.0, _sig(10.0)) is None
+        assert p.decide(5.4, _sig(10.0)) is None
+        assert p.decide(5.5, _sig(10.0)) == ("up", "ok")
+
+    def test_standing_veto_is_recorded_once_per_cooldown(self):
+        p = AutoscalerPolicy(_cfg())
+        p.decide(0.0, _sig(10.0, ready=4, total=4))
+        assert p.decide(1.0, _sig(10.0, ready=4, total=4)) == (
+            "up", "vetoed:max_replicas",
+        )
+        # The veto started a cooldown: no per-tick veto spam.
+        assert p.decide(1.01, _sig(10.0, ready=4, total=4)) is None
+        assert p.decide(5.9, _sig(10.0, ready=4, total=4)) is None
+        assert p.decide(7.0, _sig(10.0, ready=4, total=4)) == (
+            "up", "vetoed:max_replicas",
+        )
+
+
+class TestClamps:
+    def test_up_vetoed_at_ceiling_counts_warming_spawns(self):
+        p = AutoscalerPolicy(_cfg(max_replicas=3))
+        p.decide(0.0, _sig(10.0, ready=3, total=3))
+        assert p.decide(1.0, _sig(10.0, ready=3, total=3)) == (
+            "up", "vetoed:max_replicas",
+        )
+
+    def test_down_vetoed_at_floor(self):
+        p = AutoscalerPolicy(_cfg(min_replicas=2))
+        p.decide(0.0, _sig(0.0, ready=2, total=2))
+        assert p.decide(1.0, _sig(0.0, ready=2, total=2)) == (
+            "down", "vetoed:min_replicas",
+        )
+
+    def test_down_vetoed_against_ready_when_a_replica_is_dead(self):
+        """total=3 sits above the floor, but only 2 are actually serving:
+        retiring one more could race a concurrent death to zero."""
+        p = AutoscalerPolicy(_cfg(min_replicas=2))
+        sig = LoadSignal(backlog=0, queue_depth=0, ready=2, warming=1,
+                         total=3)
+        p.decide(0.0, sig)
+        assert p.decide(1.0, sig) == ("down", "vetoed:min_replicas")
+
+    def test_down_requires_empty_backlog(self):
+        """Supervisor-side backlog is work no replica holds yet — load
+        may read near zero while it exists, but retiring then would
+        shrink the fleet into known pending work."""
+        p = AutoscalerPolicy(_cfg())
+        sig = _sig(0.0, ready=8, backlog=1)
+        for t in (0.0, 1.0, 2.0, 3.0):
+            assert p.decide(t, sig) is None
+
+    def test_warming_capacity_holds_up_decisions_without_veto(self):
+        p = AutoscalerPolicy(_cfg())
+        hot_warming = _sig(10.0, ready=2, warming=1)
+        p.decide(0.0, hot_warming)
+        # Window elapsed, but a spawn is mid-warmup: hold (no veto, no
+        # re-arm) — load divides by ready only, so firing again would
+        # double-count the same overload.
+        assert p.decide(1.0, hot_warming) is None
+        assert p.decide(2.0, hot_warming) is None
+        # The instant the spawn reaches ready, the held signal fires.
+        assert p.decide(2.1, _sig(10.0, ready=3)) == ("up", "ok")
+
+
+class TestPickRetire:
+    def test_coldest_prefix_ledger_wins(self):
+        assert AutoscalerPolicy.pick_retire(
+            {0: (5, 0), 1: (0, 9), 2: (3, 0)}
+        ) == 1
+
+    def test_ties_break_on_outstanding_then_id(self):
+        assert AutoscalerPolicy.pick_retire(
+            {0: (2, 4), 1: (2, 1), 2: (2, 4)}
+        ) == 1
+        assert AutoscalerPolicy.pick_retire(
+            {2: (2, 4), 0: (2, 4)}
+        ) == 0
+
+    def test_no_candidates_raises(self):
+        with pytest.raises(ValueError):
+            AutoscalerPolicy.pick_retire({})
+
+
+class TestBrownoutLadder:
+    def _pinned(self, load=10.0, warming=0):
+        return _sig(load, ready=4, warming=warming,
+                    total=4 + warming)
+
+    def test_climbs_one_rung_per_hold_period(self):
+        p = AutoscalerPolicy(_cfg())
+        assert p.brownout(0.0, self._pinned()) == 0
+        assert p.brownout(0.5, self._pinned()) == 0
+        assert p.brownout(1.0, self._pinned()) == 1
+        assert p.brownout(1.5, self._pinned()) == 1  # each rung re-holds
+        assert p.brownout(2.0, self._pinned()) == 2
+        assert p.brownout(3.0, self._pinned()) == 3
+        assert p.brownout(9.0, self._pinned()) == 3  # ladder tops out
+
+    def test_only_saturation_at_the_ceiling_escalates(self):
+        """If the fleet can still scale up, scaling is the answer, not
+        degradation."""
+        p = AutoscalerPolicy(_cfg())
+        roomy = _sig(10.0, ready=2, total=2)  # below max_replicas=4
+        for t in (0.0, 1.0, 2.0, 5.0):
+            assert p.brownout(t, roomy) == 0
+
+    def test_warming_capacity_blocks_escalation(self):
+        p = AutoscalerPolicy(_cfg(max_replicas=4))
+        for t in (0.0, 1.0, 2.0):
+            assert p.brownout(t, self._pinned(warming=1)) == 0
+
+    def test_clears_only_after_sustained_calm(self):
+        p = AutoscalerPolicy(_cfg())
+        p.brownout(0.0, self._pinned())
+        assert p.brownout(1.0, self._pinned()) == 1
+        calm = self._pinned(load=0.0)
+        assert p.brownout(1.5, calm) == 1  # calm begins
+        assert p.brownout(3.0, calm) == 1  # 1.5s calm < clear_s=2.0
+        assert p.brownout(3.5, calm) == 0  # 2.0s calm: cleared
+
+    def test_calm_interrupted_restarts_the_clear_clock(self):
+        p = AutoscalerPolicy(_cfg())
+        p.brownout(0.0, self._pinned())
+        assert p.brownout(1.0, self._pinned()) == 1
+        p.brownout(1.5, self._pinned(load=0.0))
+        p.brownout(2.5, self._pinned())  # hot again: calm resets
+        assert p.brownout(3.6, self._pinned(load=0.0)) == 1
+        assert p.brownout(5.5, self._pinned(load=0.0)) == 1
+        assert p.brownout(5.7, self._pinned(load=0.0)) == 0
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+class TestRouterRetire:
+    def _router(self, n=2):
+        clock = FakeClock()
+        return Router(range(n), clock=clock), clock
+
+    def test_mark_retired_returns_outstanding_for_drain(self):
+        router, _ = self._router()
+        router.dispatch(7, 0)
+        router.dispatch(8, 1)
+        assert router.mark_retired(0) == [7]
+        assert router.outstanding_on(0) == [7]  # still draining
+
+    def test_retired_replica_leaves_eligibility_and_stays_out(self):
+        router, _ = self._router()
+        router.mark_retired(0)
+        assert router.eligible() == [1]
+        # include() (the ready-ack path) must NOT resurrect a retiring
+        # replica — only remove_replica ends the retirement.
+        router.include(0)
+        assert router.eligible() == [1]
+
+    def test_mark_retired_clears_prefix_ledger(self):
+        """A drained replica's radix cache is about to be freed — leaving
+        its prefix signatures in the affinity ledger would steer requests
+        at a replica mid-drain."""
+        router, _ = self._router()
+        router.dispatch(1, 0, prefix_sig=0xBEEF)
+        router.on_complete(1, 0, ttft=0.01)
+        assert router.prefix_ledger_size(0) == 1
+        # Affinity currently steers sig 0xBEEF to replica 0.
+        assert router.select(prefix_sig=0xBEEF) == 0
+        router.mark_retired(0)
+        assert router.prefix_ledger_size(0) == 0
+        assert router.select(prefix_sig=0xBEEF) == 1
+
+    def test_add_replica_joins_cold_and_excluded_callers_gate_ready(self):
+        router, _ = self._router()
+        router.add_replica(2)
+        router.exclude(2)  # supervisor excludes until ready-ack
+        assert router.eligible() == [0, 1]
+        router.include(2)
+        assert router.eligible() == [0, 1, 2]
+
+    def test_add_replica_rejects_duplicate_ids(self):
+        router, _ = self._router()
+        with pytest.raises(ValueError):
+            router.add_replica(1)
+
+    def test_remove_replica_completes_the_retirement(self):
+        router, _ = self._router()
+        router.mark_retired(0)
+        router.remove_replica(0)
+        assert router.eligible() == [1]
+        router.add_replica(2)
+        assert router.eligible() == [1, 2]
+
+
+def _req(rid, prompt_len=4, max_new=4, arrival=0.0, deadline=None,
+         tenant="default"):
+    import numpy as np
+
+    return Request(
+        rid=rid,
+        prompt=np.arange(1, prompt_len + 1, dtype=np.int32),
+        max_new_tokens=max_new,
+        arrival=arrival,
+        deadline=deadline,
+        tenant=tenant,
+    )
+
+
+class TestSchedulerBrownout:
+    def _sched(self, tenants=None, **kw):
+        pool = PagedKVPool(16, 4)
+        return Scheduler(pool, max_slots=2, max_seq_len=32, max_queue=64,
+                         tenants=tenants, **kw)
+
+    TIERS = {
+        "gold": {"budget_tokens": 0, "priority": 1.0},
+        "free": {"budget_tokens": 0, "priority": 0.0},
+    }
+
+    def test_stage1_sheds_only_below_top_priority(self):
+        sched = self._sched(tenants=self.TIERS)
+        sched.set_brownout(1)
+        free = _req(0, tenant="free")
+        assert not sched.submit(free)
+        assert free.shed_reason == "brownout"
+        gold = _req(1, tenant="gold")
+        assert sched.submit(gold)
+
+    def test_stage1_sheds_unconfigured_tenants_below_a_paying_tier(self):
+        sched = self._sched(tenants=self.TIERS)
+        sched.set_brownout(1)
+        anon = _req(0, tenant="default")  # unconfigured => priority 0
+        assert not sched.submit(anon)
+        assert anon.shed_reason == "brownout"
+
+    def test_stage1_is_inert_without_priority_tiers(self):
+        """No tenants configured => there is no 'lowest tier' to
+        sacrifice; brownout must not turn into shed-everything (stages
+        2-3 still act via the draft kill-switch and deadline floor)."""
+        sched = self._sched(tenants=None)
+        sched.set_brownout(3)
+        assert sched.submit(_req(0))
+
+    def test_stage1_is_inert_when_all_tiers_are_equal(self):
+        sched = self._sched(tenants={
+            "a": {"priority": 0.5}, "b": {"priority": 0.5},
+        })
+        sched.set_brownout(1)
+        assert sched.submit(_req(0, tenant="a"))
+        assert sched.submit(_req(1, tenant="b"))
+
+    def test_stage3_raises_the_deadline_floor_for_everyone(self):
+        sched = self._sched(tenants=self.TIERS,
+                            brownout_min_deadline_s=0.25)
+        sched.set_brownout(3)
+        tight = _req(0, arrival=0.0, deadline=0.1, tenant="gold")
+        assert not sched.submit(tight)
+        assert tight.shed_reason == "brownout"
+        roomy = _req(1, arrival=0.0, deadline=1.0, tenant="gold")
+        assert sched.submit(roomy)
+
+    def test_stage1_does_not_apply_the_deadline_floor(self):
+        sched = self._sched(tenants=self.TIERS,
+                            brownout_min_deadline_s=0.25)
+        sched.set_brownout(1)
+        tight = _req(0, arrival=0.0, deadline=0.1, tenant="gold")
+        assert sched.submit(tight)
+
+    def test_per_tenant_shed_counters(self):
+        from deeplearning_mpi_tpu.telemetry.registry import (
+            MetricsRegistry,
+            labeled,
+        )
+
+        registry = MetricsRegistry()
+        sched = self._sched(tenants=self.TIERS, registry=registry)
+        sched.set_brownout(1)
+        for rid in range(3):
+            sched.submit(_req(rid, tenant="free"))
+        sched.submit(_req(3, tenant="gold"))
+        snap = registry.snapshot()
+        assert snap[labeled("serve_tenant_shed_total", tenant="free")] == 3
+        assert labeled(
+            "serve_tenant_shed_total", tenant="gold"
+        ) not in snap
+
+    def test_clearing_brownout_reopens_the_door(self):
+        sched = self._sched(tenants=self.TIERS)
+        sched.set_brownout(1)
+        assert not sched.submit(_req(0, tenant="free"))
+        sched.set_brownout(0)
+        assert sched.submit(_req(1, tenant="free"))
+
+
+class TestAutoscaleFaultKinds:
+    def test_kinds_registered_with_step_unit(self):
+        assert AUTOSCALE_KINDS == {"load_spike", "scale_during_failure"}
+        for kind in AUTOSCALE_KINDS:
+            assert FAULT_UNITS[kind] == "step"
+
+    def test_disjoint_from_fleet_kinds(self):
+        """AUTOSCALE_KINDS detonate in the supervisor itself;
+        ``fleet_entries`` filters per-replica chaos to FLEET_KINDS, so
+        the sets must stay disjoint or a spec would detonate twice."""
+        assert not (AUTOSCALE_KINDS & FLEET_KINDS)

@@ -352,6 +352,67 @@ def test_fleet_hedges_around_slow_replica(tmp_path):
     assert out["parity_checked"] == out["completed"] > 0
 
 
+# -- fleet autoscaler drill ---------------------------------------------------
+
+def _autoscale_drill_module():
+    """Import tools/autoscale_drill.py by path (script, not a package)."""
+    import importlib.util
+
+    drill = REPO / "tools" / "autoscale_drill.py"
+    spec = importlib.util.spec_from_file_location("autoscale_drill", drill)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_autoscaler_scales_2_to_3_to_1_with_parity(tmp_path):
+    """Full closed-loop trajectory without chaos: a 2-replica fleet under
+    a saturating burst scales up to the 3-replica ceiling, then the
+    trickle tail drain-retires twice back to the 1-replica floor — every
+    replica spawned supervised (warmup + ready-ack before the router sees
+    it), every retire a zero-drop drain, and every completed stream
+    bit-identical to offline greedy across BOTH scale events. The scale
+    books must reconcile: events == spawned + retired + vetoed."""
+    d = _autoscale_drill_module()
+    from deeplearning_mpi_tpu.serving.autoscaler import AutoscalerConfig
+
+    autoscale = AutoscalerConfig(
+        min_replicas=1,
+        max_replicas=3,
+        up_load_per_replica=3.0,
+        down_load_per_replica=0.25,
+        hysteresis_s=0.2,
+        cooldown_s=0.8,
+    )
+    # Burst deep enough that load/replica clears the up-threshold on TWO
+    # warm replicas; the trickle tail gives the down-signal repeated calm
+    # windows (hysteresis + cooldown per retire) to step 3 -> 2 -> 1. The
+    # tail must outlast the scaled-up replica's warmup on a CONTENDED box
+    # (a slow spawn holds the fleet at ready=1, which min_replicas vetoes)
+    # plus two full drain-retire cycles — hence ~19 s of arrivals.
+    entries = d._trace(48, 24, trickle_dt=0.8, max_new=12)
+    result = d._run_fleet(
+        tmp_path / "drill",
+        num_replicas=2,
+        autoscale=autoscale,
+        chaos=None,
+        entries=entries,
+    )
+
+    s = result.scale
+    assert s["spawned"] >= 1, f"never scaled up: {s}"
+    assert s["retired"] >= 2, f"expected two drain-retires: {s}"
+    assert s["replicas_final"] == 1, s
+    assert s["events"] == s["spawned"] + s["retired"] + s["vetoed"], s
+    assert result.dropped == 0
+    assert result.restarts == 0  # no chaos: every exit is commanded
+    checked = d._check_parity(result)
+    shed = sum(result.shed.values())
+    assert checked == result.completed == len(entries) - shed > 0
+
+
 @pytest.mark.slow
 @pytest.mark.multiprocess
 @pytest.mark.parametrize("fault", ["rank_kill", "rank_hang"])

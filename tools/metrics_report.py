@@ -136,6 +136,47 @@ def _fleet_table(last: dict) -> str:
     return table("Serving fleet", rows)
 
 
+def _autoscaler_table(last: dict) -> str:
+    """Autoscaler accounting from a ``fleet_summary`` record (present only
+    when the fleet ran with ``autoscale=``): scale decisions with their
+    reconciliation invariant (events = spawned + retired + vetoed), the
+    fleet-size trajectory, and the brownout ladder's high-water mark with
+    per-stage escalation counts."""
+    if last.get("scale_events") is None:
+        return ""
+    rows = [("fleet size (start -> final)",
+             f"{_fmt(last.get('replicas'))} -> "
+             f"{_fmt(last.get('replicas_final'))}"),
+            ("scale books (events = spawned + retired + vetoed)",
+             f"{_fmt(last.get('scale_events'))} = "
+             f"{_fmt(last.get('scale_spawned'))} + "
+             f"{_fmt(last.get('scale_retired'))} + "
+             f"{_fmt(last.get('scale_vetoed'))} "
+             f"(balanced={_fmt(last.get('scale_balanced'))})")]
+    for direction in ("up", "down"):
+        for outcome in ("ok", "vetoed"):
+            v = last.get(
+                f'fleet_scale_total{{direction="{direction}",'
+                f'outcome="{outcome}"}}'
+            )
+            if v is not None:
+                rows.append((f"scale {direction} decisions ({outcome})",
+                             _fmt(v)))
+    rows.append(("brownout stage (max reached)",
+                 _fmt(last.get("brownout_stage_max"))))
+    for stage in ("1", "2", "3", "0"):
+        v = last.get(f'fleet_brownout_total{{stage="{stage}"}}')
+        if v is not None:
+            label = ("brownout clears (back to stage 0)" if stage == "0"
+                     else f"brownout escalations to stage {stage}")
+            rows.append((label, _fmt(v)))
+    for key, v in sorted(last.items()):
+        if key.startswith('serve_tenant_shed_total{'):
+            tenant = key.split('"')[1]
+            rows.append((f"tenant {tenant}: door sheds", _fmt(v)))
+    return table("Autoscaler", rows)
+
+
 def _serving_table(last: dict) -> str:
     """A serve_lm run's end-of-run snapshot (``serve_summary``): delivery
     and latency numbers, plus — for a disaggregated run — the per-role
@@ -336,6 +377,9 @@ def summarize(records: list[dict]) -> str:
 
     if fleet:
         out.append(_fleet_table(fleet[-1]))
+        autoscaler = _autoscaler_table(fleet[-1])
+        if autoscaler:
+            out.append(autoscaler)
 
     sanitized = [r for r in records
                  if any(k.startswith("sanitize_") for k in r)]
@@ -422,6 +466,18 @@ def _selftest() -> int:
             "swap_completions_during": 9, "compile_flat": True,
             "fault_injected_total": 2, "recovery_total": 2,
             "rollback_total": 0, "chaos_balanced": True,
+            # Autoscaler accounting (fleet run with autoscale=): the scale
+            # books, the per-direction decision counters, and the brownout
+            # ladder must render their own table.
+            "scale_events": 7, "scale_spawned": 2, "scale_retired": 2,
+            "scale_vetoed": 3, "scale_balanced": True,
+            "brownout_stage_max": 1, "replicas_final": 1,
+            'fleet_scale_total{direction="up",outcome="ok"}': 2,
+            'fleet_scale_total{direction="down",outcome="ok"}': 2,
+            'fleet_scale_total{direction="down",outcome="vetoed"}': 3,
+            'fleet_brownout_total{stage="1"}': 1,
+            'fleet_brownout_total{stage="0"}': 1,
+            'serve_tenant_shed_total{tenant="best_effort"}': 4,
         })
         # A DMT_SANITIZE=1 run's tripwire books (analysis/sanitizer.py):
         # the drill's injections show up as counted trips, a healthy run
@@ -441,7 +497,14 @@ def _selftest() -> int:
                        "MFU issued", "MFU gap", "overlap fraction",
                        "hedges fired", "replica restarts",
                        "failover recovery p50", "swap downtime",
-                       "chaos books", "prefill: TTFT", "decode: TPOT",
+                       "chaos books", "scale books",
+                       "scale up decisions (ok)",
+                       "scale down decisions (vetoed)",
+                       "brownout stage (max reached)",
+                       "brownout escalations to stage 1",
+                       "brownout clears (back to stage 0)",
+                       "tenant best_effort: door sheds",
+                       "prefill: TTFT", "decode: TPOT",
                        "handoffs prefill", "KV pool bytes (int8)",
                        "hit rate (of admissions)", "prefill tokens reused",
                        "copy-on-write copies", "LRU evictions",
