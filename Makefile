@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
@@ -17,7 +17,7 @@ SHELL := /bin/bash
 # serving-fleet replica-failure drill, the disaggregated prefill/decode
 # drill, the radix prefix-cache drill, and the fleet-autoscaler surge
 # drill without touching the ROADMAP command itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -188,3 +188,16 @@ fleet-smoke:
 autoscale-smoke:
 	env JAX_PLATFORMS=cpu python tools/autoscale_drill.py --fault surge \
 		--root /tmp/dmt_autoscale_smoke
+
+# Distributed-tracing drill (docs/OBSERVABILITY.md "Distributed request
+# tracing"): a 2-replica disaggregated fleet replays a trace with the
+# flight recorder armed while chaos kills replica 0 mid-decode. The
+# merged per-process JSONL (tools/trace_report.py) must cover every
+# completed request — queue+prefill+handoff+decode+stream spans within
+# 5% of measured TTLT — with zero orphan spans, and the killed replica
+# must leave its flight dump behind. A short traced training run then
+# proves the per-phase step attribution tiles the epoch wall-clock and
+# mfu_gap decomposes into named phase shares.
+trace-smoke:
+	env JAX_PLATFORMS=cpu python tools/trace_drill.py \
+		--root /tmp/dmt_trace_smoke

@@ -1259,6 +1259,20 @@ def _child_main(args) -> int:
     return 0
 
 
+def _flight_dumps(reason: str) -> list[str]:
+    """Dump every live span recorder's flight ring (telemetry/spans.py)
+    and return the paths, so a failed entry's details point at the last
+    recorded moments instead of just the error string. Best-effort: no
+    recorders (tracing off) or a failed dump yields [] — the failure
+    report must never grow its own failure mode."""
+    try:
+        from deeplearning_mpi_tpu.telemetry import spans as _spans
+
+        return [str(p) for p in _spans.dump_all(reason)]
+    except Exception:
+        return []
+
+
 def _run_isolated(
     key: str, argv: list[str], budget_s: float,
     env: dict[str, str] | None = None,
@@ -1351,6 +1365,9 @@ def main() -> None:
                 )
                 if "failed" not in r:
                     r["degraded"] = f"cpu harness fallback: {probe_error}"
+                    dumps = _flight_dumps(f"bench-degraded-{key}")
+                    if dumps:
+                        r["flight_dumps"] = dumps
                     details[key] = r
                     print(json.dumps(
                         {"metric": metric, "value": r.get(value_key),
@@ -1358,13 +1375,20 @@ def main() -> None:
                          "error": probe_error}
                     ), flush=True)
                     return r
-            details[key] = {"failed": probe_error}
+            failed: dict = {"failed": probe_error}
+            dumps = _flight_dumps(f"bench-failed-{key}")
+            if dumps:
+                failed["flight_dumps"] = dumps
+            details[key] = failed
             print(json.dumps({"metric": metric, "value": None, "unit": unit,
                               "error": probe_error}), flush=True)
             return None
         r = _run_isolated(key, child_argv, budget_s or args.workload_timeout)
         details[key] = r
         if "failed" in r:
+            dumps = _flight_dumps(f"bench-failed-{key}")
+            if dumps:
+                r["flight_dumps"] = dumps
             print(json.dumps({"metric": metric, "value": None, "unit": unit,
                               "error": r["failed"]}), flush=True)
             return None

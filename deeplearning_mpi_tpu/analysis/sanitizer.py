@@ -94,13 +94,21 @@ def attach_registry(registry: Any) -> None:
 def trip(name: str, message: str) -> None:
     """Count a trip and raise. The count lands BEFORE the raise so a
     caller that catches (the drill) still sees it in :func:`trip_counts`
-    and in the attached registry's run summary."""
+    and in the attached registry's run summary. Any live flight recorders
+    dump here too — same reasoning: the evidence must land before the
+    exception starts unwinding whoever corrupted the state."""
     _trips[name] = _trips.get(name, 0) + 1
     if _registry is not None:
         try:
             _registry.counter(name).inc()
         except Exception:
             pass
+    try:
+        from deeplearning_mpi_tpu.telemetry import spans as _spans
+
+        _spans.dump_all(f"sanitizer-{name}")
+    except Exception:
+        pass  # a failed dump must never mask the trip itself
     raise SanitizerError(f"[{name}] {message}")
 
 

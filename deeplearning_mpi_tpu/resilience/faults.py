@@ -245,10 +245,23 @@ def strip_entries(spec: str, entries: list[str]) -> str:
     return ",".join(kept)
 
 
+def _dump_flight(reason: str) -> None:
+    """Best-effort flight-recorder dump before a detonation. ``os._exit``
+    skips atexit and the hang never returns, so this is the dying process's
+    only chance to leave its black box on disk."""
+    try:
+        from deeplearning_mpi_tpu.telemetry import spans as _spans
+
+        _spans.dump_all(reason)
+    except Exception:
+        pass  # the detonation must land regardless
+
+
 def _exit_rank(step: int) -> None:
     """``rank_kill`` lands here: a hard exit no in-process handler can catch
     — ``os._exit`` skips atexit/finally, exactly like a host loss. Module-
     level so tests can monkeypatch the detonation."""
+    _dump_flight(f"chaos-kill-step{step}")
     print(
         f"chaos: injected rank_kill@step:{step} — hard exit "
         f"{RANK_KILL_EXIT} (simulated host loss)",
@@ -261,6 +274,7 @@ def _hang_rank(step: int) -> None:
     """``rank_hang`` lands here: block the calling (training) thread forever.
     The heartbeat daemon thread keeps beating, so the file stays fresh while
     progress freezes — the signature of a hung collective."""
+    _dump_flight(f"chaos-hang-step{step}")
     print(
         f"chaos: injected rank_hang@step:{step} — training thread blocked "
         "(heartbeat daemon still beating)",

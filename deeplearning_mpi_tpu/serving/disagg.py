@@ -85,6 +85,7 @@ class PrefillEngine(ServingEngine):
         self.handoff: deque[Request] = deque()
 
     def _prefill_complete(self, req: Request) -> None:
+        req.t_detached = self._clock()
         self.scheduler.detach(req)
         self.handoff.append(req)
 
@@ -155,6 +156,7 @@ class DisaggregatedEngine:
         draft_config: TransformerConfig | None = None,
         draft_params: Any = None,
         tenants: dict[str, dict[str, Any]] | None = None,
+        tracer: Any = None,
     ) -> None:
         engine = engine or EngineConfig()
         storage = jnp.dtype(engine.kv_dtype) if engine.kv_dtype else None
@@ -163,6 +165,8 @@ class DisaggregatedEngine:
         self.chaos = chaos
         self.steps = 0
         self._metrics = registry
+        self._clock = clock
+        self._tracer = tracer
         self._stall_observed = False
         # ONE pool + ONE set of device buffers, shared by both roles: the
         # handoff transfers block-table ownership over pages that are
@@ -202,6 +206,7 @@ class DisaggregatedEngine:
             draft_config=draft_config, draft_params=draft_params,
             pool=self.pool, kv_buffers=kvh, draft_kv_buffers=draft_kvh,
             prefix_cache=self.prefix_cache, tenants=tenants,
+            tracer=tracer,
         )
         # serve_crash chaos stays with the prefill role — mid-admission +
         # partial prefill is the crash point recover() must untangle; the
@@ -289,8 +294,10 @@ class DisaggregatedEngine:
             self._stall_observed = False
         q = self.prefill.handoff
         while q:
-            if not self.decode.adopt(q[0]):
+            req = q[0]
+            if not self.decode.adopt(req):
                 break  # decode slots full; retry next step (backpressure)
+            req.t_adopted = self._clock()
             q.popleft()
             self._inc("serve_handoffs_total")
 

@@ -604,6 +604,7 @@ class ServingEngine:
         role: str | None = None,
         prefix_cache: Any = None,
         tenants: dict[str, dict[str, Any]] | None = None,
+        tracer: Any = None,
     ) -> None:
         engine = engine or EngineConfig()
         if config.moe_experts > 0:
@@ -693,6 +694,10 @@ class ServingEngine:
         self._next_rid = 0
         self.steps = 0
         self._metrics = registry
+        # Costless-off tracing (the DMT_SANITIZE pattern): None unless a
+        # SpanRecorder was injected; every hot-path hook is a single
+        # ``is not None`` test with no allocation behind it.
+        self._tracer = tracer
         if registry is not None:
             for name in (
                 "serve_requests_submitted", "serve_requests_admitted",
@@ -1002,6 +1007,7 @@ class ServingEngine:
         deadline: Optional[float] = None,
         arrival: Optional[float] = None,
         tenant: str = "default",
+        trace: Optional[str] = None,
     ) -> Request:
         """Enqueue one request (or shed it at the door — check
         ``req.state``). ``prompt`` is a 1-D int sequence.
@@ -1014,6 +1020,10 @@ class ServingEngine:
         In-process ``recover()`` already keeps it (``Scheduler.requeue``
         preserves ``arrival``/``deadline``); this extends the same
         contract across the process boundary.
+
+        ``trace`` is the cross-process span correlation key (the fleet
+        rid); it rides the request so every span this engine emits for it
+        stitches into the supervisor's timeline.
         """
         if max_new_tokens < 1:
             raise ValueError(
@@ -1026,6 +1036,7 @@ class ServingEngine:
             arrival=self._clock() if arrival is None else arrival,
             deadline=deadline,
             tenant=tenant,
+            trace=trace,
         )
         self._next_rid += 1
         self._inc("serve_requests_submitted")
@@ -1072,6 +1083,13 @@ class ServingEngine:
         self._phase_decode(decoding, finished)
         self.steps += 1
         self._set_gauges()
+        if self._tracer is not None:
+            # Feeds the flight ring: after a wedge, the ring's tail of
+            # engine_step events is the "last known good" timeline.
+            self._tracer.event(
+                "engine_step", step=self.steps,
+                role=self.role or "colocated", finished=len(finished),
+            )
         return finished
 
     # -- step phases ---------------------------------------------------------
@@ -1467,6 +1485,13 @@ class ServingEngine:
             # complete prefix from the first decode iteration.
             self._spec.prefill_chunk(table, chunk, start, n_valid)
         self._inc("serve_prefill_chunks")
+        if self._tracer is not None:
+            self._tracer.event(
+                "prefill_chunk",
+                trace=req.trace or f"rid{req.rid}",
+                start=start, n=n_valid,
+                role=self.role or "colocated",
+            )
         req.prefilled += n_valid
         if req.prefilled < req.prompt_len:
             return
@@ -1530,8 +1555,47 @@ class ServingEngine:
         self._inc("serve_requests_completed")
         if self._metrics is not None and req.tpot is not None:
             self._metrics.histogram("serve_tpot_s").observe(req.tpot)
+        if self._tracer is not None:
+            self._trace_request(req, now)
 
     # -- telemetry ----------------------------------------------------------
+    def _trace_request(self, req: Request, now: float) -> None:
+        """Emit the request's phase spans retroactively from its lifecycle
+        stamps — one call at retirement, no open-span tracking through the
+        scheduler. The phases tile ``arrival → t_finished`` exactly (the
+        only seam, first-token → detach in a disaggregated prefill, is two
+        host statements apart), which is what lets ``trace_report`` check
+        queue+prefill+handoff+decode against measured TTLT."""
+        tr = self._tracer
+        trace = req.trace or f"rid{req.rid}"
+        root = tr.record_span(
+            "request", req.arrival, now, trace=trace,
+            rid=req.rid, tenant=req.tenant, tokens=len(req.generated),
+            prompt_len=req.prompt_len,
+        )
+        if req.t_admitted is not None:
+            tr.record_span(
+                "queue", req.arrival, req.t_admitted,
+                trace=trace, parent=root.sid,
+            )
+            if req.t_first_token is not None:
+                tr.record_span(
+                    "prefill", req.t_admitted, req.t_first_token,
+                    trace=trace, parent=root.sid,
+                )
+        decode_t0 = req.t_first_token
+        if req.t_detached is not None and req.t_adopted is not None:
+            tr.record_span(
+                "handoff", req.t_detached, req.t_adopted,
+                trace=trace, parent=root.sid,
+            )
+            decode_t0 = req.t_adopted
+        if decode_t0 is not None:
+            tr.record_span(
+                "decode", decode_t0, now, trace=trace, parent=root.sid,
+                tokens=len(req.generated),
+            )
+
     def _inc(self, name: str, amount: float = 1.0) -> None:
         if self._metrics is not None and amount:
             self._metrics.counter(name).inc(amount)

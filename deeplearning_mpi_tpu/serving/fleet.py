@@ -168,6 +168,21 @@ def worker_main(argv: list[str] | None = None) -> int:
     params = init_params(int(spec["seed"]))
     registry = MetricsRegistry()
     chaos = ChaosInjector.from_spec(None, registry=registry)  # $DMT_CHAOS
+    # Per-process span recorder, configured through the spec (the
+    # supervisor owns the trace dir; the worker owns its clock offset).
+    # One file per (replica, pid): a respawned attempt is a NEW process
+    # and must not share a writer with its dead predecessor's file.
+    tracer = None
+    if spec.get("trace_dir"):
+        from deeplearning_mpi_tpu.telemetry import SpanRecorder
+
+        trace_dir = Path(spec["trace_dir"])
+        tracer = SpanRecorder(
+            trace_dir / f"trace_replica{args.replica}-{os.getpid()}.jsonl",
+            proc=f"replica{args.replica}",
+            registry=registry,
+            flight_dir=trace_dir / "flight",
+        )
     engine_cls: Any = ServingEngine
     if disagg:
         from deeplearning_mpi_tpu.serving.disagg import DisaggregatedEngine
@@ -178,6 +193,7 @@ def worker_main(argv: list[str] | None = None) -> int:
         dtype=jnp.float32, eos_id=spec.get("eos_id"),
         registry=registry, chaos=chaos,
         tenants=spec.get("tenants") or None,
+        tracer=tracer,
     )
     if disagg:
         eng_idle = engine.idle
@@ -203,9 +219,17 @@ def worker_main(argv: list[str] | None = None) -> int:
         outbox.write(json.dumps(obj) + "\n")
         outbox.flush()
 
+    # The monotonic-vs-epoch offset is what lets the supervisor (and
+    # trace_report) place this worker's spans on the fleet's shared
+    # wall-clock timeline; it rides the ready ack and every heartbeat.
+    mono_offset = (
+        tracer.mono_offset if tracer is not None
+        else time.time() - time.monotonic()
+    )
     emit({
         "op": "ready", "replica": args.replica, "pid": os.getpid(),
         "version": version, "compile_total": compile_counter.value,
+        "mono_offset": mono_offset,
     })
 
     inbox = rdir / "inbox.jsonl"
@@ -232,6 +256,7 @@ def worker_main(argv: list[str] | None = None) -> int:
                         np.asarray(m["prompt"], np.int32), int(m["max_new"]),
                         deadline=m.get("deadline"), arrival=m.get("arrival"),
                         tenant=m.get("tenant", "default"),
+                        trace=m.get("trace"),
                     )
                     if req.state is RequestState.SHED:
                         emit({"op": "shed", "rid": rid,
@@ -291,6 +316,10 @@ def worker_main(argv: list[str] | None = None) -> int:
                             "tokens": [int(t) for t in req.generated],
                             "version": version,
                             "ttft": req.ttft, "tpot": req.tpot,
+                            # CLOCK_MONOTONIC is system-wide: the finish
+                            # stamp lets the supervisor span the stream
+                            # leg (worker finish → supervisor receipt).
+                            "t_finished": req.t_finished,
                         })
                         del live[rid]
                     elif req.state is RequestState.SHED:
@@ -311,7 +340,14 @@ def worker_main(argv: list[str] | None = None) -> int:
                 "handoff_depth": handoff_depth(),
                 "ttft_p50": ttft_hist.percentile(0.5) or 0.0,
                 "version": version,
+                "mono_offset": mono_offset,
             }
+    except BaseException:
+        # Unclean exit: leave the black box. (A chaos replica_kill never
+        # reaches here — os._exit — so faults._exit_rank dumps instead.)
+        if tracer is not None:
+            tracer.dump_flight("worker-unclean-exit")
+        raise
     finally:
         hb.stop()
     emit({
@@ -320,6 +356,8 @@ def worker_main(argv: list[str] | None = None) -> int:
         "snapshot": registry.snapshot(),
     })
     outbox.close()
+    if tracer is not None:
+        tracer.close()
     return 0
 
 
@@ -447,6 +485,7 @@ class FleetSupervisor(ClusterSupervisor):
         tp: int = 1,
         tenants: dict[str, dict[str, Any]] | None = None,
         autoscale: Any = None,
+        trace_dir: str | Path | None = None,
     ) -> None:
         from deeplearning_mpi_tpu.resilience.faults import (
             AUTOSCALE_KINDS,
@@ -508,6 +547,21 @@ class FleetSupervisor(ClusterSupervisor):
         self.exclusion_s = exclusion_s
         self.max_replica_restarts = max_replica_restarts
         self.timeout_s = timeout_s
+        #: distributed tracing: when set, the supervisor and every worker
+        #: each write a SpanRecorder JSONL into this dir (workers get the
+        #: path via spec.json) and ``tools/trace_report.py`` merges them.
+        #: None keeps the whole fleet tracing-free (costless-off).
+        self.trace_dir = Path(trace_dir) if trace_dir else None
+        self.tracer: Any = None
+        if self.trace_dir is not None:
+            from deeplearning_mpi_tpu.telemetry import SpanRecorder
+
+            self.tracer = SpanRecorder(
+                self.trace_dir / "trace_supervisor.jsonl",
+                proc="supervisor",
+                registry=self.registry,
+                flight_dir=self.trace_dir / "flight",
+            )
 
     # -- spawning ------------------------------------------------------------
     def _replica_chaos(self) -> dict[int, str]:
@@ -544,6 +598,7 @@ class FleetSupervisor(ClusterSupervisor):
             "disagg": self.disagg,
             "tp": self.tp,
             "tenants": self.tenants,
+            "trace_dir": str(self.trace_dir) if self.trace_dir else None,
         })
         (rdir / "inbox.jsonl").touch()
         env = dict(os.environ)
@@ -709,6 +764,13 @@ class FleetSupervisor(ClusterSupervisor):
             failures[kind] = failures.get(kind, 0) + 1
             self.registry.counter(FLEET_FAILURES).inc()
             self.registry.counter(labeled(FLEET_FAILURES, kind=kind)).inc()
+            if self.tracer is not None:
+                # The supervisor's own black box: ring state at the moment
+                # the watchdog (or a dead pid) declared the replica lost.
+                self.tracer.event(
+                    "replica_failure", t=now, replica=rep.idx, kind=kind,
+                )
+                self.tracer.dump_flight(f"fleet-{kind}-replica{rep.idx}")
             self._kill(rep)
             orphans = router.mark_dead(rep.idx, now)
             hit = injector.fire_observed(kind) if injector else None
@@ -778,12 +840,22 @@ class FleetSupervisor(ClusterSupervisor):
                 "op": "req", "rid": rid, "prompt": rec.prompt,
                 "max_new": rec.max_new, "arrival": rec.arrival_abs,
                 "deadline": rec.deadline_abs, "tenant": rec.tenant,
+                # Trace context rides the wire: every span the worker emits
+                # for this request carries the fleet-global key, not its
+                # engine-local rid, so the merged timeline stitches.
+                "trace": f"r{rid}",
             })
             rec.holders.add(target)
             router.dispatch(
                 rid, target, now,
                 deadline=rec.deadline_abs, prefix_sig=req_sig(rec),
             )
+            if self.tracer is not None:
+                self.tracer.event(
+                    "dispatch", trace=f"r{rid}", t=now,
+                    replica=target,
+                    kind="redispatch" if rec.redispatched else "primary",
+                )
 
         def handle_msg(rep: _Replica, m: dict) -> None:
             nonlocal completed, phase, swap_stage
@@ -810,6 +882,14 @@ class FleetSupervisor(ClusterSupervisor):
                 rec.ttft = m.get("ttft")
                 rec.holders.discard(rep.idx)
                 completed += 1
+                if self.tracer is not None and m.get("t_finished") is not None:
+                    # The stream leg: worker finish → supervisor receipt.
+                    # Both stamps are system-wide CLOCK_MONOTONIC, so the
+                    # span is valid without any clock translation.
+                    self.tracer.record_span(
+                        "stream", float(m["t_finished"]), now,
+                        trace=f"r{rid}", replica=rep.idx,
+                    )
                 if rec.ttft is not None:
                     ttft_by_phase[phase].append(float(rec.ttft))
                 if loser is not None:
@@ -958,8 +1038,14 @@ class FleetSupervisor(ClusterSupervisor):
                         "op": "req", "rid": rid, "prompt": rec.prompt,
                         "max_new": rec.max_new, "arrival": rec.arrival_abs,
                         "deadline": rec.deadline_abs, "tenant": rec.tenant,
+                        "trace": f"r{rid}",
                     })
                     rec.holders.add(target)
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            "dispatch", trace=f"r{rid}", t=now,
+                            replica=target, kind="hedge",
+                        )
                     self._log(
                         f"hedge: rid {rid} duplicated onto replica {target}"
                     )
@@ -1367,6 +1453,14 @@ class FleetSupervisor(ClusterSupervisor):
                     for m in msgs:
                         handle_msg(rep, m)
                 time.sleep(self.poll_interval_s)
+        except BaseException as err:
+            # Watchdog timeout, spent restart budget, operator interrupt —
+            # whatever aborts the run dumps the supervisor's ring first.
+            if self.tracer is not None:
+                self.tracer.dump_flight(
+                    f"fleet-abort-{type(err).__name__}"
+                )
+            raise
         finally:
             for rep in replicas.values():
                 self._kill(rep)
@@ -1470,6 +1564,8 @@ class FleetSupervisor(ClusterSupervisor):
             scale=scale_summary,
             shed_by_tenant=shed_by_tenant,
         )
+        if self.tracer is not None:
+            self.tracer.close()
         if self._own_registry:
             self.registry.close()
         return result

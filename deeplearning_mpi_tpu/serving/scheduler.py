@@ -104,6 +104,13 @@ class Request:
     t_admitted: Optional[float] = None
     t_first_token: Optional[float] = None
     t_finished: Optional[float] = None
+    #: prefill→decode handoff dwell stamps (disaggregated engines only):
+    #: detached from the prefill scheduler / adopted by the decode peer.
+    t_detached: Optional[float] = None
+    t_adopted: Optional[float] = None
+    #: cross-process trace correlation key (the fleet rid, carried over the
+    #: JSONL IPC); None falls back to the engine-local rid at span time.
+    trace: Optional[str] = None
 
     @property
     def prompt_len(self) -> int:
@@ -532,6 +539,8 @@ class Scheduler:
         req.state = RequestState.QUEUED
         req.t_admitted = None
         req.t_first_token = None
+        req.t_detached = None
+        req.t_adopted = None
         self.queue.appendleft(req)
 
     def _shed(self, req: Request, reason: str) -> None:

@@ -7,6 +7,10 @@ The split, by question answered:
   device scalars buffered without extra syncs, canonical JSONL records.
 - :mod:`.trace` — *where time went*: named scopes + trace annotations on
   every parallel hot path, so profiler timelines are readable.
+- :mod:`.spans` — *what happened to THIS request/step*: explicit spans with
+  a cross-process correlation key, per-process JSONL recorders with clock
+  alignment, and the crash flight recorder. ``tools/trace_report.py``
+  merges them into a Perfetto timeline.
 - :mod:`.flops` — *how fast it could have been*: analytic per-model FLOPs
   and MFU against device peak.
 - :mod:`.memory` — *how close to the HBM wall*: ``device.memory_stats()``.
@@ -25,6 +29,7 @@ from deeplearning_mpi_tpu.telemetry.registry import (
     TensorBoardSink,
     labeled,
 )
+from deeplearning_mpi_tpu.telemetry.spans import Span, SpanRecorder
 from deeplearning_mpi_tpu.telemetry.trace import annotate, annotate_fn
 
 __all__ = [
@@ -32,6 +37,8 @@ __all__ = [
     "JsonlSink",
     "LoggerSink",
     "MetricsRegistry",
+    "Span",
+    "SpanRecorder",
     "TensorBoardSink",
     "annotate",
     "annotate_fn",

@@ -191,6 +191,48 @@ def mfu(
     return flops_per_step / (step_seconds * n_devices * peak_flops_per_device)
 
 
+def mfu_gap_attribution(
+    phase_seconds: dict[str, float],
+    duration_s: float,
+    *,
+    mfu_issued: float | None,
+    mfu_gap: float | None,
+) -> dict[str, float]:
+    """Decompose ``mfu_gap`` into the trainer's measured step phases.
+
+    ``mfu_gap = mfu_issued - mfu`` is the utilization lost to everything
+    that isn't useful model math. With per-phase wall-clock attribution
+    (``train/trainer.py`` tracing: data_wait / h2d / collective_tail / …),
+    each non-compute phase's share of the epoch directly forfeits that
+    fraction of the *achievable* utilization:
+
+        mfu_gap_<phase> = mfu_issued · (phase_seconds / duration)
+
+    The remainder — remat recompute, padding, kernel inefficiency, and any
+    stall the fences didn't isolate — lands in ``mfu_gap_residual`` so the
+    returned values sum to ``mfu_gap`` exactly (the report can render the
+    decomposition as shares of a closed total). The ``compute`` phase is
+    the useful-work bucket and never charged to the gap.
+
+    Returns ``{}`` on degenerate inputs (no duration, or the run didn't
+    compute MFU at all) — keys absent, never faked.
+    """
+    if not duration_s or duration_s <= 0:
+        return {}
+    if mfu_issued is None or mfu_gap is None:
+        return {}
+    out: dict[str, float] = {}
+    explained = 0.0
+    for name, secs in phase_seconds.items():
+        if name == "compute":
+            continue
+        share = mfu_issued * (float(secs) / duration_s)
+        out[f"mfu_gap_{name}"] = share
+        explained += share
+    out["mfu_gap_residual"] = mfu_gap - explained
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Transformer / MoE (models/transformer.py, models/moe.py)
 # ---------------------------------------------------------------------------

@@ -107,6 +107,11 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "pod_restarts_total": ("counter", frozenset()),
     "pod_straggler_flags_total": ("counter", frozenset()),
     "pod_world_size": ("gauge", frozenset()),
+    # -- distributed tracing + flight recorder (PR 16, telemetry/spans.py) --
+    "flight_dump_total": ("counter", frozenset({"reason"})),
+    "span_dropped_total": ("counter", frozenset()),
+    "span_recorded_total": ("counter", frozenset()),
+    "trace_clock_offset_s": ("gauge", frozenset()),
     # -- runtime sanitizer (analysis/sanitizer.py) --------------------------
     "sanitize_donation_canary_trips_total": ("counter", frozenset()),
     "sanitize_kv_cow_violation_total": ("counter", frozenset()),

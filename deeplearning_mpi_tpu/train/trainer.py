@@ -539,6 +539,7 @@ class Trainer:
         comm_bytes_per_step: float | None = None,  # static collective bytes
         chaos: Any = None,  # resilience.ChaosInjector; injects planned faults
         shutdown: Any = None,  # resilience.GracefulShutdown; batch-boundary stop
+        tracer: Any = None,  # telemetry.SpanRecorder; per-step phase spans
     ) -> None:
         from deeplearning_mpi_tpu.telemetry.registry import (
             LoggerSink,
@@ -573,6 +574,14 @@ class Trainer:
         self.comm_bytes_per_step = comm_bytes_per_step
         self.chaos = chaos
         self.shutdown = shutdown
+        # Step-phase tracing (PR 16): None keeps run_epoch's hot loop
+        # untouched — the registry's "never add a device sync" constraint
+        # holds. With a tracer attached, each step is deliberately fenced
+        # (block on the batch, the loss, then the updated params) so
+        # data_wait/h2d/compute/collective_tail become MEASURED wall-clock
+        # phases instead of one opaque residual; the syncs are the price
+        # of attribution and are opt-in by construction.
+        self.tracer = tracer
         # Host-side step counter: int(state.step) would force a device sync.
         self._global_step = 0
         self._step_kwargs = dict(
@@ -658,9 +667,35 @@ class Trainer:
         images = 0
         timer = StepTimer(sync_every=25) if self.time_steps else None
         preempted = False
+        tracer = self.tracer
+        #: measured step-phase wall-clock (tracing only); "other" (host
+        #: bookkeeping, logging) is derived at epoch end as the residual so
+        #: the phases sum to the epoch duration exactly.
+        phase_s = {
+            "data_wait": 0.0, "h2d": 0.0, "compute": 0.0,
+            "collective_tail": 0.0,
+        }
         batches = prefetch(loader.epoch(epoch))
+        it = iter(batches)
         try:
-            for batch in batches:
+            while True:
+                # Explicit next() so the tracer can meter the time this
+                # host thread spent WAITING on the input pipeline — the
+                # data_wait phase. The untraced path takes the same route
+                # with zero extra work (one try/except per batch).
+                if tracer is None:
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                else:
+                    t_fetch = time.monotonic()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    t_have = time.monotonic()
+                    phase_s["data_wait"] += t_have - t_fetch
                 # Preemption check at the batch boundary — never inside a jitted
                 # step (a dispatched XLA program can't be interrupted). The
                 # caller (fit) takes the graceful checkpoint.
@@ -683,8 +718,35 @@ class Trainer:
                     elif n_batches == self.PROFILE_STEPS[1]:
                         self.profiler.stop()
                         self._profiled = True
-                with annotate("trainer/train_step"):
-                    self.state, metrics = self.train_step(self.state, batch)
+                if tracer is None:
+                    with annotate("trainer/train_step"):
+                        self.state, metrics = self.train_step(self.state, batch)
+                else:
+                    # Fenced step for phase attribution: each block_until_ready
+                    # is a deliberate sync (opt-in; see __init__). h2d =
+                    # transfer tail still in flight when the host caught up;
+                    # compute = dispatch until the loss is materialized;
+                    # collective_tail = whatever the update (optimizer +
+                    # collectives) still owed after the loss was ready.
+                    step_trace = f"step:{self._global_step}"
+                    jax.block_until_ready(batch)
+                    t_h2d = time.monotonic()
+                    phase_s["h2d"] += t_h2d - t_have
+                    with annotate("trainer/train_step"):
+                        self.state, metrics = self.train_step(self.state, batch)
+                    jax.block_until_ready(metrics["loss"])
+                    t_loss = time.monotonic()
+                    phase_s["compute"] += t_loss - t_h2d
+                    jax.block_until_ready(self.state.params)
+                    t_tail = time.monotonic()
+                    phase_s["collective_tail"] += t_tail - t_loss
+                    tracer.record_span("data_wait", t_fetch, t_have,
+                                       trace=step_trace)
+                    tracer.record_span("h2d", t_have, t_h2d, trace=step_trace)
+                    tracer.record_span("compute", t_h2d, t_loss,
+                                       trace=step_trace)
+                    tracer.record_span("collective_tail", t_loss, t_tail,
+                                       trace=step_trace, epoch=epoch)
                 if timer is not None:
                     timer.tick(metrics["loss"])
                 if self.metrics_every and self._global_step % self.metrics_every == 0:
@@ -784,6 +846,26 @@ class Trainer:
                 stats["mfu_issued"] = issued
                 if "mfu" in stats and stats["mfu"] is not None:
                     stats["mfu_gap"] = issued - stats["mfu"]
+        if tracer is not None:
+            # Measured per-phase attribution: the residual ("other" — host
+            # bookkeeping between fences) closes the sum to the epoch
+            # duration EXACTLY, so "phases sum to step wall-clock" is an
+            # identity the smoke can assert, not an approximation.
+            phase_s["other"] = max(
+                duration - sum(phase_s.values()), 0.0
+            )
+            for name, secs in phase_s.items():
+                stats[f"phase_{name}_s"] = secs
+            if "mfu_gap" in stats:
+                from deeplearning_mpi_tpu.telemetry.flops import (
+                    mfu_gap_attribution,
+                )
+
+                stats.update(mfu_gap_attribution(
+                    phase_s, duration,
+                    mfu_issued=stats["mfu_issued"],
+                    mfu_gap=stats["mfu_gap"],
+                ))
         if self.comm_bytes_per_step is not None:
             stats["comm_bytes_per_step"] = float(self.comm_bytes_per_step)
             if self.issued_flops_per_step:
@@ -873,6 +955,20 @@ class Trainer:
             means["perplexity"] = math.exp(min(means["loss"], 30.0))
         return means
 
+    def _save_checkpoint(self, epoch: int) -> None:
+        """Checkpoint save wrapped in a ``checkpoint`` phase span — the
+        fifth named phase of the step-time budget (the others meter the
+        loop; this one meters the save stall between epochs)."""
+        if self.tracer is None:
+            self.checkpointer.save(self.state, epoch=epoch)
+            return
+        t0 = time.monotonic()
+        self.checkpointer.save(self.state, epoch=epoch)
+        self.tracer.record_span(
+            "checkpoint", t0, time.monotonic(),
+            trace=f"epoch:{epoch}", epoch=epoch,
+        )
+
     def fit(
         self,
         train_loader: Any,
@@ -895,7 +991,7 @@ class Trainer:
                 # are, the epoch record still lands, then a CLEAN distinct
                 # exit — Preempted must not burn an auto-resume restart.
                 if self.checkpointer is not None:
-                    self.checkpointer.save(self.state, epoch=epoch)
+                    self._save_checkpoint(epoch)
                 self.history.append(stats)
                 self._log_metrics("epoch", stats)
                 self._log(
@@ -914,7 +1010,7 @@ class Trainer:
                     )
                 if self.checkpointer is not None:
                     self._mark_progress(phase="checkpoint", epoch=epoch)
-                    self.checkpointer.save(self.state, epoch=epoch)
+                    self._save_checkpoint(epoch)
                     last_saved = epoch
             self.history.append(stats)
             self._log_metrics("epoch", stats)
@@ -934,7 +1030,7 @@ class Trainer:
                 {"epoch": final_epoch, **{f"eval_{k}": v for k, v in final.items()}},
             )
         if self.checkpointer is not None and last_saved != final_epoch:
-            self.checkpointer.save(self.state, epoch=final_epoch)
+            self._save_checkpoint(final_epoch)
         if self.profiler is not None:
             self.profiler.stop()  # idempotent; closes a trace left open by a short epoch
         return self.history

@@ -430,3 +430,47 @@ def test_pod_survives_rank_failure_bit_identical(tmp_path, fault):
     assert out["rank_failures"] == 1
     assert out["steps_compared"] >= 12  # epochs 1-3 x 4 steps
     assert out["chaos_balanced"] is True
+
+
+# -- distributed tracing drill ------------------------------------------------
+
+def _trace_drill_module():
+    """Import tools/trace_drill.py by path (script, not a package)."""
+    import importlib.util
+
+    drill = REPO / "tools" / "trace_drill.py"
+    spec = importlib.util.spec_from_file_location("trace_drill", drill)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_trace_stitches_request_across_fleet_processes(tmp_path):
+    """Cross-process correlation e2e (``tools/trace_drill.py``, also
+    ``make trace-smoke``): a traced 2-replica disaggregated fleet loses
+    replica 0 to a chaos kill, and the merged per-process JSONL must
+    stitch every completed request end to end — the supervisor's dispatch
+    event and stream span joined to the worker's queue / prefill /
+    handoff / decode spans by the fleet-wide ``r<rid>`` trace key — with
+    the phase spans covering TTLT within 5%, zero orphan spans, and the
+    killed replica's flight dump on disk."""
+    out = _trace_drill_module().run_fleet_trace(tmp_path / "drill")
+    assert out["completed"] > 0
+    assert out["worst_coverage"] >= 0.95
+    # supervisor + both replicas + the respawned attempt, each its own file
+    assert out["trace_files"] >= 4
+    assert Path(out["flight_dump"]).is_file()
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_traced_training_attributes_step_phases(tmp_path):
+    """A traced training run must tile every step into
+    data_wait/h2d/compute/collective_tail spans whose epoch totals close
+    to the measured wall-clock exactly (the "other" residual), with
+    mfu_gap decomposed into named phase shares."""
+    out = _trace_drill_module().run_train_trace(tmp_path / "drill")
+    assert out["steps"] == 4
+    assert out["phase_sum_s"] == pytest.approx(out["duration_s"], rel=1e-6)

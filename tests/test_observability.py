@@ -2,6 +2,7 @@
 
 import json
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -689,3 +690,233 @@ class TestTrainerTelemetry:
         trainer.run_epoch(FakeLoader(), epoch=0)
         steps = [r["step"] for r in sink.records if r["kind"] == "step"]
         assert steps == [0, 2]
+
+
+class TestSpanTracing:
+    """telemetry/spans.py: the span model, the per-process recorder, the
+    flight ring, and the JSONL readers — all under fake clocks."""
+
+    @staticmethod
+    def _clock(start=0.0):
+        t = [start]
+
+        def advance(dt):
+            t[0] += dt
+
+        return (lambda: t[0]), advance
+
+    def test_span_tree_nesting_with_fake_clock(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import (
+            SpanRecorder,
+            load_trace_file,
+            span_tree,
+        )
+
+        clock, advance = self._clock(100.0)
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t",
+                           clock=clock, epoch_clock=lambda: 1e9)
+        root = rec.begin("request", trace="r1", rid=1)
+        advance(0.25)
+        child = rec.begin("prefill", trace="r1", parent=root.sid)
+        advance(0.5)
+        rec.end(child)
+        advance(0.25)
+        rec.end(root)
+        rec.close()
+
+        meta, records = load_trace_file(rec.path)
+        assert meta["proc"] == "t" and meta["pid"] == rec.pid
+        spans = [r for r in records if r["kind"] == "span"]
+        # end() writes on close, so the CHILD hits disk first — the tree
+        # readers must not rely on parents preceding children.
+        assert [s["name"] for s in spans] == ["prefill", "request"]
+        by_sid, children, orphans = span_tree(spans)
+        assert not orphans
+        assert [c["name"] for c in children[root.sid]] == ["prefill"]
+        assert by_sid[child.sid]["t1"] - by_sid[child.sid]["t0"] == 0.5
+        assert by_sid[root.sid]["t1"] - by_sid[root.sid]["t0"] == 1.0
+        assert by_sid[root.sid]["labels"] == {"rid": 1}
+
+    def test_orphan_detection(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import (
+            SpanRecorder,
+            load_trace_file,
+            span_tree,
+        )
+
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t",
+                           clock=lambda: 1.0, epoch_clock=lambda: 2.0)
+        rec.record_span("decode", 1.0, 2.0, trace="r7",
+                        parent="dead-proc/999:0")
+        rec.close()
+        _, records = load_trace_file(rec.path)
+        _, _, orphans = span_tree(records)
+        assert len(orphans) == 1
+        assert orphans[0]["parent"] == "dead-proc/999:0"
+
+    def test_flight_ring_evicts_oldest(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import SpanRecorder
+
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t", ring=4,
+                           clock=lambda: 0.0, epoch_clock=lambda: 0.0,
+                           flight_dir=tmp_path / "flight")
+        for i in range(10):
+            rec.record_span(f"s{i}", float(i), float(i) + 0.5, trace="r0")
+        out = rec.dump_flight("unit test")
+        rec.close()
+        assert out is not None and out.parent == tmp_path / "flight"
+        assert "unit-test" in out.name  # reason sanitized for filenames
+        payload = json.loads(out.read_text())
+        assert payload["spans_total"] == 10
+        # Bounded ring: only the 4 most recent records survive to the dump.
+        assert [r["name"] for r in payload["ring"]] == [
+            "s6", "s7", "s8", "s9",
+        ]
+
+    def test_torn_final_line_dropped_on_read(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import (
+            SpanRecorder,
+            load_trace_file,
+        )
+
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t",
+                           clock=lambda: 5.0, epoch_clock=lambda: 5.0)
+        rec.record_span("queue", 1.0, 2.0, trace="r0")
+        rec.record_span("decode", 2.0, 3.0, trace="r0")
+        rec.close()
+        # The single-writer contract's only failure mode: a process dies
+        # mid-write and the file ends in half a record, no newline.
+        with rec.path.open("a") as f:
+            f.write('{"kind": "span", "name": "pref')
+        meta, records = load_trace_file(rec.path)
+        assert meta is not None
+        assert [r["name"] for r in records] == ["queue", "decode"]
+
+    def test_meta_line_carries_clock_offset(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import (
+            SpanRecorder,
+            load_trace_file,
+        )
+
+        # Wall clock 1000, monotonic 400: the offset that places this
+        # process's monotonic stamps on the wall-clock timeline is 600.
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t",
+                           clock=lambda: 400.0, epoch_clock=lambda: 1000.0)
+        rec.close()
+        assert rec.mono_offset == 600.0
+        meta, _ = load_trace_file(rec.path)
+        assert meta["mono_offset"] == 600.0
+        assert meta["ts"] == 1000.0
+
+    def test_skewed_monotonic_clocks_merge_onto_one_timeline(self, tmp_path):
+        """Satellite regression: two workers whose monotonic epochs differ
+        wildly (different boots) but whose wall clocks agree must merge
+        into ONE consistent timeline — each file's own mono_offset does
+        the alignment, applied by tools/trace_report.merge_traces."""
+        import importlib.util
+
+        from deeplearning_mpi_tpu.telemetry.spans import SpanRecorder
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_report",
+            Path(__file__).resolve().parent.parent / "tools"
+            / "trace_report.py",
+        )
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+
+        wall = 1.75e9
+        a = SpanRecorder(tmp_path / "trace_a.jsonl", proc="a",
+                         clock=lambda: 10.0, epoch_clock=lambda: wall)
+        b = SpanRecorder(tmp_path / "trace_b.jsonl", proc="b",
+                         clock=lambda: 9010.0, epoch_clock=lambda: wall)
+        # The same wall instant, expressed in each process's coordinates:
+        # a's monotonic reads 10.0 where b's reads 9010.0.
+        a.record_span("request", 10.0, 10.5, trace="r0")
+        b.record_span("stream", 9010.5, 9010.6, trace="r0")
+        a.close()
+        b.close()
+        _, merged = tr.merge_traces(sorted(tmp_path.glob("trace_*.jsonl")))
+        req = next(s for s in merged if s["name"] == "request")
+        stream = next(s for s in merged if s["name"] == "stream")
+        assert req["t0"] == pytest.approx(wall, abs=1e-6)
+        assert stream["t0"] == pytest.approx(req["t1"], abs=1e-6)
+
+    def test_failed_write_degrades_to_dropped_count(self, tmp_path):
+        """Recording must never raise into the serving/training hot path:
+        a dead file degrades to span_dropped_total, ring still fed."""
+        from deeplearning_mpi_tpu.telemetry.spans import SpanRecorder
+
+        rec = SpanRecorder(tmp_path / "trace_t.jsonl", proc="t",
+                           clock=lambda: 0.0, epoch_clock=lambda: 0.0)
+        rec._f.close()  # simulate the fd dying under the recorder
+        span = rec.record_span("decode", 0.0, 1.0, trace="r0")  # no raise
+        assert span.duration == 1.0
+        assert rec.dropped_total == 1
+        assert rec.spans_total == 1
+        assert any(r.get("name") == "decode" for r in rec._ring)
+        rec.close()
+
+    def test_tracing_off_allocates_nothing(self, tmp_path):
+        """Costless-off (the DMT_SANITIZE pattern): with no trace dir the
+        hot-path hook is one pointer test — zero allocations, zero files.
+        This is the guard exactly as serving/engine.py and
+        train/trainer.py write it."""
+        import gc
+        import sys as _sys
+
+        tracer = None
+
+        def measure(body) -> int:
+            gc.collect()
+            before = _sys.getallocatedblocks()
+            body()
+            return _sys.getallocatedblocks() - before
+
+        def baseline():
+            for _ in range(10_000):
+                pass
+
+        def guarded():
+            for _ in range(10_000):
+                if tracer is not None:  # the hot-path guard under test
+                    tracer.event("engine_step", step=0)
+
+        # The frame machinery itself costs a block or two; the guarded
+        # loop must cost no more than the empty loop (min over trials
+        # irons out interpreter noise — a REAL per-call allocation would
+        # show up ~10k strong in every trial).
+        base = min(measure(baseline) for _ in range(5))
+        guard = min(measure(guarded) for _ in range(5))
+        assert guard <= base, (
+            f"tracing-off guard allocated: {guard} blocks vs "
+            f"baseline {base}"
+        )
+        assert list(tmp_path.glob("trace_*.jsonl")) == []
+
+    def test_dump_all_covers_every_live_recorder(self, tmp_path):
+        from deeplearning_mpi_tpu.telemetry.spans import (
+            SpanRecorder,
+            dump_all,
+        )
+
+        a = SpanRecorder(tmp_path / "trace_a.jsonl", proc="a",
+                         clock=lambda: 0.0, epoch_clock=lambda: 0.0,
+                         flight_dir=tmp_path / "flight")
+        b = SpanRecorder(tmp_path / "trace_b.jsonl", proc="b",
+                         clock=lambda: 0.0, epoch_clock=lambda: 0.0,
+                         flight_dir=tmp_path / "flight")
+        try:
+            a.record_span("x", 0.0, 1.0)
+            paths = dump_all("sanitizer-test")
+            ours = [p for p in paths
+                    if Path(p).parent == tmp_path / "flight"]
+            assert len(ours) == 2
+            procs = {json.loads(Path(p).read_text())["proc"] for p in ours}
+            assert procs == {"a", "b"}
+        finally:
+            a.close()
+            b.close()
+        # Closed recorders leave the registry: a later dump skips them.
+        assert not [p for p in dump_all("after-close")
+                    if Path(p).parent == tmp_path / "flight"]

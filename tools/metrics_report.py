@@ -254,6 +254,21 @@ def _prefix_table(last: dict) -> str:
     return table("Prefix cache", rows)
 
 
+def _tracing_table(last: dict) -> str:
+    """The span recorder's books (``telemetry/spans.py``): any record
+    carrying ``span_recorded_total`` (a traced fleet's ``fleet_summary``,
+    or any registry snapshot with a recorder attached) renders here.
+    Dropped spans nonzero means the JSONL writer failed mid-run; flight
+    dumps nonzero means something crashed, tripped, or timed out."""
+    rows = [("spans recorded", _fmt(last.get("span_recorded_total"))),
+            ("spans dropped (write failures)",
+             _fmt(last.get("span_dropped_total", 0))),
+            ("flight dumps", _fmt(last.get("flight_dump_total", 0))),
+            ("clock offset mono→wall (s)",
+             _fmt(last.get("trace_clock_offset_s")))]
+    return table("Tracing", rows)
+
+
 _SANITIZE_LABELS = (
     ("sanitize_kv_double_free_total", "KV double-free trips"),
     ("sanitize_kv_use_after_free_total", "KV use-after-free trips"),
@@ -338,6 +353,29 @@ def summarize(records: list[dict]) -> str:
                     if isinstance(r.get(key), (int, float))]
             if vals:
                 rows.append((label, f"{sum(vals) / len(vals):.2%}"))
+        # Traced-run attribution (train/trainer.py with a SpanRecorder):
+        # measured per-phase wall-clock — the phases tile the epoch, so
+        # the seconds sum to duration_s — and the mfu_gap decomposition
+        # (telemetry/flops.py mfu_gap_attribution), which closes to
+        # mfu_gap exactly via the residual share.
+        last_ep = epochs[-1]
+        phase_total = sum(
+            v for k, v in last_ep.items()
+            if k.startswith("phase_") and k.endswith("_s")
+            and isinstance(v, (int, float))
+        )
+        for name in ("data_wait", "h2d", "compute", "collective_tail",
+                     "other"):
+            v = last_ep.get(f"phase_{name}_s")
+            if isinstance(v, (int, float)):
+                share = f" ({v / phase_total:.0%})" if phase_total > 0 else ""
+                rows.append((f"step phases: {name} (s, last epoch)",
+                             _fmt(v) + share))
+        for key in sorted(last_ep):
+            if (key.startswith("mfu_gap_")
+                    and isinstance(last_ep.get(key), (int, float))):
+                rows.append((f"MFU gap attribution: {key[len('mfu_gap_'):]}",
+                             f"{last_ep[key]:.2%}"))
         ovl = [r["overlap_fraction"] for r in epochs
                if isinstance(r.get("overlap_fraction"), (int, float))]
         if ovl:
@@ -381,6 +419,10 @@ def summarize(records: list[dict]) -> str:
         if autoscaler:
             out.append(autoscaler)
 
+    traced = [r for r in records if r.get("span_recorded_total") is not None]
+    if traced:
+        out.append(_tracing_table(traced[-1]))
+
     sanitized = [r for r in records
                  if any(k.startswith("sanitize_") for k in r)]
     if sanitized:
@@ -406,12 +448,23 @@ def _selftest() -> int:
         reg.flush_steps(extra={"epoch": 0, "comm_bytes": 1.5e6})
         model_mfu = mfu(1e9, 0.5, n_devices=1, peak_flops_per_device=200e9)
         issued_mfu = mfu(1.3e9, 0.5, n_devices=1, peak_flops_per_device=200e9)
+        gap = issued_mfu - model_mfu
         reg.emit("epoch", {
             "epoch": 0, "loss": 1.65, "duration_s": 4.0, "images_per_s": 64.0,
             "step_ms_p50": 480.0, "step_ms_p95": 520.0,
             "mfu": model_mfu,
             "mfu_issued": issued_mfu,
-            "mfu_gap": issued_mfu - model_mfu,
+            "mfu_gap": gap,
+            # A traced run's measured attribution (phases tile duration_s;
+            # the mfu_gap_* shares close to mfu_gap via the residual).
+            "phase_data_wait_s": 0.4, "phase_h2d_s": 0.1,
+            "phase_compute_s": 3.2, "phase_collective_tail_s": 0.2,
+            "phase_other_s": 0.1,
+            "mfu_gap_data_wait": issued_mfu * 0.1,
+            "mfu_gap_h2d": issued_mfu * 0.025,
+            "mfu_gap_collective_tail": issued_mfu * 0.05,
+            "mfu_gap_other": issued_mfu * 0.025,
+            "mfu_gap_residual": gap - issued_mfu * 0.2,
             "overlap_fraction": overlap_fraction(
                 1.5e6, 1.3e9, n_devices=1,
                 peak_flops_per_device=200e9, link_bandwidth_per_device=10e9,
@@ -478,6 +531,12 @@ def _selftest() -> int:
             'fleet_brownout_total{stage="1"}': 1,
             'fleet_brownout_total{stage="0"}': 1,
             'serve_tenant_shed_total{tenant="best_effort"}': 4,
+            # Tracing books (telemetry/spans.py recorders mirror into the
+            # registry, so a traced fleet's summary carries them).
+            "span_recorded_total": 120,
+            "span_dropped_total": 0,
+            "flight_dump_total": 1,
+            "trace_clock_offset_s": 1.7537e9,
         })
         # A DMT_SANITIZE=1 run's tripwire books (analysis/sanitizer.py):
         # the drill's injections show up as counted trips, a healthy run
@@ -509,6 +568,12 @@ def _selftest() -> int:
                        "hit rate (of admissions)", "prefill tokens reused",
                        "copy-on-write copies", "LRU evictions",
                        "tenant burst: budget sheds",
+                       "step phases: data_wait", "step phases: compute",
+                       "step phases: other",
+                       "MFU gap attribution: data_wait",
+                       "MFU gap attribution: residual",
+                       "spans recorded", "flight dumps",
+                       "clock offset mono→wall",
                        "KV double-free trips", "retrace trips (post-warmup)",
                        "KV refcount underflow trips", "KV CoW violation trips",
                        "donation canary trips", "sanitizer verdict"):

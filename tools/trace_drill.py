@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Tracing drill: replay a small fleet with the flight recorder armed,
+kill a replica mid-decode, and prove the merged trace tells the whole
+story.
+
+The acceptance check for distributed request tracing
+(``telemetry/spans.py``, ``tools/trace_report.py``; ``make trace-smoke``):
+
+1. **Fleet replay** — a 2-replica *disaggregated* CPU fleet (so the
+   prefill→decode handoff dwell is a real span) serves a burst+trickle
+   trace with ``trace_dir`` set; chaos ``replica_kill@step:4`` SIGKILLs
+   replica 0 mid-decode.
+2. **Coverage** — after the run, ``trace_report.merge_traces`` stitches
+   the supervisor's and every replica attempt's JSONL onto one wall-clock
+   timeline. For EVERY completed request the queue + prefill + handoff +
+   decode + stream spans must sum to within 5% of the measured TTLT
+   (arrival → supervisor receipt): the phases are derived from the
+   request's own timestamps, so a hole means a phase went unrecorded, not
+   a timer wobble.
+3. **No orphans** — every span's parent sid resolves in the merged set.
+4. **Flight dump** — the killed replica must leave
+   ``flight/flight-replica0-<pid>-chaos-kill-step4.json`` behind: the
+   chaos detonation dumps the in-memory ring *before* ``os._exit``, which
+   is the only reason the last pre-kill records exist anywhere.
+5. **Perfetto** — the merged trace exports to Chrome ``trace_event`` JSON
+   and round-trips through ``json``.
+6. **Training attribution** — a short traced training run's
+   ``phase_*_s`` stats must sum to the epoch wall-clock exactly, the
+   ``mfu_gap_*`` decomposition must close to ``mfu_gap``, every step must
+   carry all four phase spans, and ``tools/metrics_report.py`` must
+   render both the phase table and the Tracing table.
+
+Run directly (CPU-only, ~a minute warm):
+
+    JAX_PLATFORMS=cpu python tools/trace_drill.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+MODEL_SPEC = {
+    "vocab_size": 256,
+    "num_layers": 2,
+    "num_heads": 2,
+    "num_kv_heads": None,
+    "head_dim": 16,
+    "d_model": 64,
+    "d_ff": 128,
+    "attention_window": None,
+}
+ENGINE_SPEC = {
+    "max_slots": 3,
+    "block_size": 8,
+    "num_blocks": 32,
+    "max_blocks_per_seq": 6,
+    "prefill_chunk": 8,
+    "max_queue": 64,
+}
+SEED = 0
+
+#: the trace-coverage acceptance bar: span sum vs measured TTLT.
+COVERAGE_TOL = 0.05
+
+
+def _load_tool(name: str):
+    """Import a sibling tools/ script by path (scripts, not a package)."""
+    spec = importlib.util.spec_from_file_location(name, REPO / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _base_env() -> dict[str, str]:
+    env = {}
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), os.environ.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache")),
+    )
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+    return env
+
+
+def _request_trace(n_burst: int, n_trickle: int, *, trickle_dt: float = 0.08,
+                   max_new: int = 6, seed: int = 7) -> list[dict]:
+    """Burst (both replicas hold in-flight work when the kill detonates)
+    then trickle (live load through recovery)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    entries = []
+    for i in range(n_burst + n_trickle):
+        n = int(rng.integers(3, 21))
+        entries.append({
+            "arrival": 0.0 if i < n_burst else (i - n_burst + 1) * trickle_dt,
+            "prompt": [int(t) for t in rng.integers(1, 256, size=n)],
+            "max_new": max_new,
+            "deadline": 0.0,
+        })
+    return entries
+
+
+def run_fleet_trace(root: Path) -> dict:
+    """Steps 1–5: traced disagg fleet + chaos kill + merge assertions."""
+    from deeplearning_mpi_tpu.serving import FleetSupervisor
+
+    tr = _load_tool("trace_report")
+    root = Path(root)
+    if root.exists():
+        shutil.rmtree(root)
+    root.mkdir(parents=True)
+    trace_dir = root / "trace"
+
+    entries = _request_trace(10, 8)
+    sup = FleetSupervisor(
+        MODEL_SPEC, ENGINE_SPEC, 2, root / "fleet",
+        seed=SEED,
+        chaos="replica_kill@step:4",
+        disagg=True,
+        trace_dir=trace_dir,
+        heartbeat_interval_s=0.2,
+        heartbeat_deadline_s=3.0,
+        spawn_grace_s=600.0,
+        max_replica_restarts=4,
+        timeout_s=540.0,
+        env=_base_env(),
+    )
+    result = sup.run(entries)
+
+    assert result.dropped == 0, f"{result.dropped} request(s) vanished"
+    assert result.failures.get("replica_kill") == 1, result.failures
+    assert result.restarts == 1, result.restarts
+    assert result.completed >= 1, "nothing completed; trace is vacuous"
+
+    # -- merge every process's file onto the wall clock -------------------
+    paths = sorted(trace_dir.glob("trace_*.jsonl"))
+    # supervisor + two replicas + the respawned attempt (new pid, new file)
+    assert len(paths) >= 4, [p.name for p in paths]
+    metas, merged = tr.merge_traces(paths)
+    assert all(m.get("mono_offset") is not None for m in metas), metas
+    reqs = tr.request_breakdown(merged)
+
+    # -- coverage: the merged trace covers every completed request --------
+    worst = 1.0
+    for rid in sorted(result.requests):
+        key = f"r{rid}"
+        assert key in reqs, f"completed rid {rid} has no request span"
+        rec = reqs[key]
+        missing = [p for p in ("queue", "prefill", "handoff", "decode")
+                   if p not in rec["phases"]]
+        assert not missing, f"rid {rid}: missing phase span(s) {missing}"
+        assert rec["stream"] is not None, (
+            f"rid {rid}: supervisor never recorded a stream span"
+        )
+        # queue+prefill+handoff+decode+stream vs measured TTLT
+        # (request-span arrival → supervisor receipt).
+        span_sum = sum(rec["phases"].values()) + rec["stream"]
+        ttlt = rec["ttlt"] + rec["stream"]
+        cover = span_sum / ttlt if ttlt > 0 else 1.0
+        assert abs(cover - 1.0) <= COVERAGE_TOL, (
+            f"rid {rid}: spans cover {cover:.1%} of TTLT "
+            f"(phases={rec['phases']}, stream={rec['stream']}, ttlt={ttlt})"
+        )
+        worst = min(worst, cover)
+
+    # -- no orphan spans --------------------------------------------------
+    spans = [r for r in merged if r.get("kind") == "span"]
+    _, _, orphans = tr.span_tree(spans)
+    assert not orphans, [
+        (o.get("name"), o.get("sid"), o.get("parent")) for o in orphans
+    ]
+
+    # -- the killed replica left a flight dump ----------------------------
+    dumps = sorted((trace_dir / "flight").glob(
+        "flight-replica*-chaos-kill-step4.json"
+    ))
+    assert dumps, (
+        f"no chaos-kill flight dump under {trace_dir / 'flight'}: "
+        f"{[p.name for p in (trace_dir / 'flight').glob('*')]}"
+    )
+    flight = json.loads(dumps[0].read_text())
+    assert flight["kind"] == "flight_dump" and flight["ring"], flight
+
+    # -- Perfetto export round-trips --------------------------------------
+    events = tr.to_trace_events(merged)
+    out_json = root / "trace.json"
+    out_json.write_text(json.dumps(events))
+    loaded = json.loads(out_json.read_text())
+    assert any(e.get("ph") == "X" and e.get("name") == "request"
+               for e in loaded)
+    assert any(e.get("ph") == "M" for e in loaded)
+
+    # -- the Tracing table renders from the fleet summary ------------------
+    mr = _load_tool("metrics_report")
+    report = mr.summarize(mr.load_records(root / "fleet" / "fleet_metrics.jsonl"))
+    for needle in ("Tracing", "spans recorded", "flight dumps"):
+        assert needle in report, f"'{needle}' missing from metrics_report"
+
+    print(tr.render_report(merged))
+    print(
+        f"fleet trace OK: {result.completed} requests covered "
+        f"(worst coverage {worst:.1%}), 0 orphans, "
+        f"flight dump {dumps[0].name}"
+    )
+    return {
+        "completed": result.completed,
+        "worst_coverage": worst,
+        "trace_files": len(paths),
+        "flight_dump": str(dumps[0]),
+    }
+
+
+def run_train_trace(root: Path) -> dict:
+    """Step 6: traced training run — phases tile the epoch, mfu_gap
+    decomposes, metrics_report renders the attribution."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+    from deeplearning_mpi_tpu.runtime.mesh import create_mesh
+    from deeplearning_mpi_tpu.telemetry.flops import (
+        transformer_issued_flops,
+        transformer_train_flops,
+    )
+    from deeplearning_mpi_tpu.telemetry.registry import JsonlSink
+    from deeplearning_mpi_tpu.telemetry.spans import SpanRecorder
+    from deeplearning_mpi_tpu.train import Trainer, create_train_state
+    from deeplearning_mpi_tpu.train.trainer import build_optimizer
+
+    tr = _load_tool("trace_report")
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    trace_dir = root / "trace"
+    n_steps, batch, seq = 4, 8, 16
+
+    cfg = TransformerConfig.tiny()
+    model = TransformerLM(config=cfg, dtype=jnp.float32)
+    tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+    state = create_train_state(
+        model, jax.random.key(0), jnp.zeros((1, seq), jnp.int32), tx
+    )
+
+    class Loader:
+        def epoch(self, epoch):
+            rng = np.random.default_rng(epoch)
+            for _ in range(n_steps):
+                yield {"tokens": jnp.asarray(
+                    rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32
+                )}
+
+    tracer = SpanRecorder(
+        trace_dir / f"trace_trainer-{os.getpid()}.jsonl", proc="trainer",
+        flight_dir=trace_dir / "flight",
+    )
+    trainer = Trainer(
+        state, "lm", create_mesh(),
+        flops_per_step=transformer_train_flops(cfg, batch, seq),
+        issued_flops_per_step=transformer_issued_flops(
+            cfg, batch, seq, remat="full"
+        ),
+        tracer=tracer,
+        time_steps=False,
+    )
+    metrics_path = root / "train_metrics.jsonl"
+    trainer.metrics.add_sink(JsonlSink(metrics_path))
+    stats = trainer.run_epoch(Loader(), epoch=0)
+    trainer._log_metrics("epoch", stats)
+    trainer.metrics.close()
+    tracer.close()
+
+    # Phases tile the epoch EXACTLY (the "other" residual closes the sum).
+    phase_keys = [k for k in stats if k.startswith("phase_") and k.endswith("_s")]
+    assert sorted(phase_keys) == sorted(
+        f"phase_{n}_s" for n in
+        ("data_wait", "h2d", "compute", "collective_tail", "other")
+    ), phase_keys
+    phase_sum = sum(stats[k] for k in phase_keys)
+    assert abs(phase_sum - stats["duration_s"]) < 1e-6 * max(
+        stats["duration_s"], 1.0
+    ), (phase_sum, stats["duration_s"])
+
+    # mfu_gap decomposes into the named phases and closes exactly.
+    gap_keys = [k for k in stats if k.startswith("mfu_gap_")]
+    assert "mfu_gap_data_wait" in gap_keys and "mfu_gap_residual" in gap_keys, (
+        gap_keys
+    )
+    gap_sum = sum(stats[k] for k in gap_keys)
+    assert abs(gap_sum - stats["mfu_gap"]) < 1e-12 + 1e-9 * abs(
+        stats["mfu_gap"]
+    ), (gap_sum, stats["mfu_gap"])
+
+    # Every step left all four phase spans in the trace file.
+    _, merged = tr.merge_traces(sorted(trace_dir.glob("trace_trainer-*.jsonl")))
+    steps = tr.step_breakdown(merged)
+    assert len(steps) == n_steps, sorted(steps)
+    for trace_key, phases in steps.items():
+        assert sorted(phases) == sorted(tr.STEP_PHASES), (trace_key, phases)
+
+    # metrics_report renders the per-phase attribution for the epoch.
+    mr = _load_tool("metrics_report")
+    report = mr.summarize(mr.load_records(metrics_path))
+    for needle in ("step phases", "MFU gap attribution"):
+        assert needle in report, f"'{needle}' missing from metrics_report"
+
+    print(
+        f"train trace OK: {n_steps} steps x {len(tr.STEP_PHASES)} phases, "
+        f"phase sum {phase_sum:.3f}s == epoch {stats['duration_s']:.3f}s, "
+        f"mfu_gap decomposed into {len(gap_keys)} named shares"
+    )
+    return {"steps": n_steps, "phase_sum_s": phase_sum,
+            "duration_s": stats["duration_s"]}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", default="/tmp/dmt_trace_drill")
+    parser.add_argument("--part", default="all",
+                        choices=("fleet", "train", "all"))
+    args = parser.parse_args(argv)
+    sys.path.insert(0, str(REPO))
+    root = Path(args.root)
+    if args.part in ("fleet", "all"):
+        run_fleet_trace(root / "fleet_trace")
+    if args.part in ("train", "all"):
+        run_train_trace(root / "train_trace")
+    print("trace-drill OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+#!/usr/bin/env python
+"""Merge per-process span JSONL files into one timeline and render it.
+
+    python tools/trace_report.py <trace_dir>                 # tables
+    python tools/trace_report.py <trace_dir> --out t.json    # + Perfetto
+    python tools/trace_report.py --selftest                  # synthesize
+
+Input is a directory of ``trace_*.jsonl`` files written by
+``telemetry/spans.SpanRecorder`` — one file per process (supervisor,
+each replica attempt, a trainer). Every file's FIRST line is a
+``trace_meta`` record carrying that process's monotonic-vs-epoch clock
+offset; the merge applies each file's OWN offset to its timestamps, which
+is the whole clock-alignment story: CLOCK_MONOTONIC has an arbitrary
+per-boot epoch, so raw ``t0``s from two machines (or two skewed test
+clocks) are incomparable until each is shifted onto the wall clock by the
+offset its recorder sampled at startup. The selftest is the regression
+for exactly that — two recorders with monotonic epochs 20 minutes apart
+must merge into one consistent timeline.
+
+Outputs:
+
+- **Per-request critical path** — for every ``request`` root span, the
+  queue → prefill → handoff → decode phase spans (children, stitched by
+  the fleet-wide ``r<rid>`` trace key) plus the supervisor-side ``stream``
+  span, each as a share of TTLT. The phases tile arrival→finish by
+  construction (``serving/engine.py`` derives them from the request's own
+  timestamps), so shares sum to ~100% for every completed request — the
+  trace-smoke acceptance check.
+- **Per-step phases** — ``step:N`` traces from a traced training run:
+  data_wait / h2d / compute / collective_tail per step.
+- **Orphan spans** — spans naming a parent sid that is absent from the
+  merged set (a process died before flushing the parent, or a correlation
+  key was mangled crossing the fleet IPC). Zero is the healthy state.
+- **Perfetto export** (``--out``) — Chrome ``trace_event`` JSON: open it
+  at https://ui.perfetto.dev or chrome://tracing. One track per process,
+  spans as complete ("X") events, markers as instants.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from deeplearning_mpi_tpu.telemetry.spans import (  # noqa: E402
+    load_trace_file,
+    span_tree,
+)
+
+#: request phase spans in critical-path order; ``stream`` rides on the
+#: supervisor side (worker-finish → supervisor receipt), outside TTLT.
+REQUEST_PHASES = ("queue", "prefill", "handoff", "decode")
+STEP_PHASES = ("data_wait", "h2d", "compute", "collective_tail")
+
+
+def merge_traces(paths: list[Path]) -> tuple[list[dict], list[dict]]:
+    """Load every trace file and shift its records onto the wall clock.
+
+    Returns ``(metas, records)`` — records carry ``proc``/``pid`` from
+    their file's meta and have ``t0``/``t1``/``t`` rebased to epoch
+    seconds via that file's ``mono_offset``. A file with no meta line
+    (truncated at birth) contributes records unshifted at offset 0 —
+    visible as a gross misalignment rather than silently dropped.
+    """
+    metas: list[dict] = []
+    merged: list[dict] = []
+    for path in sorted(paths):
+        meta, records = load_trace_file(path)
+        off = float(meta.get("mono_offset", 0.0)) if meta else 0.0
+        proc = meta.get("proc", path.stem) if meta else path.stem
+        pid = meta.get("pid", 0) if meta else 0
+        if meta is not None:
+            metas.append(meta)
+        for rec in records:
+            r = dict(rec)
+            r["proc"] = proc
+            r["pid"] = pid
+            if r.get("kind") == "span":
+                r["t0"] = float(r["t0"]) + off
+                if r.get("t1") is not None:
+                    r["t1"] = float(r["t1"]) + off
+            elif r.get("kind") == "event":
+                r["t"] = float(r["t"]) + off
+            merged.append(r)
+    return metas, merged
+
+
+def to_trace_events(merged: list[dict]) -> list[dict]:
+    """Chrome/Perfetto ``trace_event`` JSON array (µs timestamps).
+
+    Timestamps are rebased to the earliest record so the viewer opens at
+    t=0 instead of 50 years into the epoch; the wall-clock base survives
+    in a metadata event's args for cross-referencing logs.
+    """
+    times = [r["t0"] for r in merged if r.get("kind") == "span"]
+    times += [r["t"] for r in merged if r.get("kind") == "event"]
+    base = min(times) if times else 0.0
+    procs: dict[str, int] = {}
+    events: list[dict] = []
+    for r in merged:
+        proc = r.get("proc", "?")
+        if proc not in procs:
+            tid = procs[proc] = len(procs) + 1
+            events.append({
+                "ph": "M", "name": "process_name", "pid": tid, "tid": tid,
+                "args": {"name": proc, "wall_clock_base_s": base},
+            })
+        tid = procs[proc]
+        args = dict(r.get("labels") or {})
+        if r.get("trace") is not None:
+            args["trace"] = r["trace"]
+        if r.get("kind") == "span":
+            if r.get("t1") is None:
+                continue  # never closed; lives only in a flight ring
+            args["sid"] = r.get("sid")
+            if r.get("parent") is not None:
+                args["parent"] = r["parent"]
+            events.append({
+                "ph": "X", "name": r["name"], "pid": tid, "tid": tid,
+                "ts": (r["t0"] - base) * 1e6,
+                "dur": max(r["t1"] - r["t0"], 0.0) * 1e6,
+                "args": args,
+            })
+        elif r.get("kind") == "event":
+            events.append({
+                "ph": "i", "s": "p", "name": r["name"], "pid": tid,
+                "tid": tid, "ts": (r["t"] - base) * 1e6, "args": args,
+            })
+    return events
+
+
+def request_breakdown(merged: list[dict]) -> dict[str, dict]:
+    """Critical-path decomposition per completed request.
+
+    Keyed by trace key (``r<rid>`` fleet-wide, ``rid<n>`` engine-local).
+    Each value: ``ttlt`` (root request span duration), ``phases`` mapping
+    phase name → seconds, ``covered`` = sum(phases)/ttlt, and ``stream``
+    (supervisor receipt lag) when the fleet recorded one.
+    """
+    spans = [r for r in merged if r.get("kind") == "span"]
+    out: dict[str, dict] = {}
+    for s in spans:
+        if s.get("name") != "request" or s.get("t1") is None:
+            continue
+        trace = s.get("trace")
+        if trace is None:
+            continue
+        out[trace] = {
+            "t0": s["t0"],
+            "ttlt": s["t1"] - s["t0"],
+            "phases": {},
+            "stream": None,
+            "root_sid": s.get("sid"),
+        }
+    for s in spans:
+        trace = s.get("trace")
+        if trace not in out or s.get("t1") is None:
+            continue
+        if s.get("name") in REQUEST_PHASES:
+            out[trace]["phases"][s["name"]] = s["t1"] - s["t0"]
+        elif s.get("name") == "stream":
+            out[trace]["stream"] = s["t1"] - s["t0"]
+    for rec in out.values():
+        total = sum(rec["phases"].values())
+        rec["covered"] = (total / rec["ttlt"]) if rec["ttlt"] > 0 else 1.0
+    return out
+
+
+def step_breakdown(merged: list[dict]) -> dict[str, dict[str, float]]:
+    """Per-step phase seconds for every ``step:N`` trace, keyed by trace."""
+    out: dict[str, dict[str, float]] = {}
+    for s in merged:
+        if s.get("kind") != "span" or s.get("t1") is None:
+            continue
+        trace = s.get("trace") or ""
+        if not trace.startswith("step:") or s.get("name") not in STEP_PHASES:
+            continue
+        phases = out.setdefault(trace, {})
+        phases[s["name"]] = phases.get(s["name"], 0.0) + (s["t1"] - s["t0"])
+    return out
+
+
+def _cols(rows: list[list[str]], header: list[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def line(vals):
+        return "  ".join(str(v).ljust(w) for v, w in zip(vals, widths))
+    out = [line(header), line(["-" * w for w in widths])]
+    out += [line(r) for r in rows]
+    return "\n".join(out) + "\n"
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v * 1e3:.2f}"
+
+
+def render_report(merged: list[dict], *, max_rows: int = 32) -> str:
+    out = []
+    reqs = request_breakdown(merged)
+    if reqs:
+        rows = []
+        def sort_key(item):
+            return item[1]["t0"]
+        for trace, rec in sorted(reqs.items(), key=sort_key)[:max_rows]:
+            ph = rec["phases"]
+            row = [trace, _ms(rec["ttlt"])]
+            for name in REQUEST_PHASES:
+                secs = ph.get(name)
+                if secs is None:
+                    row.append("-")
+                elif rec["ttlt"] > 0:
+                    row.append(f"{_ms(secs)} ({secs / rec['ttlt']:.0%})")
+                else:
+                    row.append(_ms(secs))
+            row.append(_ms(rec["stream"]))
+            row.append(f"{rec['covered']:.1%}")
+            rows.append(row)
+        header = ["request", "TTLT ms"]
+        header += [f"{n} ms" for n in REQUEST_PHASES]
+        header += ["stream ms", "covered"]
+        title = f"Per-request critical path ({len(reqs)} requests)"
+        out.append(title + "\n" + "-" * len(title) + "\n"
+                   + _cols(rows, header))
+        if len(reqs) > max_rows:
+            out.append(f"... {len(reqs) - max_rows} more requests omitted\n")
+    steps = step_breakdown(merged)
+    if steps:
+        def step_num(trace):
+            try:
+                return int(trace.split(":", 1)[1])
+            except ValueError:
+                return 0
+        rows = []
+        for trace in sorted(steps, key=step_num)[:max_rows]:
+            ph = steps[trace]
+            rows.append([trace] + [_ms(ph.get(n)) for n in STEP_PHASES])
+        title = f"Per-step phases ({len(steps)} steps)"
+        out.append(title + "\n" + "-" * len(title) + "\n"
+                   + _cols(rows, ["step"] + [f"{n} ms" for n in STEP_PHASES]))
+        if len(steps) > max_rows:
+            out.append(f"... {len(steps) - max_rows} more steps omitted\n")
+    spans = [r for r in merged if r.get("kind") == "span"]
+    _, _, orphans = span_tree(spans)
+    events = [r for r in merged if r.get("kind") == "event"]
+    procs = sorted({r.get("proc", "?") for r in merged})
+    summary = [
+        f"processes: {len(procs)} ({', '.join(procs)})",
+        f"spans: {len(spans)}  events: {len(events)}",
+        f"orphan spans (parent missing from merge): {len(orphans)}",
+    ]
+    for o in orphans[:8]:
+        summary.append(
+            f"  orphan: {o.get('name')} sid={o.get('sid')} "
+            f"parent={o.get('parent')} trace={o.get('trace')}"
+        )
+    out.append("Merge summary\n-------------\n" + "\n".join(summary) + "\n")
+    return "\n".join(out)
+
+
+def _selftest() -> int:
+    """Clock-skew regression + torn-line tolerance + render needles.
+
+    Two recorders whose *monotonic* clocks disagree by 20 minutes (two
+    machines, two boots) but whose wall clocks agree record the same
+    incident; the merge must land both on one timeline within tolerance.
+    A torn final line on one file must be dropped, not fatal.
+    """
+    import time
+
+    from deeplearning_mpi_tpu.telemetry.spans import SpanRecorder
+
+    with tempfile.TemporaryDirectory() as tmp:
+        tdir = Path(tmp)
+        wall = time.time()
+        # Worker A's monotonic epoch is 0; worker B booted 1200s "earlier"
+        # (its monotonic reads 1200s higher at the same wall instant).
+        skew = 1200.0
+        rec_a = SpanRecorder(
+            tdir / "trace_replica0-1.jsonl", proc="replica0",
+            clock=lambda: 100.0, epoch_clock=lambda: wall,
+        )
+        rec_b = SpanRecorder(
+            tdir / "trace_supervisor.jsonl", proc="supervisor",
+            clock=lambda: 100.0 + skew, epoch_clock=lambda: wall,
+        )
+        # The same request seen from both sides at the same wall instants,
+        # expressed in each process's own monotonic coordinates.
+        root = rec_a.record_span("request", 100.0, 100.010, trace="r0",
+                                 rid=0, tenant="default", tokens=4)
+        rec_a.record_span("queue", 100.0, 100.002, trace="r0",
+                          parent=root.sid)
+        rec_a.record_span("prefill", 100.002, 100.005, trace="r0",
+                          parent=root.sid)
+        rec_a.record_span("handoff", 100.005, 100.006, trace="r0",
+                          parent=root.sid)
+        rec_a.record_span("decode", 100.006, 100.010, trace="r0",
+                          parent=root.sid)
+        rec_b.record_span("stream", 100.010 + skew, 100.011 + skew,
+                          trace="r0", replica=0)
+        rec_b.event("dispatch", trace="r0", t=100.0 + skew, replica=0,
+                    kind="primary")
+        # An orphan: names a parent sid no file contains.
+        rec_b.record_span("decode", 100.02 + skew, 100.03 + skew,
+                          trace="r9", parent="replica9/999:0")
+        # A traced training step from a third process.
+        rec_c = SpanRecorder(
+            tdir / "trace_trainer-7.jsonl", proc="trainer",
+            clock=lambda: 5.0, epoch_clock=lambda: wall,
+        )
+        rec_c.record_span("data_wait", 5.0, 5.001, trace="step:0")
+        rec_c.record_span("h2d", 5.001, 5.002, trace="step:0")
+        rec_c.record_span("compute", 5.002, 5.012, trace="step:0")
+        rec_c.record_span("collective_tail", 5.012, 5.013, trace="step:0")
+        for rec in (rec_a, rec_b, rec_c):
+            rec.close()
+        # Tear the final line of one file mid-record.
+        torn = tdir / "trace_replica1-2.jsonl"
+        torn_rec = SpanRecorder(torn, proc="replica1",
+                                clock=lambda: 50.0,
+                                epoch_clock=lambda: wall)
+        torn_rec.record_span("request", 50.0, 50.5, trace="r1")
+        torn_rec.close()
+        with torn.open("a") as f:
+            f.write('{"kind": "span", "name": "dec')  # no newline, cut JSON
+
+        metas, merged = merge_traces(sorted(tdir.glob("trace_*.jsonl")))
+        assert len(metas) == 4, metas
+        # Clock alignment: supervisor's stream span must start where the
+        # replica's request span ends on the WALL clock, despite the 1200s
+        # monotonic skew between their raw timestamps.
+        reqs = request_breakdown(merged)
+        stream = [r for r in merged if r.get("kind") == "span"
+                  and r["name"] == "stream"][0]
+        gap = abs(stream["t0"] - (reqs["r0"]["t0"] + reqs["r0"]["ttlt"]))
+        assert gap < 1e-6, f"skewed clocks not aligned: gap={gap}"
+        assert abs(reqs["r0"]["covered"] - 1.0) < 0.05, reqs["r0"]
+        # Torn line dropped, intact records kept.
+        assert "r1" in reqs and abs(reqs["r1"]["ttlt"] - 0.5) < 1e-9
+        assert not any(r.get("name") == "dec" for r in merged)
+        # Orphan detection.
+        _, _, orphans = span_tree(
+            [r for r in merged if r.get("kind") == "span"])
+        assert len(orphans) == 1 and orphans[0]["trace"] == "r9", orphans
+        # Perfetto export round-trips as JSON with one track per process.
+        events = to_trace_events(merged)
+        blob = json.dumps(events)
+        names = {e["args"]["name"] for e in json.loads(blob)
+                 if e["ph"] == "M"}
+        assert names == {"replica0", "replica1", "supervisor", "trainer"}
+        assert any(e["ph"] == "X" and e["name"] == "request"
+                   for e in events)
+        assert any(e["ph"] == "i" and e["name"] == "dispatch"
+                   for e in events)
+        report = render_report(merged)
+        print(report)
+        for needle in ("Per-request critical path", "r0", "covered",
+                       "Per-step phases", "step:0", "data_wait",
+                       "collective_tail",
+                       "orphan spans (parent missing from merge): 1"):
+            if needle not in report:
+                print(f"selftest FAILED: '{needle}' missing from report",
+                      file=sys.stderr)
+                return 1
+    print("selftest OK")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("trace_dir", nargs="?", type=Path,
+                        help="directory of trace_*.jsonl files "
+                        "(a fleet's trace_dir)")
+    parser.add_argument("--out", type=Path, default=None,
+                        help="write Chrome/Perfetto trace_event JSON here")
+    parser.add_argument("--selftest", action="store_true",
+                        help="synthesize skewed recorders and verify the "
+                        "merge (no fleet required)")
+    args = parser.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if args.trace_dir is None:
+        parser.error("pass a trace dir or --selftest")
+    paths = sorted(Path(args.trace_dir).glob("trace_*.jsonl"))
+    if not paths:
+        print(f"error: no trace_*.jsonl under {args.trace_dir}",
+              file=sys.stderr)
+        return 1
+    metas, merged = merge_traces(paths)
+    print(f"{args.trace_dir}: {len(paths)} trace files, "
+          f"{len(merged)} records\n")
+    print(render_report(merged))
+    if args.out is not None:
+        events = to_trace_events(merged)
+        args.out.parent.mkdir(parents=True, exist_ok=True)
+        args.out.write_text(json.dumps(events))
+        print(f"wrote {len(events)} trace events to {args.out} "
+              "(open at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
