@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
@@ -15,9 +15,10 @@ SHELL := /bin/bash
 # the fault-injection recovery drill, the autotune loop, the elastic-pod
 # rank-failure drill, the overlapped-ZeRO-1 bit-equality drill, the
 # serving-fleet replica-failure drill, the disaggregated prefill/decode
-# drill, the radix prefix-cache drill, and the fleet-autoscaler surge
-# drill without touching the ROADMAP command itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke
+# drill, the radix prefix-cache drill, the fleet-autoscaler surge drill,
+# and the numerics-guardrail drill without touching the ROADMAP command
+# itself.
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -126,6 +127,19 @@ chaos-smoke:
 pod-smoke:
 	env JAX_PLATFORMS=cpu python tools/pod_drill.py --fault rank_kill \
 		--root /tmp/dmt_pod_smoke
+
+# Numerics-guardrail drill (docs/RESILIENCE.md "Numerics guardrails"):
+# both arms of tools/guardrail_drill.py. loss_spike — a planned x1000
+# loss scale must draw a poisoned verdict, roll back to the pinned
+# last-known-good checkpoint, and replay onto a trajectory bit-identical
+# to an unfaulted run. bitflip — a 2-process pod's rank 1 flips one
+# param bit mid-run; the supervisor's cross-rank digest vote must convict
+# it, quarantine the host, prune poisoned checkpoints, and re-form a
+# world of one whose resumed losses are bit-identical to a clean resume.
+# Chaos books must reconcile in both arms.
+guard-smoke:
+	env JAX_PLATFORMS=cpu python tools/guardrail_drill.py --arm both \
+		--root /tmp/dmt_guard_smoke
 
 # Disaggregated prefill/decode drill (docs/SERVING.md "Disaggregated
 # topology"): the serve-smoke trace through the split topology — a

@@ -274,6 +274,7 @@ def main(argv: list[str] | None = None) -> int:
             zero=args.zero, overlap=args.zero_overlap,
             clip_norm=1.0,  # the optimizer chain's clip, mirrored by overlap
             ema_decay=args.ema, chaos=chaos,
+            guardrails=config.build_guardrails(args),
         )
         trainer.place_state()
         if chaos is not None:

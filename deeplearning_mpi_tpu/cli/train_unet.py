@@ -182,6 +182,7 @@ def main(argv: list[str] | None = None) -> int:
             logger=logger, checkpointer=checkpointer, eval_every=args.eval_every,
             grad_accum=args.grad_accum, zero=args.zero, seg_loss=args.loss,
             ema_decay=args.ema, chaos=chaos,
+            guardrails=config.build_guardrails(args),
         )
         trainer.place_state()  # replicate (dp) or TP-shard (--tp > 1)
         if chaos is not None:

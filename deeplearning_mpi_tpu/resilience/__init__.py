@@ -27,7 +27,9 @@ from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
     AUTOSCALE_KINDS,
     DISAGG_KINDS,
     FLEET_KINDS,
+    GUARD_KINDS,
     SERVE_KINDS,
+    TRAIN_KINDS,
     ChaosInjector,
     FaultPlan,
     FaultSpec,
@@ -35,6 +37,15 @@ from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
     InjectedKill,
     fleet_entries,
     validate_plan_kinds,
+)
+from deeplearning_mpi_tpu.resilience.guardrails import (  # noqa: F401
+    DigestVote,
+    GuardrailConfig,
+    GuardrailPolicy,
+    QuarantineLedger,
+    RollbackRequested,
+    Verdict,
+    param_digest,
 )
 from deeplearning_mpi_tpu.resilience.integrity import (  # noqa: F401
     CheckpointCorruption,
@@ -67,10 +78,14 @@ __all__ = [
     "ChaosInjector",
     "CheckpointCorruption",
     "DISAGG_KINDS",
+    "DigestVote",
     "FLEET_KINDS",
     "FaultPlan",
     "FaultSpec",
+    "GUARD_KINDS",
     "GracefulShutdown",
+    "GuardrailConfig",
+    "GuardrailPolicy",
     "Heartbeat",
     "InjectedFault",
     "InjectedKill",
@@ -79,13 +94,18 @@ __all__ = [
     "PodResult",
     "PodSupervisor",
     "Preempted",
+    "QuarantineLedger",
     "ResilientLoader",
+    "RollbackRequested",
     "SERVE_KINDS",
+    "TRAIN_KINDS",
     "TrainingFailure",
+    "Verdict",
     "atomic_write_json",
     "corrupt_checkpoint",
     "dir_digests",
     "fleet_entries",
+    "param_digest",
     "preflight",
     "restart_delay",
     "run_with_auto_resume",

@@ -53,6 +53,22 @@ kind             unit    injection site
                          its ``at``-th scale-up, while the new replica is
                          still warming — failover and autoscaling must
                          compose without thrashing
+``loss_spike``    step   the batch gains a loss-scale key the jitted step
+                         multiplies into BOTH the reported loss and the
+                         differentiated total — a poison-data-region spike
+                         the guardrail policy must catch and roll back
+``grad_spike``    step   like ``loss_spike`` but the scale multiplies only
+                         the DIFFERENTIATED total: gradients blow up while
+                         the reported loss stays normal — only the
+                         grad-norm detector can see it
+``nan_grads``     step   the grad scale is NaN: gradients are non-finite
+                         while the loss is finite — the step's extended
+                         finite guard (loss AND grad norm) must skip it
+``bitflip``       step   the TARGET RANK flips one mantissa bit in a
+                         digest-sampled param leaf of its own replica,
+                         post-update and purely locally — silent data
+                         corruption only the cross-rank digest vote can
+                         attribute (supervisor-accounted like rank_kill)
 ===============  ======  =====================================================
 
 ``rank_kill``/``rank_hang`` are *pod-level* kinds (:data:`POD_KINDS`): the
@@ -101,6 +117,7 @@ __all__ = [
     "FLEET_KINDS",
     "FaultPlan",
     "FaultSpec",
+    "GUARD_KINDS",
     "InjectedFault",
     "InjectedKill",
     "POD_KINDS",
@@ -109,6 +126,7 @@ __all__ = [
     "RECOVERY_LATENCY",
     "ROLLBACK",
     "SERVE_KINDS",
+    "TRAIN_KINDS",
     "fleet_entries",
     "pod_entries",
     "strip_entries",
@@ -131,11 +149,31 @@ FAULT_UNITS = {
     "handoff_stall": "step",
     "load_spike": "step",
     "scale_during_failure": "step",
+    "loss_spike": "step",
+    "grad_spike": "step",
+    "nan_grads": "step",
+    "bitflip": "step",
 }
 
 #: kinds whose accounting lives in the pod supervisor, not the worker: the
-#: faulted process is dead or wedged before it could emit a run_summary.
-POD_KINDS = frozenset({"rank_kill", "rank_hang"})
+#: faulted process is dead or wedged before it could emit a run_summary —
+#: or, for ``bitflip``, about to be quarantined and killed by the
+#: supervisor once the digest vote blames it.
+POD_KINDS = frozenset({"rank_kill", "rank_hang", "bitflip"})
+
+#: numerics-guardrail kinds (docs/RESILIENCE.md "Numerics guardrails"):
+#: detected by the GuardrailPolicy / digest vote, not by process liveness.
+#: ``loss_spike``/``grad_spike``/``nan_grads`` detonate in-process through
+#: :meth:`ChaosInjector.maybe_guard_fault`; ``bitflip`` is pod-level (the
+#: supervisor's vote owns its accounting).
+GUARD_KINDS = frozenset({"loss_spike", "grad_spike", "nan_grads", "bitflip"})
+
+#: every kind the training workloads (train_lm/resnet/unet CLIs) have a
+#: live injection hook for — ``validate_plan_kinds``'s supported set, so a
+#: serving-only kind handed to a trainer fails loud at parse time.
+TRAIN_KINDS = frozenset(
+    {"nan_grad", "kill", "corrupt_ckpt", "loader_stall", "loader_die"}
+) | POD_KINDS | GUARD_KINDS
 
 #: serving-fleet kinds — same supervisor-side accounting split as
 #: :data:`POD_KINDS`, owned by ``serving.fleet.FleetSupervisor``.
@@ -522,6 +560,87 @@ class ChaosInjector:
             poisoned[key] = poisoned[key] * nan
         return poisoned
 
+    def maybe_guard_fault(self, batch: Any, *, step: int) -> Any:
+        """Trainer hook: detonate the in-process numerics kinds by adding
+        scale keys the jitted step pops at trace time (train/trainer.py):
+
+        - ``loss_spike`` → ``__loss_scale__`` multiplies the reported loss
+          AND the differentiated total — a visible loss blow-up;
+        - ``grad_spike`` → ``__grad_scale__`` multiplies ONLY the
+          differentiated total, so gradients explode while the reported
+          loss stays normal (what loss-watching alone cannot see);
+        - ``nan_grads`` → NaN ``__grad_scale__``: non-finite grads under a
+          finite loss — the extended finite guard's case.
+
+        Adding a key changes the batch's pytree structure, costing one
+        (cached) recompile on the first faulted step and one back — the
+        price of keeping clean steps byte-identical to a chaos-free run.
+        """
+        scales = {}
+        if self.should_fire("loss_spike", step):
+            scales["__loss_scale__"] = 1e3
+        if self.should_fire("grad_spike", step):
+            scales["__grad_scale__"] = 1e4
+        if self.should_fire("nan_grads", step):
+            scales["__grad_scale__"] = float("nan")
+        if not scales:
+            return batch
+        import jax.numpy as jnp
+
+        faulted = dict(batch)
+        for key, value in scales.items():
+            faulted[key] = jnp.float32(value)
+        return faulted
+
+    def maybe_bitflip(self, params: Any, *, step: int) -> Any:
+        """Trainer hook, post-update: silently corrupt THIS rank's replica.
+
+        Fires only on the target rank (same ``$DMT_CHAOS_RANK``/last-rank
+        convention as :meth:`check_rank_fault`) and flips one mantissa bit
+        in the first digest-sampled leaf — the shared ``_digest_leaves``
+        enumeration guarantees the corrupted leaf is one ``param_digest``
+        covers. The rebuild uses ``jax.make_array_from_single_device_
+        arrays``, a purely local operation: no collective runs, so peer
+        ranks keep their clean bytes and the replicas silently diverge —
+        real SDC, detectable only by the cross-rank digest vote. Returns
+        the corrupted params, or ``None`` when nothing fired.
+        """
+        if not any(
+            s.kind == "bitflip" and not s.fired for s in self.plan.specs
+        ):
+            return None
+        import jax
+
+        target = int(os.environ.get(ENV_RANK, str(jax.process_count() - 1)))
+        if jax.process_index() != target:
+            return None
+        if not self.should_fire("bitflip", step):
+            return None
+        import numpy as np
+
+        from deeplearning_mpi_tpu.resilience.guardrails import _digest_leaves
+
+        path, leaf = _digest_leaves(params, 1)[0]
+        flipped_shards = []
+        for shard in leaf.addressable_shards:
+            arr = np.array(jax.device_get(shard.data))
+            flat = arr.view(np.int32).reshape(-1) if arr.dtype.itemsize == 4 \
+                else arr.view(np.int16).reshape(-1)
+            flat[0] ^= 1 << 10  # a mantissa bit: silent, not a NaN
+            flipped_shards.append(jax.device_put(arr, shard.device))
+        corrupted_leaf = jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, flipped_shards
+        )
+        print(
+            f"chaos: injected bitflip@step:{step} in {path} "
+            "(local replica corrupted; peers clean)",
+            flush=True,
+        )
+        leaf_id = id(leaf)
+        return jax.tree_util.tree_map(
+            lambda x: corrupted_leaf if id(x) == leaf_id else x, params
+        )
+
     def loader_fault(self, *, batch: int) -> None:
         """Watchdog-worker hook: a stall sleeps ``stall_s``; a die raises
         (every attempt — poison batches stay poison across retries)."""
@@ -589,15 +708,19 @@ class ChaosInjector:
         return False
 
     def reconcile_nan_recoveries(self, skipped: int) -> int:
-        """Trainer epoch-end hook: each pending ``nan_grad`` fault counts as
-        recovered once the epoch's skip count confirms the NaN guard
-        actually rejected a step for it. Returns recoveries recorded."""
+        """Trainer epoch-end hook: each pending ``nan_grad``/``nan_grads``
+        fault counts as recovered once the epoch's skip count confirms the
+        finite guard actually rejected a step for it (``nan_grads`` is
+        caught by the grad-norm half of the extended guard, but the
+        recovery mechanism — skip the update — is the same). Returns
+        recoveries recorded."""
         n = 0
         for spec in self.plan.specs:
             if skipped - n <= 0:
                 break
-            if spec.kind == "nan_grad" and spec.fired and not spec.recovered:
-                if self.record_recovery("nan_grad", at=spec.at):
+            if (spec.kind in ("nan_grad", "nan_grads") and spec.fired
+                    and not spec.recovered):
+                if self.record_recovery(spec.kind, at=spec.at):
                     n += 1
         return n
 

@@ -1,0 +1,478 @@
+"""Numerics guardrails: SDC detection, digest voting, rollback, quarantine.
+
+The process-level chaos kinds (kill/hang/corrupt) all announce themselves —
+a dead rank stops beating, a corrupt checkpoint fails its manifest. *Bad
+numerics* do not: a loss spike from a poison data region, a gradient
+blow-up, or a silently-corrupting host flipping bits in its replicated
+params all keep training "successfully" while ruining the run. "Scalable
+Training of Language Models using JAX pjit and TPUv4" (PAPERS.md) documents
+exactly this class of large-run interruption — anomalous steps and hardware
+defects that demand checkpoint *rollback*, not restart. This module closes
+the loop from detection to recovery with three pure, fake-clock-testable
+pieces:
+
+:class:`GuardrailPolicy`
+    Consumes the per-step health signals the trainer already computes
+    (loss, gradient global-norm, the finite flag) through EWMA-banded
+    robust-z detectors. Warmup grace keeps the cold band from flagging the
+    first steps; anti-flap hysteresis freezes the band during an anomaly
+    episode (an outlier must never drag the band toward itself) and
+    requires consecutive calm steps before the episode closes. Verdicts are
+    ``ok`` (update band) | ``spike`` (tolerated, band frozen) | ``poisoned``
+    (the caller must roll back — either one step cleared the hard z bar, or
+    a spike run outlasted the patience budget).
+
+:class:`DigestVote`
+    Statistical detectors cannot *attribute* a silently-corrupting host.
+    The vote can: every rank periodically publishes a cheap sha256 over a
+    fixed sample of its param leaves (:func:`param_digest`) through the pod
+    heartbeat channel it already maintains. In pure data parallelism those
+    leaves are bit-identical by construction, so at any step held by two or
+    more ranks the digests must agree — a mismatch blames the minority
+    digest *directly* (a bit-flipped replica loses the vote), no statistics
+    involved.
+
+:class:`QuarantineLedger`
+    A blamed host is quarantined in an atomic JSON ledger the pod
+    supervisor consults before every (re)spawn, so a flaky host is not
+    re-admitted to the world it just corrupted.
+
+Nothing here imports jax at module scope and nothing reads a wall clock
+internally — callers inject ``step`` and the policy's state machine is
+plain arithmetic, so every detector path is unit-testable in microseconds
+(the same doctrine as ``serving/autoscaler.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Iterable, Mapping, Optional
+
+from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
+
+__all__ = [
+    "DigestVote",
+    "GuardrailConfig",
+    "GuardrailPolicy",
+    "QuarantineLedger",
+    "RollbackRequested",
+    "Verdict",
+    "VoteResult",
+    "param_digest",
+]
+
+
+class RollbackRequested(RuntimeError):
+    """Raised by the Trainer when the policy returns ``poisoned``: the run
+    must restore the pinned last-known-good checkpoint and replay.
+
+    Deliberately NOT a subclass of the chaos exceptions: ``run_with_auto_
+    resume`` retries it like any crash, but ``execute_training``'s resume
+    closure checks ``trainer.pending_rollback`` first and services it via
+    ``Checkpointer.rollback_to_last_good`` instead of the plain latest-
+    checkpoint restore.
+    """
+
+    def __init__(self, verdict: "Verdict") -> None:
+        super().__init__(
+            f"guardrail verdict poisoned at step {verdict.step} "
+            f"({verdict.signal}: z={verdict.z:.1f}, {verdict.reason}) — "
+            "rollback to last-known-good requested"
+        )
+        self.verdict = verdict
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One step's guardrail judgement.
+
+    ``region`` is the attributed poison window ``(first_anomalous_step,
+    step)`` — the replay pass can skip or down-clip exactly these steps'
+    batches (``GuardrailConfig.replay``) instead of re-eating the poison.
+    """
+
+    status: str  # "ok" | "spike" | "poisoned"
+    step: int
+    signal: str = ""  # which detector judged: "loss" | "grad_norm" | ""
+    z: float = 0.0
+    reason: str = ""
+    region: tuple[int, int] | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardrailConfig:
+    """Detector thresholds. Defaults are deliberately loose: normal
+    training loss is noisy and a guardrail that cries wolf trains nothing.
+
+    ``digest_every`` > 0 additionally computes :func:`param_digest` every N
+    steps — the ONLY guardrail feature with a device read beyond the step
+    scalars, which is why it is opt-in per config rather than implied by
+    attaching a policy.
+    """
+
+    warmup_steps: int = 8  # band-building grace: verdict ok, no z judged
+    ewma_alpha: float = 0.2  # band update weight (mean and deviation)
+    z_spike: float = 6.0  # robust-z at/above which a step is a spike
+    z_poison: float = 12.0  # robust-z at/above which one step poisons
+    spike_patience: int = 2  # tolerated consecutive spikes before poisoned
+    hysteresis_steps: int = 4  # calm steps to close an episode (anti-flap)
+    digest_every: int = 0  # 0 = no param digests
+    digest_sample_leaves: int = 8  # leaves sampled by param_digest
+    replay: str = "none"  # poison-region replay action: none|skip|clip
+    clip_scale: float = 0.1  # replay="clip": loss-scale over the region
+
+
+class _Band:
+    """EWMA mean + EWMA mean-absolute-deviation for one signal.
+
+    Robust-z is ``|x - mean| / max(dev, eps)`` — mean-abs-deviation rather
+    than variance so a single huge outlier (the thing being detected)
+    cannot square itself into the denominator on the step it lands.
+    """
+
+    __slots__ = ("mean", "dev", "n")
+
+    def __init__(self) -> None:
+        self.mean = 0.0
+        self.dev = 0.0
+        self.n = 0
+
+    def z(self, x: float) -> float:
+        if self.n == 0:
+            return 0.0
+        return abs(x - self.mean) / max(self.dev, 1e-8, abs(self.mean) * 1e-3)
+
+    def update(self, x: float, alpha: float) -> None:
+        if self.n == 0:
+            self.mean = x
+            self.dev = max(abs(x) * 0.1, 1e-8)
+        else:
+            self.dev = (1 - alpha) * self.dev + alpha * abs(x - self.mean)
+            self.mean = (1 - alpha) * self.mean + alpha * x
+        self.n += 1
+
+
+class GuardrailPolicy:
+    """Pure per-step anomaly detector. See the module docstring.
+
+    State machine per episode: ``ok`` steps update the bands; the first
+    anomalous step opens an episode and FREEZES the bands (an anomaly must
+    not teach the detector that anomalies are normal); within an episode,
+    spikes extend it and a run of more than ``spike_patience`` consecutive
+    anomalous steps escalates to ``poisoned``; ``hysteresis_steps``
+    consecutive calm steps close the episode and thaw the bands. A
+    ``poisoned`` verdict resets the policy — the caller is about to roll
+    back to a state where this band history never happened.
+    """
+
+    def __init__(self, config: GuardrailConfig | None = None) -> None:
+        self.config = config or GuardrailConfig()
+        self._bands: dict[str, _Band] = {}
+        self._seen = 0
+        self._episode_start: Optional[int] = None
+        self._anomaly_streak = 0
+        self._calm_streak = 0
+
+    # -- core ---------------------------------------------------------------
+    def observe(
+        self,
+        step: int,
+        *,
+        loss: float,
+        grad_norm: float | None = None,
+        finite: bool = True,
+    ) -> Verdict:
+        """Judge one step's health signals; returns the worst verdict."""
+        cfg = self.config
+        self._seen += 1
+        signals = [("loss", float(loss))]
+        if grad_norm is not None:
+            signals.append(("grad_norm", float(grad_norm)))
+
+        # A non-finite step never updates a band and is always anomalous —
+        # but the jitted step already skipped its update (the isfinite
+        # guard), so one NaN is a tolerated spike, not an instant rollback;
+        # only a *run* of them outlasting the patience escalates.
+        if not finite:
+            return self._anomalous(
+                Verdict("spike", step, "finite", float("inf"),
+                        "non-finite step (update skipped in-step)"),
+                step,
+            )
+
+        worst: tuple[float, str, float] | None = None  # (z, signal, value)
+        for name, value in signals:
+            band = self._bands.setdefault(name, _Band())
+            if self._seen > cfg.warmup_steps and band.n > 0:
+                z = band.z(value)
+                if z >= cfg.z_spike and (worst is None or z > worst[0]):
+                    worst = (z, name, value)
+
+        if worst is not None:
+            z, name, _value = worst
+            if z >= cfg.z_poison:
+                verdict = Verdict(
+                    "poisoned", step, name, z,
+                    f"robust-z {z:.1f} >= z_poison {cfg.z_poison:g}",
+                    region=(self._episode_start
+                            if self._episode_start is not None else step,
+                            step),
+                )
+                self.reset()
+                return verdict
+            return self._anomalous(
+                Verdict("spike", step, name, z,
+                        f"robust-z {z:.1f} >= z_spike {cfg.z_spike:g}"),
+                step,
+            )
+
+        # Calm step. Bands stay frozen until the episode closes.
+        if self._episode_start is not None:
+            self._calm_streak += 1
+            self._anomaly_streak = 0
+            if self._calm_streak < self.config.hysteresis_steps:
+                return Verdict("ok", step, reason="episode cooling")
+            self._episode_start = None
+            self._calm_streak = 0
+        for name, value in signals:
+            self._bands[name].update(value, cfg.ewma_alpha)
+        return Verdict("ok", step)
+
+    def _anomalous(self, verdict: Verdict, step: int) -> Verdict:
+        """Book one anomalous (spike) step; escalate past the patience."""
+        if self._episode_start is None:
+            self._episode_start = step
+            self._anomaly_streak = 0
+        self._calm_streak = 0
+        self._anomaly_streak += 1
+        if self._anomaly_streak > self.config.spike_patience:
+            escalated = Verdict(
+                "poisoned", step, verdict.signal, verdict.z,
+                f"{self._anomaly_streak} consecutive anomalous steps > "
+                f"spike_patience {self.config.spike_patience}",
+                region=(self._episode_start, step),
+            )
+            self.reset()
+            return escalated
+        return dataclasses.replace(
+            verdict, region=(self._episode_start, step)
+        )
+
+    def reset(self) -> None:
+        """Forget all band history — called after a rollback (the restored
+        trajectory predates everything the bands learned)."""
+        self._bands.clear()
+        self._seen = 0
+        self._episode_start = None
+        self._anomaly_streak = 0
+        self._calm_streak = 0
+
+    # -- replay attribution -------------------------------------------------
+    def replay_scale(self, step: int, region: tuple[int, int] | None) -> float:
+        """Loss scale the replay pass applies at ``step`` given the
+        attributed poison ``region``: 1.0 outside it; inside, 0.0 for
+        ``replay="skip"`` (the step runs but contributes nothing),
+        ``clip_scale`` for ``replay="clip"``, 1.0 for ``replay="none"``
+        (re-eat the data — right when the anomaly was transient, e.g. an
+        injected fault that fires once)."""
+        if region is None or not (region[0] <= step <= region[1]):
+            return 1.0
+        if self.config.replay == "skip":
+            return 0.0
+        if self.config.replay == "clip":
+            return float(self.config.clip_scale)
+        return 1.0
+
+
+# -- param digests ----------------------------------------------------------
+
+def _digest_leaves(params: Any, sample_leaves: int) -> list[tuple[str, Any]]:
+    """The fixed leaf sample digested AND bit-flipped (chaos): sorting by
+    path makes the sample deterministic across ranks and runs, and sharing
+    this enumeration with ``ChaosInjector.maybe_bitflip`` guarantees the
+    corrupted leaf is one the digest actually covers.
+
+    Only fully-replicated leaves qualify: a TP/ZeRO-sharded leaf's local
+    shard legitimately differs per rank, so digesting it would make every
+    vote a false mismatch. Replication is judged locally — the first
+    addressable shard spans the global shape.
+    """
+    import jax
+
+    leaves = []
+    for path, leaf in sorted(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        key=lambda kv: jax.tree_util.keystr(kv[0]),
+    ):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            if tuple(shards[0].data.shape) != tuple(leaf.shape):
+                continue  # sharded leaf: per-rank bytes differ by design
+        leaves.append((jax.tree_util.keystr(path), leaf))
+        if len(leaves) >= sample_leaves:
+            break
+    return leaves
+
+
+def param_digest(params: Any, *, sample_leaves: int = 8) -> str:
+    """sha256 hex digest over a fixed sample of replicated param leaves.
+
+    One host fetch of ``sample_leaves`` small arrays — cheap enough to run
+    every few steps, strong enough that any single bit flip in a sampled
+    leaf changes the digest. Identical across data-parallel ranks by
+    construction (same init, same updates), so cross-rank comparison is a
+    pure equality vote.
+    """
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in _digest_leaves(params, sample_leaves):
+        shards = getattr(leaf, "addressable_shards", None)
+        data = shards[0].data if shards else leaf
+        arr = np.asarray(jax.device_get(data))
+        h.update(path.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+# -- cross-rank digest vote -------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class VoteResult:
+    """Outcome of comparing one step's digests across ranks.
+
+    ``minority`` holds the out-voted rank(s); empty means a tie the vote
+    cannot break (two ranks, two digests) — the caller falls back to the
+    planned chaos target, or to a whole-world restart when there is none.
+    """
+
+    step: int
+    minority: tuple[int, ...]
+    digests: dict[int, str]
+
+
+class DigestVote:
+    """Pure cross-rank digest comparator fed from heartbeat payloads.
+
+    Each rank's heartbeat carries a small ring ``{step: digest}`` (see
+    ``Trainer._guard_observe``); the supervisor ingests whatever rings it
+    last saw and :meth:`tally` compares every step at least two ranks still
+    hold, in order. All-agree advances ``last_agreed_step`` — the newest
+    step known SDC-free, which bounds how far back a post-divergence
+    checkpoint prune must reach. The first disagreement returns a
+    :class:`VoteResult` blaming the minority digest.
+    """
+
+    def __init__(self) -> None:
+        self._rings: dict[int, dict[int, str]] = {}
+        self.last_agreed_step: int = -1
+
+    def observe(self, rank: int, digests: Mapping[Any, Any] | None) -> None:
+        """Record rank's latest digest ring (JSON round-trips keys to str)."""
+        if not digests:
+            return
+        self._rings[int(rank)] = {
+            int(s): str(d) for s, d in digests.items()
+        }
+
+    def drop_rank(self, rank: int) -> None:
+        """Forget a departed (dead/quarantined) rank's ring — its stale
+        digests must not out-vote the survivors at future steps."""
+        self._rings.pop(int(rank), None)
+
+    def tally(self) -> Optional[VoteResult]:
+        """Compare all commonly-held steps; first mismatch wins the blame.
+
+        Steps are judged oldest-first so the returned divergence step is
+        the EARLIEST observed — the checkpoint prune keys off it.
+        """
+        if len(self._rings) < 2:
+            return None
+        common: dict[int, dict[int, str]] = {}
+        for rank, ring in self._rings.items():
+            for step, digest in ring.items():
+                common.setdefault(step, {})[rank] = digest
+        for step in sorted(common):
+            votes = common[step]
+            if len(votes) < 2 or step <= self.last_agreed_step:
+                continue
+            tallies: dict[str, list[int]] = {}
+            for rank, digest in votes.items():
+                tallies.setdefault(digest, []).append(rank)
+            if len(tallies) == 1:
+                self.last_agreed_step = step
+                continue
+            sizes = sorted(len(r) for r in tallies.values())
+            minority: list[int] = []
+            if sizes[-1] > sizes[0]:  # a strict majority exists
+                biggest = max(tallies.values(), key=len)
+                for digest, ranks in tallies.items():
+                    if ranks is not biggest:
+                        minority.extend(ranks)
+            return VoteResult(step, tuple(sorted(minority)),
+                              {r: d for r, d in sorted(votes.items())})
+        return None
+
+
+# -- quarantine ledger ------------------------------------------------------
+
+class QuarantineLedger:
+    """Atomic JSON ledger of hosts blamed for silent corruption.
+
+    The pod supervisor loads it before every world (re)form and never
+    spawns a quarantined host again — within this run AND across runs
+    sharing the pod dir (the ledger outlives the supervisor on purpose: a
+    host that flipped bits once is suspect until a human clears it).
+    """
+
+    def __init__(self, path: str | Path) -> None:
+        self.path = Path(path)
+        self.entries: list[dict[str, Any]] = []
+        if self.path.exists():
+            try:
+                loaded = json.loads(self.path.read_text())
+                if isinstance(loaded, list):
+                    self.entries = [e for e in loaded if isinstance(e, dict)]
+            except (OSError, json.JSONDecodeError):
+                pass  # an unreadable ledger quarantines nobody (fail open)
+
+    def hosts(self) -> set[str]:
+        return {str(e.get("host")) for e in self.entries if e.get("host")}
+
+    def __contains__(self, host: Any) -> bool:
+        return str(host) in self.hosts()
+
+    def quarantine(self, host: Any, *, reason: str,
+                   step: int | None = None,
+                   digest: str | None = None) -> dict[str, Any]:
+        """Book one host; idempotent per host (re-blame updates nothing)."""
+        if host in self:
+            return next(e for e in self.entries
+                        if str(e.get("host")) == str(host))
+        entry: dict[str, Any] = {"host": str(host), "reason": reason}
+        if step is not None:
+            entry["step"] = int(step)
+        if digest is not None:
+            entry["digest"] = digest
+        self.entries.append(entry)
+        atomic_write_json(self.path, self.entries)
+        return entry
+
+
+def attach_digest_ring(ring: dict[int, str], step: int, digest: str,
+                       *, cap: int = 16) -> None:
+    """Append one digest to a heartbeat ring in place, evicting oldest past
+    ``cap`` — the ring rides every heartbeat JSON, so it must stay small."""
+    ring[step] = digest
+    while len(ring) > cap:
+        del ring[min(ring)]

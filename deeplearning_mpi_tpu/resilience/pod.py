@@ -23,15 +23,32 @@ agent, grown two capabilities the reference lacks:
    the resumed run bit-identical to a clean from-checkpoint run at the
    surviving world size (``tools/pod_drill.py`` asserts exactly that).
 
-Chaos accounting: ``rank_kill``/``rank_hang`` detonate *inside* a worker,
-which is then dead or wedged — it can never emit its own run summary. The
-supervisor therefore owns their books: it marks the spec fired when it
-observes the failure (:meth:`ChaosInjector.fire_observed`), records the
-recovery when the re-formed world first makes progress, and strips the
-fired entry from the spec before respawning (workers restart their step
-count at 0, so an unstripped entry would re-fire every attempt). The
-pod-level reconciliation invariant — ``fault_injected_total ==
-recovery_total + rollback_total`` — lands in ``pod_metrics.jsonl``.
+Chaos accounting: ``rank_kill``/``rank_hang``/``bitflip`` detonate *inside*
+a worker, which is then dead, wedged, or silently corrupt — it can never
+emit its own run summary. The supervisor therefore owns their books: it
+marks the spec fired when it observes the failure
+(:meth:`ChaosInjector.fire_observed`), records the recovery when the
+re-formed world first makes progress, and strips the fired entry from the
+spec before respawning (workers restart their step count at 0, so an
+unstripped entry would re-fire every attempt). The pod-level
+reconciliation invariant — ``fault_injected_total == recovery_total +
+rollback_total`` — lands in ``pod_metrics.jsonl``.
+
+**Silent data corruption** (docs/RESILIENCE.md "Numerics guardrails") is
+the third failure class, and the only one exit codes and heartbeat
+liveness both miss: a host flipping bits in its replicated params keeps
+running and keeps beating. Workers launched with ``--guardrails
+--digest_every N`` ride a small ``{step: digest}`` ring on every heartbeat
+(:func:`~.guardrails.param_digest`); the supervisor feeds the rings into a
+:class:`~.guardrails.DigestVote` each poll. In pure data parallelism the
+sampled leaves are bit-identical across ranks, so the first step where two
+live ranks disagree convicts the minority digest directly. The blamed
+HOST (rank identity survives re-numbering across re-forms) is booked in a
+:class:`~.guardrails.QuarantineLedger` the supervisor consults before
+every spawn — within this run and across runs sharing the pod dir — then
+the world is torn down, checkpoints captured after the divergence step are
+pruned (they froze the poisoned trajectory), and the survivors re-form
+without the corrupter, resuming bit-identical to a never-faulted run.
 
 The mechanics shared with the serving fleet — heartbeat liveness
 (:class:`LivenessTracker`), SIGKILL+reap teardown, chaos books, rendezvous
@@ -45,6 +62,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import shutil
 import socket
 import subprocess
 import time
@@ -66,6 +84,7 @@ from deeplearning_mpi_tpu.resilience.faults import (
     pod_entries,
     strip_entries,
 )
+from deeplearning_mpi_tpu.resilience.guardrails import DigestVote, QuarantineLedger
 from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
 from deeplearning_mpi_tpu.telemetry.registry import MetricsRegistry, labeled
 
@@ -73,6 +92,8 @@ __all__ = [
     "ENV_HEARTBEAT_DIR",
     "ENV_HEARTBEAT_INTERVAL",
     "LivenessTracker",
+    "POD_DIGEST_MISMATCHES",
+    "POD_QUARANTINES",
     "POD_RANK_FAILURES",
     "POD_RESTARTS",
     "POD_STRAGGLERS",
@@ -86,6 +107,8 @@ POD_RANK_FAILURES = "pod_rank_failures_total"
 POD_RESTARTS = "pod_restarts_total"
 POD_WORLD_SIZE = "pod_world_size"
 POD_STRAGGLERS = "pod_straggler_flags_total"
+POD_DIGEST_MISMATCHES = "guard_digest_mismatch_total"
+POD_QUARANTINES = "guard_quarantine_total"
 
 
 class PodFailure(RuntimeError):
@@ -144,6 +167,7 @@ class PodSupervisor(ClusterSupervisor):
         min_world_size: int = 1,
         max_pod_restarts: int = 2,
         straggler_factor: float = 4.0,
+        ckpt_dir: str | Path | None = None,
         registry: MetricsRegistry | None = None,
         env: Mapping[str, str] | None = None,
     ) -> None:
@@ -163,9 +187,15 @@ class PodSupervisor(ClusterSupervisor):
         self.min_world_size = min_world_size
         self.max_pod_restarts = max_pod_restarts
         self.straggler_factor = straggler_factor
+        # The workers' checkpoint directory (the Checkpointer root). Only
+        # needed for SDC recovery: a digest-blamed corruption poisons every
+        # checkpoint saved after the divergence step, and the supervisor —
+        # not the (possibly corrupt) workers — must prune them before the
+        # survivors resume. None disables the prune.
+        self.ckpt_dir = Path(ckpt_dir) if ckpt_dir is not None else None
 
     def _chaos_target(self, spec: str, world: int) -> Optional[int]:
-        """Rank a planned ``rank_kill``/``rank_hang`` detonates on, or None.
+        """Rank a planned pod-level fault detonates on, or None.
 
         Drills wedge a KNOWN rank (``faults.py``: last rank unless
         ``$DMT_CHAOS_RANK`` overrides). When culprit analysis ties — every
@@ -226,6 +256,69 @@ class PodSupervisor(ClusterSupervisor):
         )
         return procs, handles, hb_dir
 
+    def _blame_corrupt(
+        self,
+        divergence: Any,
+        hosts: list[int],
+        candidates: list[int],
+        spec: str,
+        world: int,
+    ) -> list[int]:
+        """Map a :class:`~.guardrails.VoteResult` to guilty rank(s).
+
+        The vote speaks host ids; the minority maps back through ``hosts``
+        to current ranks. A tie (two ranks, two digests) falls back to the
+        planned chaos target — the one signal left that can break it; no
+        target means nobody is blamed and the caller restarts the whole
+        world instead.
+        """
+        self.registry.counter(POD_DIGEST_MISMATCHES).inc()
+        self._log(
+            f"digest vote: mismatch at step {divergence.step} — "
+            + ", ".join(
+                f"host {h}: {d[:12]}…"
+                for h, d in divergence.digests.items()
+            )
+        )
+        corrupt = [r for r in candidates if hosts[r] in divergence.minority]
+        if not corrupt:
+            target = self._chaos_target(spec, world)
+            if target in candidates:
+                self._log(
+                    f"digest vote: tied — blaming planned chaos target "
+                    f"rank {target}"
+                )
+                corrupt = [target]
+        return corrupt
+
+    def _prune_poisoned_ckpts(
+        self, divergence_step: int, ckpt_ring: Mapping[int, int]
+    ) -> None:
+        """Delete checkpoints captured after the first diverged step.
+
+        Under data parallelism a bit-flipped replica's gradients mix into
+        every all-reduce, so a checkpoint whose recorded save step exceeds
+        the divergence step froze the poisoned trajectory — restoring it
+        would resume the corruption with the corrupter already evicted.
+        ``ckpt_ring`` is the ``{epoch: global step at save}`` ring the
+        workers ride on their heartbeats (``Trainer._save_checkpoint``);
+        the world is already torn down when this runs, so the deletes race
+        nobody. No-op without a ``ckpt_dir``.
+        """
+        if self.ckpt_dir is None or not ckpt_ring:
+            return
+        for epoch, saved_step in sorted(ckpt_ring.items()):
+            if saved_step <= divergence_step:
+                continue
+            step_dir = self.ckpt_dir / str(epoch)
+            if step_dir.exists():
+                shutil.rmtree(step_dir, ignore_errors=True)
+            (self.ckpt_dir / f"manifest-{epoch}.json").unlink(missing_ok=True)
+            self._log(
+                f"pruned checkpoint epoch {epoch} (saved at step "
+                f"{saved_step} > divergence step {divergence_step})"
+            )
+
     @staticmethod
     def _kill_all(procs: dict[int, subprocess.Popen]) -> None:
         for proc in procs.values():
@@ -237,9 +330,28 @@ class PodSupervisor(ClusterSupervisor):
     # -- the supervision loop ------------------------------------------------
     def run(self) -> PodResult:
         injector = self._open_books("pod_metrics.jsonl")
-        for name in (POD_RANK_FAILURES, POD_RESTARTS, POD_STRAGGLERS):
+        for name in (POD_RANK_FAILURES, POD_RESTARTS, POD_STRAGGLERS,
+                     POD_DIGEST_MISMATCHES, POD_QUARANTINES):
             self.registry.counter(name)
-        world = self.num_processes
+        # SDC machinery. Host identity survives rank re-numbering: attempt
+        # 0's rank i is host i, and after a re-form the new rank j is the
+        # j-th surviving host — `hosts[rank]` is the stable name the vote
+        # and the ledger speak. A host quarantined by this run OR a prior
+        # run sharing the pod dir is never spawned at all.
+        ledger = QuarantineLedger(self.pod_dir / "quarantine.json")
+        vote = DigestVote()
+        hosts: list[int] = [
+            h for h in range(self.num_processes) if h not in ledger
+        ]
+        if len(hosts) < self.num_processes:
+            self._log(
+                f"quarantine: host(s) "
+                f"{sorted(set(range(self.num_processes)) - set(hosts))} "
+                f"barred by {ledger.path} — spawning {len(hosts)} of "
+                f"{self.num_processes}"
+            )
+        ckpt_ring: dict[int, int] = {}  # epoch -> global step at its save
+        world = len(hosts)
         spec = self.chaos_spec
         self.registry.gauge(POD_WORLD_SIZE).set(world)
         world_sizes: list[int] = []
@@ -250,6 +362,11 @@ class PodSupervisor(ClusterSupervisor):
         pending_recoveries: list[tuple[str, float]] = []
         ok = False
         try:
+            if world < self.min_world_size:
+                raise PodFailure(
+                    f"{world} admissible host(s) after quarantine — below "
+                    f"min_world_size={self.min_world_size}"
+                )
             attempt = 0
             while True:
                 world_sizes.append(world)
@@ -260,16 +377,22 @@ class PodSupervisor(ClusterSupervisor):
                 flagged: set[int] = set()
                 dead: list[int] = []
                 hung: list[int] = []
+                corrupt: list[int] = []
+                divergence = None  # VoteResult of the first digest mismatch
                 running: list[int] = list(procs)
                 stall_settle_until: float | None = None
                 try:
                     while True:
                         time.sleep(self.poll_interval_s)
                         for rank in procs:
-                            tracker.observe(
-                                rank,
-                                Heartbeat.read(hb_dir / f"heartbeat-{rank}.json"),
+                            hb = Heartbeat.read(
+                                hb_dir / f"heartbeat-{rank}.json"
                             )
+                            tracker.observe(rank, hb)
+                            if hb:
+                                vote.observe(hosts[rank], hb.get("digests"))
+                                for e, s in (hb.get("ckpts") or {}).items():
+                                    ckpt_ring[int(e)] = int(s)
                         if pending_recoveries and tracker.any_progress():
                             now = time.monotonic()
                             for kind, detected in pending_recoveries:
@@ -286,11 +409,23 @@ class PodSupervisor(ClusterSupervisor):
                         rcs = {r: p.poll() for r, p in procs.items()}
                         dead = [r for r, rc in rcs.items() if rc not in (None, 0)]
                         if not dead and all(rc == 0 for rc in rcs.values()):
-                            ok = True
-                            return self._result(
-                                True, world_sizes, restarts, rank_failures,
-                                injector,
+                            divergence = vote.tally()
+                            if divergence is None:
+                                ok = True
+                                return self._result(
+                                    True, world_sizes, restarts,
+                                    rank_failures, injector,
+                                )
+                            # Every worker exited 0, but their final
+                            # heartbeat rings disagree: the run COMPLETED on
+                            # a poisoned trajectory. Exit codes are not a
+                            # verdict on numerics — fall through to the SDC
+                            # recovery with every (exited) rank eligible.
+                            running = list(procs)
+                            corrupt = self._blame_corrupt(
+                                divergence, hosts, running, spec, world
                             )
+                            break
                         running = [r for r, rc in rcs.items() if rc is None]
                         if not dead:
                             stalled = [r for r in running if tracker.stalled(r)]
@@ -343,7 +478,13 @@ class PodSupervisor(ClusterSupervisor):
                                     f"{tracker.progress_age_s(rank):.1f}s "
                                     f"(flagged, not failed)"
                                 )
-                        if dead or hung:
+                        if not dead and not hung:
+                            divergence = vote.tally()
+                            if divergence is not None:
+                                corrupt = self._blame_corrupt(
+                                    divergence, hosts, running, spec, world
+                                )
+                        if dead or hung or divergence is not None:
                             break
                 finally:
                     if not ok:
@@ -370,9 +511,11 @@ class PodSupervisor(ClusterSupervisor):
                         f"world of {world}"
                     )
                     hung = [min(hung)]
-                failures = [(r, "rank_kill") for r in dead] + [
-                    (r, "rank_hang") for r in hung
-                ]
+                failures = (
+                    [(r, "rank_kill") for r in dead]
+                    + [(r, "rank_hang") for r in hung]
+                    + [(r, "bitflip") for r in corrupt]
+                )
                 detected = time.monotonic()
                 for rank, kind in failures:
                     rank_failures += 1
@@ -381,9 +524,18 @@ class PodSupervisor(ClusterSupervisor):
                         labeled(POD_RANK_FAILURES, kind=kind)
                     ).inc()
                     rc = procs[rank].poll()  # dmt-lint: disable=DMT006 — rank was observed dead BEFORE teardown; poll() returns the stored exit code, not a live query
-                    why = f"exit {rc}" if kind == "rank_kill" else (
-                        f"progress stalled {tracker.progress_age_s(rank):.1f}s"
-                    )
+                    if kind == "rank_kill":
+                        why = f"exit {rc}"
+                    elif kind == "rank_hang":
+                        why = (
+                            f"progress stalled "
+                            f"{tracker.progress_age_s(rank):.1f}s"
+                        )
+                    else:
+                        why = (
+                            f"digest vote minority at step "
+                            f"{divergence.step}"
+                        )
                     hit = injector.fire_observed(kind) if injector else None
                     if hit is not None:
                         pending_recoveries.append((kind, detected))
@@ -393,13 +545,50 @@ class PodSupervisor(ClusterSupervisor):
                         )
                     else:
                         self._log(f"rank {rank} failed ({why}) — unplanned")
+                if divergence is not None and not corrupt:
+                    # Mismatch seen but unattributable (tie, no planned
+                    # target): nobody is quarantined — the whole world
+                    # restarts at the same size and the checkpoint restore
+                    # clears whichever replica's memory was corrupt. Still
+                    # book the observed fault so the chaos ledger balances.
+                    self._log(
+                        f"digest vote: mismatch at step {divergence.step} "
+                        f"unattributable — restarting the whole world of "
+                        f"{world}"
+                    )
+                    hit = injector.fire_observed("bitflip") if injector else None
+                    if hit is not None:
+                        pending_recoveries.append(("bitflip", detected))
+                for rank in corrupt:
+                    host = hosts[rank]
+                    ledger.quarantine(
+                        host,
+                        reason="digest vote minority",
+                        step=divergence.step,
+                        digest=divergence.digests.get(host),
+                    )
+                    self.registry.counter(POD_QUARANTINES).inc()
+                    self._log(
+                        f"quarantine: host {host} (rank {rank}) booked in "
+                        f"{ledger.path.name} — barred from every future "
+                        f"spawn"
+                    )
+                if divergence is not None:
+                    self._prune_poisoned_ckpts(divergence.step, ckpt_ring)
+                for rank in dead + hung + corrupt:
+                    # A departed rank's stale digests must not out-vote the
+                    # survivors at steps they have yet to (re)play.
+                    vote.drop_rank(hosts[rank])
 
                 # Survivors = ranks still alive at DETECTION time, minus the
                 # culprits. The teardown SIGKILL that just ran does not
                 # disqualify them — those are live hosts, killed only because
                 # a world with a dead peer cannot drain its collectives.
-                survivors = [r for r in running if r not in dead and r not in hung]
-                if whole_world_hang:
+                survivors = [
+                    r for r in running
+                    if r not in dead and r not in hung and r not in corrupt
+                ]
+                if whole_world_hang or (divergence is not None and not corrupt):
                     # Blame was unknowable, so nobody is excluded: the
                     # blamed rank is a live process like its peers and
                     # rejoins the same-size world.
@@ -422,7 +611,8 @@ class PodSupervisor(ClusterSupervisor):
                     fired = [
                         f"{s.kind}@{s.unit}:{s.at}"
                         for s in injector.plan.specs
-                        if s.kind in ("rank_kill", "rank_hang") and s.fired
+                        if s.kind in ("rank_kill", "rank_hang", "bitflip")
+                        and s.fired
                     ]
                     spec = strip_entries(spec, fired)
                 restarts += 1
@@ -433,6 +623,7 @@ class PodSupervisor(ClusterSupervisor):
                     f"re-forming: world {world} -> {new_world} "
                     f"(restart {restarts}/{self.max_pod_restarts})"
                 )
+                hosts = [hosts[r] for r in sorted(survivors)]
                 world = new_world
         except PodFailure as err:
             self._log(f"FAILED: {err}")

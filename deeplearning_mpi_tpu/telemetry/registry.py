@@ -258,6 +258,18 @@ class MetricsRegistry:
             out.append(self.emit("step", values))
         return out
 
+    def drop_pending_steps(self) -> int:
+        """Discard the buffered (unfetched) step scalars; returns the count.
+
+        Rollback path (numerics guardrails): a ``poisoned`` verdict means
+        the steps since the episode opened never happened — their buffered
+        records must not reach the sinks as if they were real training
+        progress. Dropping device references is free (no device_get).
+        """
+        n = len(self._pending_steps)
+        self._pending_steps.clear()
+        return n
+
     def snapshot(self) -> dict[str, Any]:
         """Current instrument values as one flat dict (for epoch records)."""
         snap: dict[str, Any] = {}

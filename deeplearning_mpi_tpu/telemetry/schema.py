@@ -101,6 +101,14 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "recovery_total": ("counter", frozenset()),
     "rollback_total": ("counter", frozenset()),
     "train_restarts_total": ("counter", frozenset()),
+    # -- numerics guardrails (PR 18, resilience/guardrails.py) --------------
+    "guard_checks_total": ("counter", frozenset()),
+    "guard_digest_mismatch_total": ("counter", frozenset()),
+    "guard_digest_total": ("counter", frozenset()),
+    "guard_poisoned_total": ("counter", frozenset()),
+    "guard_quarantine_total": ("counter", frozenset()),
+    "guard_rollback_total": ("counter", frozenset()),
+    "guard_spike_total": ("counter", frozenset()),
     # -- elastic pod (PR 5) -------------------------------------------------
     "elastic_restore_total": ("counter", frozenset()),
     "pod_rank_failures_total": ("counter", frozenset({"kind"})),

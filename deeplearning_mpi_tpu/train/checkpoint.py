@@ -14,6 +14,7 @@ their target shardings.
 
 from __future__ import annotations
 
+import json
 from pathlib import Path
 from typing import Any
 
@@ -23,6 +24,7 @@ import orbax.checkpoint as ocp
 from deeplearning_mpi_tpu.analysis import sanitizer as _sanitizer
 from deeplearning_mpi_tpu.resilience.integrity import (
     CheckpointCorruption,
+    atomic_write_json,
     corrupt_checkpoint,
     dir_digests,
     read_manifest,
@@ -63,6 +65,20 @@ class Checkpointer:
     ``chaos`` accepts a :class:`~..resilience.faults.ChaosInjector`; a
     planned ``corrupt_ckpt@epoch:N`` flips bytes inside the just-committed
     step so the verify-and-roll-back path is tested against real damage.
+
+    **Last-known-good pinning** (numerics guardrails, docs/RESILIENCE.md):
+    with integrity on, the newest save that still hashes clean AFTER the
+    chaos-corruption hook is pinned in ``last_good.json``. Retention is
+    done manually here, never by Orbax: the keep set is the newest
+    ``max_to_keep`` steps **plus the pin** — the retention bug this
+    replaces let Orbax's count window silently delete the only verified
+    checkpoint while every younger one was corrupt.
+    :meth:`rollback_to_last_good` restores the pin, DELETES every younger
+    step (they contain the poisoned updates), and bumps the pin's
+    monotonic ``generation`` — the anti-rollback fence: a pin file that
+    ever goes backward in generation within one process's lifetime means
+    someone swapped in a stale pin to smuggle old weights past the
+    rollback, and the checkpointer refuses it loudly.
     """
 
     def __init__(
@@ -76,10 +92,16 @@ class Checkpointer:
         self.directory = Path(directory).absolute()
         self.chaos = chaos
         self.integrity = integrity and jax.process_count() == 1
+        self.max_to_keep = max_to_keep
+        #: anti-rollback fence: highest last-good generation seen; None
+        #: until the pin file is first read.
+        self._generation: int | None = None
+        # Retention is OURS (see class docstring): Orbax's max_to_keep
+        # cannot be taught to keep the pinned last-known-good step.
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=None, create=True
             ),
         )
 
@@ -126,9 +148,132 @@ class Checkpointer:
             self.manager.wait_until_finished()
             victim = corrupt_checkpoint(self.directory / str(epoch))
             print(f"chaos: corrupted checkpoint epoch {epoch} ({victim.name})")
+        if self.integrity:
+            # Pin AFTER the chaos hook, by re-hashing: only a save whose
+            # bytes still match its manifest becomes the last-known-good —
+            # a corrupted save must never be what rollback lands on.
+            manifest = read_manifest(self.directory, epoch)
+            if manifest is not None and dir_digests(
+                self.directory / str(epoch)
+            ) == manifest:
+                self._pin(epoch)
+        self._prune_retained(keep_also=epoch)
 
     def latest_epoch(self) -> int | None:
         return self.manager.latest_step()
+
+    # -- last-known-good pin + manual retention -----------------------------
+    def _pin_path(self) -> Path:
+        return self.directory / "last_good.json"
+
+    def _load_pin(self) -> dict | None:
+        """Read ``last_good.json`` through the anti-rollback fence: the
+        on-disk generation must never be OLDER than one this process has
+        already seen — a backward jump means the pin was swapped for a
+        stale copy (the classic anti-rollback attack on A/B firmware
+        slots), and trusting it would resurrect checkpoints the rollback
+        deliberately discarded."""
+        try:
+            data = json.loads(self._pin_path().read_text())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(data, dict) or "epoch" not in data:
+            return None
+        gen = int(data.get("generation", 0))
+        if self._generation is not None and gen < self._generation:
+            raise CheckpointCorruption(
+                f"anti-rollback fence: on-disk last-good generation {gen} "
+                f"is older than this process's {self._generation} — "
+                f"{self._pin_path()} was replaced with a stale pin"
+            )
+        self._generation = gen
+        return data
+
+    def _pin(self, epoch: int) -> None:
+        atomic_write_json(
+            self._pin_path(),
+            {"epoch": epoch, "generation": self._generation or 0},
+        )
+
+    def last_good_epoch(self) -> int | None:
+        """The pinned digest-verified epoch, or None (no pin yet)."""
+        pin = self._load_pin()
+        return int(pin["epoch"]) if pin is not None else None
+
+    def _prune_retained(self, *, keep_also: int) -> None:
+        """Manual retention: drop all but the newest ``max_to_keep`` steps,
+        ALWAYS keeping the pinned last-known-good — the whole point of
+        owning retention (a run where every younger save is corrupt must
+        still be able to roll back to the pin, however old)."""
+        if not self.max_to_keep:
+            return
+        steps = sorted(set(self.manager.all_steps()) | {keep_also})
+        keep = set(steps[-self.max_to_keep:])
+        pin = self.last_good_epoch() if self.integrity else None
+        if pin is not None:
+            keep.add(pin)
+        doomed = [s for s in steps if s not in keep]
+        if not doomed:
+            return
+        # Deleting under an in-flight async save is a hazard; barrier first.
+        self.manager.wait_until_finished()
+        for step in doomed:
+            self.manager.delete(step)
+        if self.integrity:
+            self._prune_manifests(keep_also=keep_also)
+
+    def rollback_to_last_good(self, template: TrainState) -> tuple[TrainState, int]:
+        """Restore the pinned last-known-good checkpoint, DELETE every
+        younger step, and bump the anti-rollback generation; returns
+        ``(state, epoch)``.
+
+        The guardrails' ``poisoned`` recovery path (docs/RESILIENCE.md):
+        younger checkpoints may contain the poisoned updates — unlike
+        :meth:`restore_verified`'s walk, which would happily resume from a
+        bytes-clean-but-numerically-poisoned newer save, this discards
+        them. The pin is still re-verified before restore (pin → corrupt
+        since save is possible); a missing or corrupt pin falls back to
+        the verified walk. The generation bump makes the rollback
+        irreversible on disk: any later appearance of a lower generation
+        trips the fence in :meth:`_load_pin`.
+        """
+        self.manager.wait_until_finished()
+        state: TrainState | None = None
+        epoch: int | None = None
+        pin = self._load_pin() if self.integrity else None
+        if pin is not None and int(pin["epoch"]) in set(self.manager.all_steps()):
+            epoch = int(pin["epoch"])
+            manifest = read_manifest(self.directory, epoch)
+            if manifest is None or dir_digests(
+                self.directory / str(epoch)
+            ) == manifest:
+                try:
+                    restored = self.manager.restore(
+                        epoch,
+                        args=ocp.args.StandardRestore(_arrays_only(template)),
+                    )
+                    state = template.replace(**restored)
+                except Exception as err:  # noqa: BLE001 — unreadable = corrupt
+                    self._note_corrupt(epoch, f"restore failed: {err}")
+            else:
+                self._note_corrupt(epoch, "pinned step no longer hashes clean")
+        if state is None:
+            # No pin (or it died since save): the verified walk is the best
+            # remaining evidence of a good state.
+            state, epoch = self.restore_verified(template)
+        assert epoch is not None
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            if step > epoch:
+                print(
+                    f"rollback: discarding checkpoint epoch {step} "
+                    f"(younger than last-good {epoch})"
+                )
+                self.manager.delete(step)
+        if self.integrity:
+            self._prune_manifests(keep_also=epoch)
+            self._generation = (self._generation or 0) + 1
+            self._pin(epoch)
+        return state, epoch
 
     def _prune_manifests(self, *, keep_also: int | None = None) -> None:
         """Drop manifests for steps the manager has retired, so retention
@@ -138,6 +283,9 @@ class Checkpointer:
         keep = set(self.manager.all_steps())
         if keep_also is not None:
             keep.add(keep_also)
+        pin = self.last_good_epoch()
+        if pin is not None:
+            keep.add(pin)  # the pinned step's manifest must outlive the window
         for mf in self.directory.glob("manifest-*.json"):
             try:
                 epoch = int(mf.stem.split("-", 1)[1])
@@ -189,6 +337,13 @@ class Checkpointer:
             except Exception as err:  # noqa: BLE001 — unreadable = corrupt
                 self._note_corrupt(epoch, f"restore failed: {err}")
                 continue
+            if self.integrity:
+                pin = self._load_pin()
+                if pin is not None and epoch < int(pin["epoch"]):
+                    # The walk landed BELOW the pin: the pinned step itself
+                    # failed (deleted or corrupt since save). Re-pin to what
+                    # actually restored so retention protects it from here.
+                    self._pin(epoch)
             return template.replace(**restored), epoch
         raise CheckpointCorruption(
             f"no checkpoint under {self.directory} survived verification "

@@ -135,6 +135,7 @@ def make_train_step(
     seg_loss: str = "bce",
     state_shardings: Any = None,
     ema_decay: float = 0.0,
+    guard_metrics: bool = False,
 ) -> Callable[[TrainState, Batch], tuple[TrainState, dict[str, jax.Array]]]:
     """Build the jitted optimizer step for a task.
 
@@ -179,6 +180,24 @@ def make_train_step(
     update (``ema = d*ema + (1-d)*params``); requires a state built with
     ``create_train_state(..., ema=True)``. A NaN-skipped step leaves the
     EMA untouched along with everything else.
+
+    ``guard_metrics=True`` (numerics guardrails — docs/RESILIENCE.md)
+    additionally returns the gradient global-norm in the metrics and
+    extends the finite guard to ``isfinite(loss) AND isfinite(grad_norm)``
+    — non-finite *gradients under a finite loss* (the ``nan_grads`` chaos
+    kind; real-world: an overflowing bwd matmul) then skip the update just
+    like a NaN loss. Off (the default) the emitted program is byte-
+    identical to before the flag existed: zero extra outputs, zero extra
+    FLOPs — the guardrails' costless-when-off contract.
+
+    Chaos scale keys: the injector's ``maybe_guard_fault`` may add
+    ``__loss_scale__`` / ``__grad_scale__`` scalar keys to the batch.
+    They are popped here at trace time (before the grad-accum split, whose
+    per-leaf reshape would choke on a scalar): the loss scale multiplies
+    both the reported loss and the differentiated total (a visible loss
+    spike), the grad scale multiplies ONLY the differentiated total — the
+    reported loss stays normal while the gradients blow up, which is
+    exactly the failure loss-watching alone cannot see.
     """
     # Donation is vetoed wholesale where it is unsafe (XLA:CPU + persistent
     # compile cache — see compat.buffer_donation_supported), not per caller:
@@ -208,6 +227,13 @@ def make_train_step(
         # metric's inclusion so dense runs don't log a meaningless 0.0.
         moe_drop_seen: list[bool] = []
 
+        # Chaos scale keys out BEFORE the grad-accum split sees the batch
+        # (dict mutation at trace time is free — key presence is static, so
+        # a clean batch compiles the exact pre-guardrail program).
+        batch = dict(batch)
+        loss_scale = batch.pop("__loss_scale__", None)
+        grad_scale = batch.pop("__grad_scale__", None)
+
         def loss_and_grads(batch_stats, chunk, data_scale=None, aux_scale=None):
             # data_scale/aux_scale (grad-accum only) fold the cross-chunk
             # weights INTO the differentiated scalar, so data loss and aux
@@ -224,10 +250,16 @@ def make_train_step(
                     mutable=["batch_stats", AUX_COLLECTION, METRIC_COLLECTION],
                 )
                 loss = loss_fn(outputs, chunk)
+                if loss_scale is not None:
+                    loss = loss * loss_scale  # loss_spike: visible blow-up
                 total = loss if data_scale is None else data_scale * loss
                 if aux_weight:
                     a = aux_weight if aux_scale is None else aux_scale
                     total = total + a * collect_aux_loss(mutated)
+                if grad_scale is not None:
+                    # grad_spike/nan_grads: only the DIFFERENTIATED scalar
+                    # is scaled — the returned (reported) loss stays clean.
+                    total = total * grad_scale
                 drop = collect_dropped_fraction(mutated)
                 if drop is not None and not moe_drop_seen:
                     moe_drop_seen.append(True)
@@ -307,7 +339,12 @@ def make_train_step(
         # formulation that executes only the taken branch benchmarked
         # *slower* (180.5 vs 176.5 ms/step) — XLA materializes copies around
         # the cond's operands/results that cost more than the select saves.
+        grad_norm = optax.global_norm(grads) if guard_metrics else None
         finite = jnp.isfinite(loss)
+        if grad_norm is not None:
+            # Extended guard (guard_metrics): non-finite grads under a
+            # finite loss must ALSO skip — a NaN param update is forever.
+            finite = finite & jnp.isfinite(grad_norm)
         keep = lambda new, old: jax.tree.map(
             lambda n, o: jnp.where(finite, n, o), new, old
         )
@@ -329,6 +366,8 @@ def make_train_step(
                 ema,
             )
         metrics = {"loss": loss, "finite": jnp.asarray(finite, jnp.float32)}
+        if grad_norm is not None:
+            metrics["grad_norm"] = grad_norm
         if moe_drop_seen:
             metrics["moe_dropped_frac"] = drop_frac
         return (
@@ -540,6 +579,7 @@ class Trainer:
         chaos: Any = None,  # resilience.ChaosInjector; injects planned faults
         shutdown: Any = None,  # resilience.GracefulShutdown; batch-boundary stop
         tracer: Any = None,  # telemetry.SpanRecorder; per-step phase spans
+        guardrails: Any = None,  # resilience.GuardrailPolicy; numerics watchdog
     ) -> None:
         from deeplearning_mpi_tpu.telemetry.registry import (
             LoggerSink,
@@ -582,13 +622,51 @@ class Trainer:
         # phases instead of one opaque residual; the syncs are the price
         # of attribution and are opt-in by construction.
         self.tracer = tracer
+        # Numerics guardrails (docs/RESILIENCE.md): None keeps the hot loop
+        # untouched — zero guardrail objects allocated, zero extra host
+        # syncs (regression-locked like tracing). Attached, each step's
+        # scalars are fetched and judged (the sanctioned sync, same doctrine
+        # as the tracer's fences) and the step is rebuilt with
+        # guard_metrics so the grad global-norm rides the metrics.
+        self.guardrails = guardrails
+        #: the poisoned verdict awaiting rollback service — set just before
+        #: RollbackRequested is raised so the auto-resume closure
+        #: (utils/config.py execute_training) can tell a rollback retry
+        #: from a crash retry.
+        self.pending_rollback: Any = None
+        self._guard_metrics = guardrails is not None
+        #: {step: sha256} digest ring riding every heartbeat (digest vote).
+        self._digest_ring: dict[int, str] = {}
+        #: {epoch: global_step at save} — lets the pod supervisor map a
+        #: divergence step to the checkpoints that must be pruned.
+        self._ckpt_ring: dict[int, int] = {}
+        if chaos is not None and guardrails is None:
+            from deeplearning_mpi_tpu.resilience.faults import GUARD_KINDS
+
+            planned = sorted(
+                {s.kind for s in chaos.plan.specs if s.kind in GUARD_KINDS}
+            )
+            if planned:
+                # Fail loud at construction: without a policy these faults
+                # would fire and nothing could ever detect or account for
+                # them — the reconciliation invariant would be
+                # unfalsifiable (validate_plan_kinds's doctrine, one layer
+                # up).
+                raise ValueError(
+                    f"chaos kind(s) {', '.join(planned)} need a guardrail "
+                    "policy attached (Trainer(guardrails=...) / "
+                    "--guardrails) — without one they could never be "
+                    "detected and the chaos books could never balance"
+                )
         # Host-side step counter: int(state.step) would force a device sync.
         self._global_step = 0
         self._step_kwargs = dict(
             aux_weight=aux_weight, grad_accum=grad_accum, loss_chunk=loss_chunk,
             seg_loss=seg_loss, ema_decay=ema_decay,
         )
-        self.train_step = make_train_step(task, **self._step_kwargs)
+        self.train_step = make_train_step(
+            task, guard_metrics=self._guard_metrics, **self._step_kwargs
+        )
         self.eval_step = make_eval_step(task, loss_chunk=loss_chunk, seg_loss=seg_loss)
         self.history: list[dict[str, float]] = []
         self._profiled = False
@@ -712,6 +790,10 @@ class Trainer:
                     # NaN poisoning rides the batch; the jitted step's own
                     # finite-guard — not the injector — must skip the update.
                     batch = self.chaos.maybe_poison(batch, self.task, step=self._global_step)
+                    # Numerics chaos (loss_spike/grad_spike/nan_grads) rides
+                    # the batch as scale keys; the guardrail policy — not the
+                    # injector — must detect and account for it.
+                    batch = self.chaos.maybe_guard_fault(batch, step=self._global_step)
                 if self.profiler is not None and not self._profiled:
                     if n_batches == self.PROFILE_STEPS[0]:
                         self.profiler.start()
@@ -747,6 +829,20 @@ class Trainer:
                                        trace=step_trace)
                     tracer.record_span("collective_tail", t_loss, t_tail,
                                        trace=step_trace, epoch=epoch)
+                if self.chaos is not None:
+                    # Post-update SDC injection: silently corrupt one param
+                    # leaf on the target rank — no loss signal, only the
+                    # cross-rank digest vote can catch it.
+                    flipped = self.chaos.maybe_bitflip(
+                        self.state.params, step=self._global_step
+                    )
+                    if flipped is not None:
+                        self.state = self.state.replace(params=flipped)
+                if self.guardrails is not None:
+                    # Judge THIS step before the counter advances — a
+                    # poisoned verdict raises RollbackRequested out of the
+                    # epoch (the finally below still joins the prefetcher).
+                    self._guard_observe(metrics, epoch=epoch, step=self._global_step)
                 if timer is not None:
                     timer.tick(metrics["loss"])
                 if self.metrics_every and self._global_step % self.metrics_every == 0:
@@ -759,10 +855,18 @@ class Trainer:
                     # hung collective (thread wedged, daemon still beating)
                     # reads as a progress stall, and per-rank step cadence
                     # feeds straggler flagging.
-                    self.heartbeat.progress = {
+                    progress = {
                         "epoch": epoch, "step_in_epoch": n_batches,
                         "step": self._global_step, "phase": "train",
                     }
+                    if self._digest_ring:
+                        # Param digests + checkpoint save-steps ride the
+                        # beat so the pod supervisor can run the cross-rank
+                        # digest vote and map a divergence to the
+                        # checkpoints it must prune.
+                        progress["digests"] = dict(self._digest_ring)
+                        progress["ckpts"] = dict(self._ckpt_ring)
+                    self.heartbeat.progress = progress
                 # Accumulate on device, excluding non-finite batches from the mean
                 # (the reference `continue`s before accumulating epoch loss,
                 # pytorch/unet/train.py:186-188) — one NaN batch must not poison
@@ -903,6 +1007,91 @@ class Trainer:
         )
         return stats
 
+    def _guard_observe(self, metrics: dict[str, jax.Array], *, epoch: int, step: int) -> None:
+        """Feed one step's health scalars to the guardrail policy and act
+        on the verdict (numerics guardrails — docs/RESILIENCE.md).
+
+        The float() fetches below are the sanctioned per-step host sync —
+        same doctrine as the tracer's fences: attribution costs a sync and
+        is opt-in by construction (guardrails=None never reaches here,
+        locked by the costless-when-off regression test).
+
+        Verdicts: ``spike`` is tolerated in place (counted, logged, and —
+        under chaos — closes the fired spec's recovery book: the clip/skip
+        machinery genuinely contained it). ``poisoned`` drops the buffered
+        poisoned step records, dumps the flight recorder, books a chaos
+        rollback, and raises :class:`RollbackRequested` — serviced by the
+        auto-resume closure via ``Checkpointer.rollback_to_last_good``.
+        """
+        import os
+
+        from deeplearning_mpi_tpu.resilience.guardrails import (
+            RollbackRequested,
+            attach_digest_ring,
+            param_digest,
+        )
+
+        # Drill pacing knob: the guardrail drill's tiny CPU model finishes
+        # its whole run faster than a supervisor poll cycle, so the bitflip
+        # arm slows the observed loop down to heartbeat speed. Honored only
+        # with a policy attached — the guardrails-off path never reads it.
+        delay = float(os.environ.get("DMT_GUARD_STEP_DELAY_S", "0") or 0.0)
+        if delay > 0:
+            time.sleep(delay)
+        loss = float(metrics["loss"])
+        finite = float(metrics["finite"]) > 0
+        gn = metrics.get("grad_norm")
+        grad_norm = float(gn) if gn is not None else None
+        self.metrics.counter("guard_checks_total").inc()
+        verdict = self.guardrails.observe(
+            step, loss=loss, grad_norm=grad_norm, finite=finite
+        )
+        cfg = self.guardrails.config
+        if cfg.digest_every and step % cfg.digest_every == 0:
+            attach_digest_ring(
+                self._digest_ring, step,
+                param_digest(self.state.params, sample_leaves=cfg.digest_sample_leaves),
+            )
+            self.metrics.counter("guard_digest_total").inc()
+        if verdict.ok:
+            return
+        if verdict.status == "spike":
+            self.metrics.counter("guard_spike_total").inc()
+            self._log(
+                f"guardrail: tolerated {verdict.signal} spike at step {step} "
+                f"(z={verdict.z:.1f}): {verdict.reason}"
+            )
+            if self.chaos is not None:
+                # A contained spike IS the recovery for the spike kinds:
+                # clip_norm absorbed a grad_spike, the finite guard skipped
+                # nan_grads. at= matches the exact fired spec; kinds not in
+                # the plan are no-ops.
+                for kind in ("grad_spike", "loss_spike", "nan_grads"):
+                    self.chaos.record_recovery(kind, at=step)
+            return
+        # poisoned: the in-memory state can no longer be trusted past the
+        # attributed region — roll back to the pinned last-known-good.
+        self.metrics.counter("guard_poisoned_total").inc()
+        dropped = self.metrics.drop_pending_steps()
+        self._log(
+            f"guardrail: POISONED at step {step} ({verdict.signal}, "
+            f"z={verdict.z:.1f}, region={verdict.region}): {verdict.reason} — "
+            f"requesting rollback (dropped {dropped} buffered step records)"
+        )
+        if self.chaos is not None:
+            # The rollback is the terminal accounting for whichever guard
+            # spec escalated; at=None matches the oldest fired-unresolved.
+            for kind in ("loss_spike", "grad_spike", "nan_grads"):
+                self.chaos.record_rollback(kind)
+        try:
+            from deeplearning_mpi_tpu.telemetry import spans
+
+            spans.dump_all(f"guard-rollback-step{step}")
+        except Exception:
+            pass  # the flight dump is evidence, never the failure itself
+        self.pending_rollback = verdict
+        raise RollbackRequested(verdict)
+
     def _log_metrics(self, kind: str, record: dict[str, Any]) -> None:
         """Emit one canonical metrics record through the registry — every
         sink (RunLogger sidecar, ``--metrics_dir`` JSONL, TensorBoard, ...)
@@ -959,6 +1148,13 @@ class Trainer:
         """Checkpoint save wrapped in a ``checkpoint`` phase span — the
         fifth named phase of the step-time budget (the others meter the
         loop; this one meters the save stall between epochs)."""
+        if self._guard_metrics:
+            # Record which global step this save captured (rides the
+            # heartbeat next to the digests): the pod supervisor uses it to
+            # prune checkpoints taken at-or-after a digest divergence.
+            self._ckpt_ring[epoch] = self._global_step
+            while len(self._ckpt_ring) > 8:
+                self._ckpt_ring.pop(min(self._ckpt_ring))
         if self.tracer is None:
             self.checkpointer.save(self.state, epoch=epoch)
             return
@@ -1064,7 +1260,15 @@ class Trainer:
         )
 
         self.state = shard_state(self.state, self.mesh, zero=self.zero)
-        if self.zero and self.overlap:
+        if self.zero and self.overlap and self._guard_metrics:
+            # The explicit bucketed schedule computes no grad global-norm
+            # metric; guardrails need it, so fall back to the GSPMD step
+            # (bit-identical where both apply) rather than judge blind.
+            self._log(
+                "overlap: guardrails need grad-norm metrics — using the "
+                "GSPMD ZeRO-1 step instead of the bucketed schedule"
+            )
+        if self.zero and self.overlap and not self._guard_metrics:
             from deeplearning_mpi_tpu.parallel.zero import (
                 OverlapUnsupported,
                 make_overlapped_train_step,
@@ -1089,6 +1293,7 @@ class Trainer:
                 state_shardings=infer_state_sharding(
                     self.state, self.mesh, zero=self.zero
                 ),
+                guard_metrics=self._guard_metrics,
                 **self._step_kwargs,
             )
 
@@ -1137,7 +1342,9 @@ class Trainer:
             self._step_kwargs["grad_accum"] = int(params["grad_accum"])
         if "overlap" in params:
             self.overlap = bool(params["overlap"])
-        self.train_step = make_train_step(self.task, **self._step_kwargs)
+        self.train_step = make_train_step(
+            self.task, guard_metrics=self._guard_metrics, **self._step_kwargs
+        )
         self._log(
             "tuned step schedule applied: "
             + ", ".join(f"{k}={v}" for k, v in sorted(params.items()))

@@ -140,6 +140,20 @@ def add_training_flags(
                        "in fault_injected_total / recovery_total / "
                        "rollback_total. $DMT_CHAOS is the env fallback. See "
                        "docs/RESILIENCE.md")
+    group.add_argument("--guardrails", action="store_true",
+                       help="numerics guardrails: judge every step's loss/"
+                       "grad-norm/finite scalars through EWMA robust-z "
+                       "detectors; tolerated spikes are logged, a poisoned "
+                       "verdict rolls back to the pinned last-known-good "
+                       "checkpoint and replays (pair with --max_restarts). "
+                       "Costs one host sync per step; off (default) adds "
+                       "zero syncs and zero allocations. docs/RESILIENCE.md")
+    group.add_argument("--digest_every", type=int, default=0,
+                       help="with --guardrails: every N steps, sha256 a "
+                       "fixed sample of param leaves and publish it on the "
+                       "heartbeat for the pod supervisor's cross-rank digest "
+                       "vote (a bit-flipped replica is blamed directly; "
+                       "minority digest loses). 0 = off")
     group.add_argument("--debug_nans", action="store_true", help="jax_debug_nans: raise at the first NaN-producing op (SURVEY.md §5.2)")
     group.add_argument("--num_workers", type=int, default=None,
                        help="loader fetch threads per host (default: half the "
@@ -332,10 +346,42 @@ def restore_for_start(args, checkpointer, state, logger):
 
 def build_chaos(args: argparse.Namespace):
     """Resolve ``--chaos`` (or ``$DMT_CHAOS``) into a ChaosInjector, or
-    ``None`` when no plan is set — the common case pays one None check."""
-    from deeplearning_mpi_tpu.resilience.faults import ChaosInjector
+    ``None`` when no plan is set — the common case pays one None check.
 
-    return ChaosInjector.from_spec(getattr(args, "chaos", None))
+    The plan is validated against :data:`~..resilience.faults.TRAIN_KINDS`:
+    a kind the training workload has no injection hook for (e.g.
+    ``serve_crash``) fails loud at parse time instead of silently never
+    firing and leaving the reconciliation invariant unbalanced.
+    """
+    from deeplearning_mpi_tpu.resilience.faults import (
+        TRAIN_KINDS,
+        ChaosInjector,
+        validate_plan_kinds,
+    )
+
+    injector = ChaosInjector.from_spec(getattr(args, "chaos", None))
+    if injector is not None:
+        validate_plan_kinds(
+            ",".join(f"{s.kind}@{s.unit}:{s.at}" for s in injector.plan.specs),
+            TRAIN_KINDS, workload="training",
+        )
+    return injector
+
+
+def build_guardrails(args: argparse.Namespace):
+    """Resolve ``--guardrails``/``--digest_every`` into a GuardrailPolicy,
+    or ``None`` (the costless-when-off default: no policy object means the
+    trainer allocates nothing and adds no host syncs)."""
+    if not getattr(args, "guardrails", False):
+        return None
+    from deeplearning_mpi_tpu.resilience.guardrails import (
+        GuardrailConfig,
+        GuardrailPolicy,
+    )
+
+    return GuardrailPolicy(
+        GuardrailConfig(digest_every=getattr(args, "digest_every", 0) or 0)
+    )
 
 
 def setup_runtime(args: argparse.Namespace):
@@ -521,6 +567,41 @@ def execute_training(
         nonlocal attempts
         attempts += 1
         if attempts > 1:
+            pending = getattr(trainer, "pending_rollback", None)
+            if pending is not None:
+                # Guardrail rollback (docs/RESILIENCE.md): the poisoned
+                # steps never happened. Restore the PINNED last-known-good
+                # (not merely the newest bytes-clean step, which may carry
+                # the poisoned updates), discard younger checkpoints, and
+                # replay — the loader order is (seed, epoch)-deterministic,
+                # so the replay rejoins the unfaulted trajectory.
+                trainer.pending_rollback = None
+                template = state_factory() if state_factory else trainer.state
+                if checkpointer.latest_epoch() is not None:
+                    trainer.state, epoch = checkpointer.rollback_to_last_good(
+                        template
+                    )
+                    restart_epoch = epoch + 1
+                else:
+                    # Poisoned before the first save: a fresh init IS the
+                    # last-known-good.
+                    trainer.state = template
+                    restart_epoch = 0
+                # Rejoin the restored state's step count, so the replayed
+                # steps' records/triggers line up with a clean run's.
+                trainer._global_step = int(trainer.state.step)
+                trainer.place_state()
+                trainer.metrics.counter("guard_rollback_total").inc()
+                trainer._log(
+                    f"guardrail rollback: restored last-good state (step "
+                    f"{trainer._global_step}); replaying from epoch "
+                    f"{restart_epoch} (poison region {pending.region})"
+                )
+                return trainer.fit(
+                    train_loader, args.num_epochs,
+                    eval_loader=eval_loader,
+                    start_epoch=max(start_epoch, restart_epoch),
+                )
             # Crash restart: the previous state's buffers may be donated/
             # deleted — ALWAYS rebuild, from the newest checkpoint that
             # passes integrity verification when one exists, else from a

@@ -1,0 +1,687 @@
+"""Numerics-guardrail tests: SDC detection, digest voting, rollback-and-
+replay, quarantine, and the costless-when-off contract.
+
+Structured bottom-up, like the subsystem (``docs/RESILIENCE.md`` "Numerics
+guardrails"):
+
+- :class:`GuardrailPolicy` — the pure per-step verdict machine (warmup
+  grace, EWMA bands, spike/poison thresholds, patience escalation,
+  hysteresis, replay attribution).
+- :class:`DigestVote` / :func:`param_digest` / ``maybe_bitflip`` — the
+  cross-rank SDC detector and the chaos hook it detects.
+- :class:`QuarantineLedger` — the persistent blame book.
+- :class:`Checkpointer` hardening — the pinned last-known-good surviving
+  retention with every younger save corrupt, ``rollback_to_last_good``
+  discarding poisoned steps, and the anti-rollback generation fence.
+- the fault-kind audit — every kind in every ``*_KINDS`` set is
+  grammar-parseable, workload-validated, and has a live injection hook.
+- trainer integration — the all-non-finite epoch path, spike-kind
+  accounting (``nan_grads``), the loss-spike rollback-and-replay e2e
+  rejoining the unfaulted trajectory, and the costless-when-off
+  regression (no policy => no guardrail objects, no extra metrics, no
+  guardrail code reachable from the hot loop).
+
+The two-process bitflip drill (digest vote -> quarantine -> re-form) needs
+real subprocess ranks and lives in ``tools/guardrail_drill.py``
+(``make guard-smoke``); everything in-process is covered here.
+"""
+
+import json
+import math
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning_mpi_tpu.data import ShardedLoader, SyntheticTokens
+from deeplearning_mpi_tpu.models import TransformerConfig, TransformerLM
+from deeplearning_mpi_tpu.resilience import (
+    ChaosInjector,
+    CheckpointCorruption,
+    FaultPlan,
+    ResilientLoader,
+    atomic_write_json,
+    tree_digests,
+)
+from deeplearning_mpi_tpu.resilience.faults import (
+    AUTOSCALE_KINDS,
+    DISAGG_KINDS,
+    FAULT_INJECTED,
+    FAULT_UNITS,
+    FLEET_KINDS,
+    GUARD_KINDS,
+    POD_KINDS,
+    RECOVERY,
+    ROLLBACK,
+    SERVE_KINDS,
+    TRAIN_KINDS,
+    validate_plan_kinds,
+)
+from deeplearning_mpi_tpu.resilience.guardrails import (
+    DigestVote,
+    GuardrailConfig,
+    GuardrailPolicy,
+    QuarantineLedger,
+    VoteResult,
+    attach_digest_ring,
+    param_digest,
+)
+from deeplearning_mpi_tpu.train import Checkpointer, Trainer, create_train_state
+from deeplearning_mpi_tpu.train.trainer import build_optimizer, make_train_step
+
+
+# -- shared tiny-LM plumbing --------------------------------------------------
+
+def _lm_factory(mesh=None, seed=0, ema=False):
+    model = TransformerLM(config=TransformerConfig.tiny(), dtype=jnp.float32)
+    tx = build_optimizer("sgd", 1e-2, momentum=0.0)
+
+    def factory():
+        return create_train_state(
+            model, jax.random.key(seed), jnp.zeros((1, 16), jnp.int32), tx,
+            mesh=mesh, ema=ema,
+        )
+
+    return factory
+
+
+def _warm(policy, n, value=1.0):
+    """Feed ``n`` calm steps; returns the next step index."""
+    for step in range(n):
+        verdict = policy.observe(step, loss=value)
+        assert verdict.ok, verdict
+    return n
+
+
+def _denom(policy, signal="loss"):
+    """The robust-z denominator the policy will use for ``signal`` now."""
+    band = policy._bands[signal]
+    return max(band.dev, 1e-8, abs(band.mean) * 1e-3)
+
+
+# -- GuardrailPolicy ----------------------------------------------------------
+
+class TestGuardrailPolicy:
+    CFG = GuardrailConfig(warmup_steps=4, spike_patience=2, hysteresis_steps=3)
+
+    def test_warmup_grace_judges_nothing(self):
+        pol = GuardrailPolicy(self.CFG)
+        # Wildly bimodal losses: any z-test would scream, but the first
+        # warmup_steps observations only build bands.
+        for step, loss in enumerate([1.0, 500.0, 1.0, 500.0]):
+            assert pol.observe(step, loss=loss).ok
+
+    def test_calm_steps_stay_ok_and_update_bands(self):
+        pol = GuardrailPolicy(self.CFG)
+        step = _warm(pol, 8)
+        assert pol.observe(step, loss=1.0).ok
+        band = pol._bands["loss"]
+        assert band.n == 9 and band.mean == pytest.approx(1.0)
+
+    def test_spike_verdict_between_thresholds(self):
+        pol = GuardrailPolicy(self.CFG)
+        step = _warm(pol, 8)
+        x = 1.0 + 9.0 * _denom(pol)  # z ~ 9: >= z_spike 6, < z_poison 12
+        v = pol.observe(step, loss=x)
+        assert v.status == "spike" and v.signal == "loss"
+        assert 6.0 <= v.z < 12.0
+        assert v.region == (step, step)
+
+    def test_instant_poison_above_z_poison(self):
+        pol = GuardrailPolicy(self.CFG)
+        step = _warm(pol, 8)
+        v = pol.observe(step, loss=1.0 + 50.0 * _denom(pol))
+        assert v.status == "poisoned" and v.region == (step, step)
+        # A poisoned verdict resets the policy: the caller rolls back to a
+        # state where this band history never happened.
+        assert pol._seen == 0 and not pol._bands
+
+    def test_spike_run_escalates_past_patience(self):
+        pol = GuardrailPolicy(self.CFG)
+        step = _warm(pol, 8)
+        x = 1.0 + 9.0 * _denom(pol)
+        assert pol.observe(step, loss=x).status == "spike"
+        assert pol.observe(step + 1, loss=x).status == "spike"
+        v = pol.observe(step + 2, loss=x)  # 3 consecutive > patience 2
+        assert v.status == "poisoned"
+        assert v.region == (step, step + 2)  # whole episode attributed
+
+    def test_hysteresis_freezes_bands_until_calm(self):
+        pol = GuardrailPolicy(self.CFG)
+        step = _warm(pol, 8)
+        dev_before = pol._bands["loss"].dev
+        assert pol.observe(step, loss=1.0 + 9.0 * _denom(pol)).status == "spike"
+        # Calm steps inside the episode: verdict ok, bands still frozen.
+        for i in range(1, self.CFG.hysteresis_steps):
+            v = pol.observe(step + i, loss=1.0)
+            assert v.ok and v.reason == "episode cooling"
+            assert pol._bands["loss"].dev == dev_before
+        # The closing calm step thaws the bands and updates them again.
+        v = pol.observe(step + self.CFG.hysteresis_steps, loss=1.0)
+        assert v.ok and v.reason == ""
+        assert pol._episode_start is None
+        assert pol._bands["loss"].dev != dev_before
+
+    def test_non_finite_is_spike_even_during_warmup(self):
+        pol = GuardrailPolicy(self.CFG)
+        v = pol.observe(0, loss=float("nan"), finite=False)
+        assert v.status == "spike" and v.signal == "finite"
+        assert v.z == float("inf")
+
+    def test_grad_norm_signal_judged_independently(self):
+        pol = GuardrailPolicy(self.CFG)
+        for step in range(8):
+            assert pol.observe(step, loss=1.0, grad_norm=2.0).ok
+        x = 2.0 + 50.0 * _denom(pol, "grad_norm")
+        v = pol.observe(8, loss=1.0, grad_norm=x)  # loss calm, grads explode
+        assert v.status == "poisoned" and v.signal == "grad_norm"
+
+    def test_replay_scale_regions(self):
+        for replay, inside in (("none", 1.0), ("skip", 0.0), ("clip", 0.1)):
+            pol = GuardrailPolicy(GuardrailConfig(replay=replay))
+            assert pol.replay_scale(5, (4, 6)) == inside
+            assert pol.replay_scale(7, (4, 6)) == 1.0
+            assert pol.replay_scale(5, None) == 1.0
+
+
+# -- DigestVote ---------------------------------------------------------------
+
+class TestDigestVote:
+    def test_majority_blames_minority(self):
+        vote = DigestVote()
+        vote.observe(0, {"4": "a"})  # str keys: heartbeat JSON round-trip
+        vote.observe(1, {4: "a"})
+        vote.observe(2, {4: "b"})
+        assert vote.tally() == VoteResult(4, (2,), {0: "a", 1: "a", 2: "b"})
+
+    def test_two_rank_tie_is_unattributed(self):
+        vote = DigestVote()
+        vote.observe(0, {3: "a"})
+        vote.observe(1, {3: "b"})
+        result = vote.tally()
+        assert result is not None and result.minority == ()
+
+    def test_single_ring_has_no_quorum(self):
+        vote = DigestVote()
+        vote.observe(0, {1: "a", 2: "b"})
+        assert vote.tally() is None
+
+    def test_agreement_advances_watermark(self):
+        vote = DigestVote()
+        vote.observe(0, {1: "x", 2: "y"})
+        vote.observe(1, {1: "x", 2: "y"})
+        assert vote.tally() is None
+        assert vote.last_agreed_step == 2
+        # A late rewrite of an already-agreed step is never re-judged —
+        # the watermark bounds how far back blame (and the checkpoint
+        # prune) can reach.
+        vote.observe(1, {2: "z"})
+        assert vote.tally() is None
+
+    def test_earliest_divergence_wins(self):
+        vote = DigestVote()
+        vote.observe(0, {5: "a", 7: "a"})
+        vote.observe(1, {5: "b", 7: "b"})
+        result = vote.tally()
+        assert result is not None and result.step == 5
+
+    def test_drop_rank_forgets_stale_digests(self):
+        vote = DigestVote()
+        vote.observe(0, {4: "a"})
+        vote.observe(1, {4: "a"})
+        vote.observe(2, {4: "b"})
+        assert vote.tally().minority == (2,)
+        vote.drop_rank(2)
+        # Survivors agree; the departed rank's ring can't out-vote them.
+        assert vote.tally() is None
+
+
+# -- param_digest + bitflip chaos hook ---------------------------------------
+
+class TestParamDigest:
+    def _params(self):
+        return {
+            "w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "b": jnp.ones((4,), jnp.float32),
+        }
+
+    def test_deterministic_and_value_sensitive(self):
+        params = self._params()
+        d1 = param_digest(params)
+        assert d1 == param_digest(self._params())
+        tweaked = dict(params, b=params["b"].at[0].set(2.0))
+        assert param_digest(tweaked) != d1
+
+    def test_sample_leaves_bounds_coverage(self):
+        params = self._params()
+        assert param_digest(params, sample_leaves=1) != param_digest(
+            params, sample_leaves=2
+        )
+
+    def test_maybe_bitflip_changes_the_digest(self, monkeypatch):
+        monkeypatch.delenv("DMT_CHAOS_RANK", raising=False)
+        params = self._params()
+        clean = param_digest(params)
+        chaos = ChaosInjector(FaultPlan.parse("bitflip@step:2"))
+        assert chaos.maybe_bitflip(params, step=1) is None  # not yet
+        flipped = chaos.maybe_bitflip(params, step=2)
+        assert flipped is not None
+        # Silent corruption: one mantissa bit, still finite, new digest.
+        assert param_digest(flipped) != clean
+        assert all(
+            bool(jnp.isfinite(leaf).all()) for leaf in jax.tree_util.tree_leaves(flipped)
+        )
+        assert param_digest(params) == clean  # original tree untouched
+        assert chaos.maybe_bitflip(params, step=2) is None  # fire-once
+
+    def test_attach_digest_ring_caps_and_evicts_oldest(self):
+        ring: dict[int, str] = {}
+        for step in range(20):
+            attach_digest_ring(ring, step, f"d{step}", cap=4)
+        assert sorted(ring) == [16, 17, 18, 19]
+
+
+# -- QuarantineLedger ---------------------------------------------------------
+
+class TestQuarantineLedger:
+    def test_roundtrip_idempotence_and_persistence(self, tmp_path):
+        ledger = QuarantineLedger(tmp_path / "quarantine.json")
+        assert 1 not in ledger
+        entry = ledger.quarantine(
+            1, reason="digest vote minority", step=6, digest="abc123"
+        )
+        assert entry == {
+            "host": "1", "reason": "digest vote minority",
+            "step": 6, "digest": "abc123",
+        }
+        assert 1 in ledger and "1" in ledger and 0 not in ledger
+        # Re-blame updates nothing.
+        ledger.quarantine(1, reason="again", step=9)
+        assert len(ledger.entries) == 1
+        # The ledger outlives the supervisor that wrote it.
+        reloaded = QuarantineLedger(tmp_path / "quarantine.json")
+        assert reloaded.hosts() == {"1"}
+        assert reloaded.entries[0]["reason"] == "digest vote minority"
+
+    def test_unreadable_ledger_fails_open(self, tmp_path):
+        path = tmp_path / "quarantine.json"
+        path.write_text("{not json")
+        ledger = QuarantineLedger(path)
+        assert ledger.hosts() == set()
+        # and it is still writable after the bad read
+        ledger.quarantine(3, reason="x")
+        assert 3 in QuarantineLedger(path)
+
+
+# -- Checkpointer: pin retention, rollback, generation fence ------------------
+
+def _corrupting_chaos(from_epoch=1):
+    """Stub chaos that corrupts every save from ``from_epoch``; the restore
+    path books rollbacks against it, which the stub just swallows."""
+    return SimpleNamespace(
+        should_corrupt=lambda *, epoch: epoch >= from_epoch,
+        record_rollback=lambda *a, **k: True,
+        record_recovery=lambda *a, **k: True,
+    )
+
+
+class TestCheckpointRetentionPin:
+    def test_pin_survives_retention_with_all_younger_saves_corrupt(
+        self, mesh, tmp_path
+    ):
+        # Regression (PR 18 satellite): max_to_keep used to be allowed to
+        # delete the pinned last-known-good once it aged out of the count
+        # window — a run where every younger save is corrupt then had
+        # nothing verified left to roll back to.
+        factory = _lm_factory(mesh)
+        state = factory()
+        ck = Checkpointer(
+            tmp_path / "ck", max_to_keep=2, chaos=_corrupting_chaos()
+        )
+        try:
+            for epoch in range(4):
+                ck.save(state, epoch=epoch)
+            ck.manager.wait_until_finished()
+            # Epoch 0 is the only verified save, pinned OUTSIDE the window
+            # of 2; epoch 1 aged out normally.
+            assert ck.last_good_epoch() == 0
+            assert set(ck.manager.all_steps()) == {0, 2, 3}
+            restored, epoch = ck.restore_verified(factory())
+            assert epoch == 0
+            assert tree_digests({"p": restored.params}) == tree_digests(
+                {"p": state.params}
+            )
+        finally:
+            ck.close()
+
+    def test_rollback_to_last_good_discards_younger_steps(self, mesh, tmp_path):
+        factory = _lm_factory(mesh)
+        ck = Checkpointer(
+            tmp_path / "ck", max_to_keep=5, chaos=_corrupting_chaos()
+        )
+        try:
+            for epoch in range(3):
+                ck.save(factory(), epoch=epoch)
+            restored, epoch = ck.rollback_to_last_good(factory())
+            assert epoch == 0
+            # Younger (possibly poisoned) checkpoints are GONE — unlike
+            # restore_verified's walk, which merely skips them.
+            assert ck.manager.all_steps() == [0]
+            assert ck._generation == 1  # rollback bumped the fence
+        finally:
+            ck.close()
+
+    def test_generation_fence_rejects_stale_pin(self, mesh, tmp_path):
+        factory = _lm_factory(mesh)
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=3)
+        try:
+            ck.save(factory(), epoch=0)
+            ck.rollback_to_last_good(factory())  # generation 0 -> 1
+            # The classic anti-rollback attack: swap the pin file for an
+            # older copy, hoping to resurrect discarded checkpoints.
+            atomic_write_json(
+                ck.directory / "last_good.json",
+                {"epoch": 0, "generation": 0},
+            )
+            with pytest.raises(CheckpointCorruption, match="anti-rollback"):
+                ck.last_good_epoch()
+        finally:
+            ck.close()
+
+
+# -- fault-kind audit (satellite): every kind is wired end to end -------------
+
+class TestFaultKindAudit:
+    #: kind -> the ChaosInjector hook that detonates (or books) it. The
+    #: supervisor-observed kinds fire through fire_observed: load_spike /
+    #: scale_during_failure detonate in serving/fleet.py's autoscale loop,
+    #: bitflip's accounting lives in resilience/pod.py's digest vote.
+    HOOKS = {
+        "nan_grad": "maybe_poison",
+        "kill": "check_kill",
+        "corrupt_ckpt": "should_corrupt",
+        "loader_stall": "loader_fault",
+        "loader_die": "loader_fault",
+        "loss_spike": "maybe_guard_fault",
+        "grad_spike": "maybe_guard_fault",
+        "nan_grads": "maybe_guard_fault",
+        "bitflip": "maybe_bitflip",
+        "rank_kill": "check_rank_fault",
+        "rank_hang": "check_rank_fault",
+        "serve_crash": "check_serve_crash",
+        "handoff_stall": "check_handoff_stall",
+        "replica_kill": "check_replica_fault",
+        "replica_hang": "check_replica_fault",
+        "replica_slow": "check_replica_fault",
+        "load_spike": "fire_observed",
+        "scale_during_failure": "fire_observed",
+    }
+
+    ALL_SETS = (
+        TRAIN_KINDS, POD_KINDS, GUARD_KINDS, FLEET_KINDS,
+        SERVE_KINDS, DISAGG_KINDS, AUTOSCALE_KINDS,
+    )
+
+    def test_every_kind_set_is_grammar_parseable(self):
+        for kinds in self.ALL_SETS:
+            assert kinds <= set(FAULT_UNITS), kinds - set(FAULT_UNITS)
+
+    def test_workload_sets_cover_the_grammar_exactly(self):
+        # No orphan kind that parses but no workload would ever validate —
+        # such a kind could never fire and its books could never balance.
+        covered = TRAIN_KINDS | FLEET_KINDS | DISAGG_KINDS | AUTOSCALE_KINDS
+        assert covered == set(FAULT_UNITS)
+
+    def test_validate_accepts_each_kind_in_its_workload(self):
+        for kinds, workload in (
+            (TRAIN_KINDS, "training"),
+            (FLEET_KINDS, "fleet"),
+            (SERVE_KINDS, "serving"),
+            (DISAGG_KINDS, "serving-disagg"),
+            (AUTOSCALE_KINDS, "autoscaler"),
+        ):
+            spec = ",".join(f"{k}@{FAULT_UNITS[k]}:1" for k in sorted(kinds))
+            validate_plan_kinds(spec, kinds, workload=workload)  # no raise
+            plan = FaultPlan.parse(spec)  # and the grammar agrees
+            assert len(plan) == len(kinds)
+
+    def test_validate_rejects_cross_workload_kind(self):
+        with pytest.raises(ValueError, match="no injection hook"):
+            validate_plan_kinds(
+                "loader_stall@batch:1", SERVE_KINDS, workload="serving"
+            )
+
+    def test_every_kind_has_a_live_hook(self):
+        assert set(self.HOOKS) == set(FAULT_UNITS)
+        for kind, hook in self.HOOKS.items():
+            assert callable(getattr(ChaosInjector, hook)), (kind, hook)
+
+    def test_guard_kinds_refuse_a_trainer_without_a_policy(self, mesh):
+        chaos = ChaosInjector(FaultPlan.parse("loss_spike@step:1"))
+        with pytest.raises(ValueError, match="guardrail"):
+            Trainer(
+                _lm_factory(mesh)(), "lm", mesh,
+                eval_every=1, time_steps=False, chaos=chaos,
+            )
+
+
+# -- trainer integration ------------------------------------------------------
+
+class TestTrainerEpochStats:
+    def test_all_nonfinite_epoch_reports_nan_and_leaves_ema_alone(self, mesh):
+        # Satellite regression: an epoch where EVERY step trips the finite
+        # guard must report NaN (not a perfect-looking 0.0), and the EMA —
+        # advanced only on accepted updates — must be byte-identical.
+        factory = _lm_factory(mesh, ema=True)
+        state = factory()
+        state = state.replace(
+            params=jax.tree_util.tree_map(
+                lambda x: jnp.full_like(x, jnp.nan), state.params
+            )
+        )
+        trainer = Trainer(
+            state, "lm", mesh, eval_every=1, time_steps=False, ema_decay=0.5,
+        )
+        trainer.place_state()
+        ema_before = tree_digests({"e": trainer.state.ema_params})
+        loader = ShardedLoader(
+            SyntheticTokens(16, 32), 8, mesh, shuffle=True, seed=0
+        )
+        stats = trainer.run_epoch(loader, 0)
+        assert math.isnan(stats["loss"])
+        assert tree_digests({"e": trainer.state.ema_params}) == ema_before
+
+    def test_partial_nonfinite_epoch_excludes_skipped_steps(self, mesh):
+        # One poisoned batch out of two: the mean is over the finite step
+        # only, and the reconciliation hook books the skip as the recovery.
+        chaos = ChaosInjector(FaultPlan.parse("nan_grad@step:0"))
+        trainer = Trainer(
+            _lm_factory(mesh)(), "lm", mesh,
+            eval_every=1, time_steps=False, chaos=chaos,
+        )
+        trainer.place_state()
+        chaos.bind_registry(trainer.metrics)
+        loader = ShardedLoader(
+            SyntheticTokens(16, 32), 8, mesh, shuffle=True, seed=0
+        )
+        stats = trainer.run_epoch(loader, 0)
+        assert math.isfinite(stats["loss"])
+        assert chaos.balanced() and not chaos.unrecovered()
+
+
+class TestGuardSpikeAccounting:
+    def test_nan_grads_is_tolerated_and_booked_as_recovery(self, mesh, tmp_path):
+        from deeplearning_mpi_tpu.utils import config
+
+        factory = _lm_factory(mesh)
+        chaos = ChaosInjector(FaultPlan.parse("nan_grads@step:10"))
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=5, chaos=chaos)
+        trainer = Trainer(
+            factory(), "lm", mesh, checkpointer=ck, eval_every=1,
+            time_steps=False, chaos=chaos, guardrails=GuardrailPolicy(),
+        )
+        trainer.place_state()
+        chaos.bind_registry(trainer.metrics)
+        loader = ResilientLoader(
+            ShardedLoader(SyntheticTokens(48, 32), 8, mesh, shuffle=True, seed=0),
+            chaos=chaos, batch_timeout_s=10.0, backoff_s=0.01,
+        )
+        args = SimpleNamespace(
+            num_epochs=3, max_restarts=2, eval_only=False, resume=False,
+            restart_delay_s=0.01,
+        )
+        try:
+            history = config.execute_training(
+                trainer, ck, args, loader, None, 0, state_factory=factory
+            )
+        finally:
+            ck.close()
+        # The extended finite guard (grads half) skipped the update; the
+        # spike verdict contained it in place — no rollback, no restart.
+        assert [h["epoch"] for h in history] == [0, 1, 2]
+        assert all(math.isfinite(h["loss"]) for h in history)
+        snap = trainer.metrics.snapshot()
+        assert snap[FAULT_INJECTED] == 1
+        assert snap[RECOVERY] == 1 and snap.get(ROLLBACK, 0) == 0
+        assert snap["guard_spike_total"] == 1
+        assert snap.get("guard_poisoned_total", 0) == 0
+        assert chaos.balanced(), chaos.summary()
+
+
+class TestLossSpikeRollbackE2E:
+    """The tentpole's in-process half: a loss_spike draws a poisoned
+    verdict, the run rolls back to the pinned last-known-good and replays
+    onto the exact unfaulted trajectory (bit-identical final params)."""
+
+    EPOCHS = 3
+    BATCH = 8
+    SEQS = 48  # 6 steps/epoch -> 18 total; spike at step 10 = mid-epoch 1
+
+    def _run(self, mesh, tmp_path, chaos_spec=None):
+        from deeplearning_mpi_tpu.utils import config
+
+        factory = _lm_factory(mesh)
+        loader = ShardedLoader(
+            SyntheticTokens(self.SEQS, 32), self.BATCH, mesh,
+            shuffle=True, seed=0,
+        )
+        chaos = ChaosInjector(FaultPlan.parse(chaos_spec)) if chaos_spec else None
+        ck = Checkpointer(tmp_path / "ck", max_to_keep=5, chaos=chaos)
+        trainer = Trainer(
+            factory(), "lm", mesh, checkpointer=ck, eval_every=1,
+            time_steps=False, chaos=chaos, guardrails=GuardrailPolicy(),
+        )
+        trainer.place_state()
+        if chaos is not None:
+            chaos.bind_registry(trainer.metrics)
+            loader = ResilientLoader(
+                loader, chaos=chaos, batch_timeout_s=10.0, backoff_s=0.01
+            )
+        args = SimpleNamespace(
+            num_epochs=self.EPOCHS, max_restarts=2, eval_only=False,
+            resume=False, restart_delay_s=0.01,
+        )
+        try:
+            history = config.execute_training(
+                trainer, ck, args, loader, None, 0, state_factory=factory
+            )
+        finally:
+            ck.close()
+        return trainer, chaos, history
+
+    @pytest.fixture(scope="class")
+    def spiked_and_clean(self, tmp_path_factory):
+        from deeplearning_mpi_tpu.runtime.mesh import create_mesh
+
+        mesh = create_mesh()
+        tmp = tmp_path_factory.mktemp("guard_e2e")
+        # x1000 loss at step 10 (epoch 1, past the 8-step warmup): robust-z
+        # blows through z_poison, the trainer raises RollbackRequested, and
+        # the auto-resume closure restores the pinned epoch-0 checkpoint.
+        spiked = self._run(mesh, tmp / "spiked", "loss_spike@step:10")
+        clean = self._run(mesh, tmp / "clean")
+        return spiked, clean
+
+    def test_rollback_replays_onto_unfaulted_trajectory(self, spiked_and_clean):
+        (st, _, sh), (ct, _, ch) = spiked_and_clean
+        assert int(st.state.step) == self.EPOCHS * (self.SEQS // self.BATCH)
+        # Epoch 1 aborted mid-flight at the poisoned verdict, then replayed
+        # from the epoch-0 pin — the fired spec stays fired, so the replay
+        # eats clean data and rejoins the clean run bit-for-bit.
+        assert [h["epoch"] for h in sh] == [0, 1, 2]
+        assert tree_digests({"p": st.state.params}) == tree_digests(
+            {"p": ct.state.params}
+        )
+        clean_loss = {h["epoch"]: h["loss"] for h in ch}
+        for h in sh:
+            assert h["loss"] == clean_loss[h["epoch"]], (
+                f"epoch {h['epoch']} diverged after rollback"
+            )
+
+    def test_books_reconcile_as_one_rollback(self, spiked_and_clean):
+        (trainer, chaos, _), _ = spiked_and_clean
+        assert chaos.balanced(), chaos.summary()
+        assert not chaos.unrecovered()
+        snap = trainer.metrics.snapshot()
+        assert snap[FAULT_INJECTED] == 1
+        assert snap[ROLLBACK] == 1 and snap.get(RECOVERY, 0) == 0
+        assert snap["guard_poisoned_total"] == 1
+        assert snap["guard_rollback_total"] == 1
+        assert snap["guard_checks_total"] > 0
+
+    def test_clean_run_draws_no_verdicts(self, spiked_and_clean):
+        _, (trainer, _, _) = spiked_and_clean
+        snap = trainer.metrics.snapshot()
+        assert snap["guard_checks_total"] == self.EPOCHS * (self.SEQS // self.BATCH)
+        assert snap.get("guard_spike_total", 0) == 0
+        assert snap.get("guard_poisoned_total", 0) == 0
+
+
+# -- costless when off --------------------------------------------------------
+
+class TestCostlessWhenOff:
+    def test_step_metrics_carry_no_grad_norm_without_guardrails(self, mesh):
+        # guard_metrics=True adds optax.global_norm(grads) to the jitted
+        # step — extra FLOPs and an extra device scalar. The default step
+        # must not compute it.
+        factory = _lm_factory(mesh)
+        loader = ShardedLoader(
+            SyntheticTokens(16, 32), 8, mesh, shuffle=True, seed=0
+        )
+        batch = next(iter(loader.epoch(0)))
+        _, metrics = make_train_step("lm", donate=False)(factory(), batch)
+        assert "grad_norm" not in metrics
+        _, metrics = make_train_step("lm", donate=False, guard_metrics=True)(
+            factory(), batch
+        )
+        assert "grad_norm" in metrics
+
+    def test_off_run_never_touches_guardrail_machinery(self, mesh, monkeypatch):
+        # Regression lock for the costless-when-off contract: with no
+        # policy attached, zero guardrail objects are allocated and no
+        # guardrail code runs — every entry point is booby-trapped and a
+        # full epoch must still pass. The env pacing knob must also never
+        # be read (it lives inside _guard_observe).
+        from deeplearning_mpi_tpu.resilience import guardrails as G
+
+        def boom(*args, **kwargs):
+            raise AssertionError("guardrail machinery touched in off mode")
+
+        monkeypatch.setattr(G.GuardrailPolicy, "__init__", boom)
+        monkeypatch.setattr(G.DigestVote, "__init__", boom)
+        monkeypatch.setattr(G, "param_digest", boom)
+        monkeypatch.setattr(Trainer, "_guard_observe", boom)
+        monkeypatch.setenv("DMT_GUARD_STEP_DELAY_S", "60")
+        trainer = Trainer(
+            _lm_factory(mesh)(), "lm", mesh, eval_every=1, time_steps=False,
+        )
+        trainer.place_state()
+        loader = ShardedLoader(
+            SyntheticTokens(16, 32), 8, mesh, shuffle=True, seed=0
+        )
+        stats = trainer.run_epoch(loader, 0)
+        assert math.isfinite(stats["loss"])
+        snapshot = trainer.metrics.snapshot()
+        assert not any(k.startswith("guard_") for k in snapshot)
+        assert not trainer._digest_ring and not trainer._guard_metrics

@@ -1,0 +1,338 @@
+"""Numerics-guardrail drill: silent corruption and loss spikes, end to end.
+
+The acceptance check for the numerics guardrails (``resilience/
+guardrails.py``, ``docs/RESILIENCE.md`` "Numerics guardrails"), runnable
+standalone (``make guard-smoke``) or from ``tests/test_multiprocess.py``.
+Two arms, both closed by a bit-identical parity oracle:
+
+**bitflip** — the SDC/quarantine path, supervisor in charge:
+
+1. Launch a 2-process CPU pod training the tiny chaos-smoke LM with
+   ``--guardrails --digest_every 1`` and ``bitflip@step:6`` planned: rank 1
+   flips one mantissa bit in a digest-sampled param leaf in epoch 1, after
+   the epoch-0 checkpoint landed. Nothing crashes and nothing hangs — exit
+   codes and heartbeat liveness both stay green while the corrupted
+   replica's gradients poison every subsequent all-reduce.
+2. The supervisor's digest vote must convict the corrupter from the
+   heartbeat-carried digest rings (the 2-rank tie breaks on the planned
+   chaos target), book the host in ``quarantine.json``, prune any
+   checkpoint saved after the divergence step, and re-form a world of 1
+   from the clean epoch-0 save.
+3. **Parity oracle**: prune a copy of the model dir back to epoch 0 and run
+   a clean single-process ``--resume``. The re-formed pod's per-step and
+   per-epoch losses for epochs >= 1 must be bit-identical to the oracle's
+   — the flip, the eviction, and the re-form are invisible in the numbers.
+4. **Accounting**: the final ``pod_summary`` must reconcile
+   (``fault_injected_total == recovery_total + rollback_total``) and carry
+   ``guard_digest_mismatch_total >= 1``, ``guard_quarantine_total == 1``.
+
+**loss_spike** — the rollback-and-replay path, all inside one process:
+
+1. Run the same model single-process with ``--guardrails --max_restarts 2``
+   and ``loss_spike@step:10`` planned (after the policy's 8-step warmup):
+   the batch is poisoned with a x1000 loss scale, the robust-z clears
+   ``z_poison`` in one step, and the trainer raises ``RollbackRequested``
+   after dropping the buffered poisoned step records.
+2. The auto-resume closure restores the pinned last-known-good checkpoint
+   (epoch 1) and replays; the fault fired once, so the replay is clean.
+3. **Parity oracle**: an unfaulted run from scratch. Epochs >= 1 must be
+   bit-identical — rollback-and-replay rejoins the unfaulted trajectory.
+4. **Accounting**: ``run_summary`` carries ``fault_injected_total == 1 ==
+   rollback_total``, ``guard_poisoned_total == 1``, ``guard_rollback_total
+   == 1``.
+
+Float comparisons are strict equality: the JSONL records round-trip
+``repr`` exactly, so ``==`` on parsed finite floats is bitwise equality.
+Records from a torn-down or rolled-back attempt cannot pollute the
+comparison — step scalars flush at epoch end (and the poisoned buffer is
+dropped before the rollback), and a re-run epoch's records land later in
+the file, so the dict parse keeps the final trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: the chaos-smoke model: 40 sequences - 4 eval = 36 train rows -> 4 steps
+#: per epoch at batch 8; epoch boundaries at steps 4/8/12.
+WORKER_FLAGS = [
+    "--platform", "cpu", "--n_virtual_devices", "1",
+    "--num_epochs", "4", "--batch_size", "8",
+    "--train_sequences", "40", "--seq_len", "32",
+    "--num_layers", "1", "--d_model", "32", "--d_ff", "64",
+    "--num_heads", "2", "--head_dim", "16",
+    "--eval_every", "1", "--keep_checkpoints", "10",
+    "--num_workers", "0", "--resume",
+]
+BITFLIP_STEP = 6  # epoch 1: epoch-0 checkpoint exists, vote convicts mid-run
+SPIKE_STEP = 10  # epoch 2: past the 8-step warmup, epoch-1 checkpoint pinned
+
+
+def _base_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH", "")) if p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    # Same persistent compile cache the test suite uses (tests/conftest.py).
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+    # The drill owns the chaos/pod contract; inherited vars would leak into
+    # the oracle (a stale DMT_CHAOS would re-arm the fault there).
+    for k in ("DMT_CHAOS", "DMT_CHAOS_RANK", "DMT_GUARD_STEP_DELAY_S",
+              "DMT_HEARTBEAT_DIR", "DMT_HEARTBEAT_INTERVAL_S",
+              "COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+        env.pop(k, None)
+    return env
+
+
+def _worker_cmd(
+    model_dir: Path, log_dir: Path, metrics_dir: Path, *extra: str
+) -> list[str]:
+    return [
+        sys.executable, "-m", "deeplearning_mpi_tpu.cli.train_lm",
+        *WORKER_FLAGS,
+        "--model_dir", str(model_dir),
+        "--log_dir", str(log_dir),
+        "--metrics_dir", str(metrics_dir),
+        *extra,
+    ]
+
+
+def _prune_to_epoch0(ckpt_dir: Path) -> None:
+    """Rewind a checkpoint history to exactly the epoch-0 step: the state
+    the re-formed pod resumed from, which is what the oracle must see."""
+    for child in ckpt_dir.iterdir():
+        if child.is_dir() and child.name.isdigit() and int(child.name) > 0:
+            shutil.rmtree(child)
+        elif child.name.startswith("manifest-"):
+            try:
+                epoch = int(child.stem.split("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if epoch > 0:
+                child.unlink()
+    (ckpt_dir / "last_good.json").unlink(missing_ok=True)
+
+
+def _losses(metrics_path: Path) -> tuple[dict, dict]:
+    """epoch -> [losses in step order] for the LAST recorded burst of each
+    epoch, plus epoch -> mean loss, epochs >= 1 only (epoch 0 predates
+    every planned fault). A torn-down attempt can flush an epoch's step
+    records before the supervisor's SIGKILL lands; the recovered attempt
+    re-runs that epoch with a restarted step counter, so a non-monotonic
+    step within one epoch marks the superseding burst. Epoch-mean records
+    dedupe by plain overwrite (the re-run lands later in the file)."""
+    step_losses: dict[int, list[float]] = {}
+    last_step: dict[int, int] = {}
+    epoch_losses: dict[int, float] = {}
+    with metrics_path.open() as f:
+        for line in f:
+            rec = json.loads(line)
+            epoch = rec.get("epoch")
+            if epoch is None or epoch < 1 or "loss" not in rec:
+                continue
+            if rec.get("kind") == "step":
+                e, s = int(epoch), int(rec["step"])
+                if e in last_step and s <= last_step[e]:
+                    step_losses[e] = []
+                step_losses.setdefault(e, []).append(rec["loss"])
+                last_step[e] = s
+            elif rec.get("kind") == "epoch":
+                epoch_losses[int(epoch)] = rec["loss"]
+    return step_losses, epoch_losses
+
+
+def _assert_parity(pod_metrics: Path, oracle_metrics: Path) -> int:
+    got_steps, got_epochs = _losses(pod_metrics)
+    ora_steps, ora_epochs = _losses(oracle_metrics)
+    assert ora_steps and ora_epochs, "oracle produced no post-resume records"
+    assert got_steps == ora_steps, (
+        "recovered per-step losses diverge from the unfaulted trajectory: "
+        f"got={got_steps} oracle={ora_steps}"
+    )
+    assert got_epochs == ora_epochs, (
+        f"recovered epoch losses diverge: got={got_epochs} oracle={ora_epochs}"
+    )
+    return sum(len(v) for v in ora_steps.values())
+
+
+def _fresh(root: Path) -> Path:
+    root = Path(root)
+    if root.exists():
+        shutil.rmtree(root)
+    root.mkdir(parents=True)
+    return root
+
+
+def run_bitflip(root: Path) -> dict:
+    """SDC arm: digest vote -> quarantine -> re-form -> bit-identical resume."""
+    from deeplearning_mpi_tpu.resilience.pod import PodSupervisor
+
+    root = _fresh(root)
+    guard_flags = ("--guardrails", "--digest_every", "1")
+    env = _base_env()
+    # The tiny CPU model outruns the supervisor's poll loop; pace the
+    # guarded steps to heartbeat speed so the vote convicts mid-run.
+    env["DMT_GUARD_STEP_DELAY_S"] = "0.3"
+
+    sup = PodSupervisor(
+        _worker_cmd(root / "models", root / "logs", root / "metrics",
+                    *guard_flags),
+        num_processes=2,
+        pod_dir=root / "pod",
+        chaos=f"bitflip@step:{BITFLIP_STEP}",
+        heartbeat_interval_s=0.2,
+        heartbeat_deadline_s=60.0,
+        spawn_grace_s=600.0,  # cold-cache startup compile on one shared core
+        poll_interval_s=0.25,
+        min_world_size=1,
+        max_pod_restarts=2,
+        ckpt_dir=root / "models" / "lm",
+        env=env,
+    )
+    result = sup.run()
+    assert result.ok, "pod did not finish"
+    assert result.world_sizes == [2, 1], result.world_sizes
+    assert result.restarts == 1, result.restarts
+    assert result.rank_failures == 1, result.rank_failures
+    assert result.chaos_balanced, result.snapshot
+
+    # The corrupter must be in the ledger, barred from re-admission.
+    from deeplearning_mpi_tpu.resilience.guardrails import QuarantineLedger
+
+    ledger = QuarantineLedger(root / "pod" / "quarantine.json")
+    assert 1 in ledger, ledger.entries
+    assert 0 not in ledger, ledger.entries
+    entry = ledger.entries[0]
+    assert entry["reason"] == "digest vote minority", entry
+
+    # Supervisor books: injected == recovered, vote + quarantine counted.
+    summaries = [
+        rec
+        for rec in map(
+            json.loads, (root / "pod" / "pod_metrics.jsonl").open()
+        )
+        if rec.get("kind") == "pod_summary"
+    ]
+    s = summaries[-1]
+    injected = s.get("fault_injected_total", 0)
+    recovered = s.get("recovery_total", 0)
+    rolled_back = s.get("rollback_total", 0)
+    assert injected == 1 and injected == recovered + rolled_back, s
+    assert s.get("guard_digest_mismatch_total", 0) >= 1, s
+    assert s.get("guard_quarantine_total") == 1, s
+    assert s.get("chaos_balanced") is True, s
+
+    # Parity oracle: clean single-process resume from the epoch-0 save.
+    shutil.copytree(root / "models", root / "oracle_models")
+    _prune_to_epoch0(root / "oracle_models" / "lm")
+    proc = subprocess.run(
+        _worker_cmd(root / "oracle_models", root / "oracle_logs",
+                    root / "oracle_metrics", *guard_flags),
+        env=_base_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"oracle run failed:\n{proc.stdout[-4000:]}"
+    steps = _assert_parity(
+        root / "metrics" / "metrics.jsonl",
+        root / "oracle_metrics" / "metrics.jsonl",
+    )
+    print(
+        f"guard-drill OK (bitflip): digest vote convicted host 1, world "
+        f"2 -> 1, {steps} resumed steps bit-identical to the clean resume, "
+        f"books reconciled (injected={injected:.0f} recovered={recovered:.0f})"
+    )
+    return {"world_sizes": result.world_sizes, "steps_compared": steps,
+            "quarantined": sorted(ledger.hosts())}
+
+
+def run_loss_spike(root: Path) -> dict:
+    """Rollback arm: poisoned verdict -> last-known-good -> clean replay."""
+    root = _fresh(root)
+    guard_flags = (
+        "--guardrails", "--max_restarts", "2",
+        "--chaos", f"loss_spike@step:{SPIKE_STEP}",
+    )
+    proc = subprocess.run(
+        _worker_cmd(root / "models", root / "logs", root / "metrics",
+                    *guard_flags),
+        env=_base_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"faulted run failed:\n{proc.stdout[-4000:]}"
+
+    summaries = [
+        rec
+        for rec in map(
+            json.loads, (root / "metrics" / "metrics.jsonl").open()
+        )
+        if rec.get("kind") == "run_summary"
+    ]
+    s = summaries[-1]
+    injected = s.get("fault_injected_total", 0)
+    rolled_back = s.get("rollback_total", 0)
+    recovered = s.get("recovery_total", 0)
+    assert injected == 1 and injected == recovered + rolled_back, s
+    assert rolled_back == 1, s
+    assert s.get("guard_poisoned_total") == 1, s
+    assert s.get("guard_rollback_total") == 1, s
+
+    # Parity oracle: the same run, never faulted, from scratch.
+    proc = subprocess.run(
+        _worker_cmd(root / "oracle_models", root / "oracle_logs",
+                    root / "oracle_metrics", "--guardrails"),
+        env=_base_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"oracle run failed:\n{proc.stdout[-4000:]}"
+    steps = _assert_parity(
+        root / "metrics" / "metrics.jsonl",
+        root / "oracle_metrics" / "metrics.jsonl",
+    )
+    print(
+        f"guard-drill OK (loss_spike): poisoned at step {SPIKE_STEP}, rolled "
+        f"back to last-known-good, {steps} replayed steps bit-identical to "
+        f"the unfaulted run, books reconciled (injected={injected:.0f} "
+        f"rolled_back={rolled_back:.0f})"
+    )
+    return {"steps_compared": steps, "rollbacks": rolled_back}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--arm", default="both",
+                        choices=("bitflip", "loss_spike", "both"))
+    parser.add_argument("--root", default="/tmp/dmt_guard_drill")
+    args = parser.parse_args(argv)
+    sys.path.insert(0, str(REPO))
+    root = Path(args.root)
+    if args.arm in ("loss_spike", "both"):
+        run_loss_spike(root / "loss_spike")
+    if args.arm in ("bitflip", "both"):
+        run_bitflip(root / "bitflip")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

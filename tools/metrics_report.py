@@ -19,6 +19,10 @@ Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
   (``serving/fleet.py``): completions/shed/dropped, hedge outcomes
   (``serve_hedge_total{outcome=...}``), replica restarts, swap downtime,
   failover TTFT p50/p99 by phase, and the chaos reconciliation books;
+- ``guard_*`` counters — a ``--guardrails`` run's numerics books
+  (``resilience/guardrails.py``; docs/RESILIENCE.md "Numerics guardrails"):
+  steps checked, spikes tolerated, poisoned verdicts and the rollbacks that
+  serviced them, and the pod supervisor's digest-vote/quarantine columns;
 - ``sanitize_*`` counters — a ``DMT_SANITIZE=1`` run's tripwire books
   (``analysis/sanitizer.py``; docs/ANALYSIS.md): KV-pool double-free /
   use-after-free poison trips, post-warmup retrace trips, and donation
@@ -269,6 +273,31 @@ def _tracing_table(last: dict) -> str:
     return table("Tracing", rows)
 
 
+def _guardrails_table(last: dict) -> str:
+    """The numerics guardrails' books (``resilience/guardrails.py``;
+    docs/RESILIENCE.md "Numerics guardrails"): any record carrying
+    ``guard_checks_total`` (a ``--guardrails`` run summary) or the pod
+    supervisor's digest-vote counters renders here. Spikes are tolerated
+    anomalies; poisoned verdicts each pair with a rollback; a digest
+    mismatch pairs with a quarantined host."""
+    rows = []
+    if last.get("guard_checks_total") is not None:
+        rows += [("steps checked", _fmt(last.get("guard_checks_total"))),
+                 ("spikes tolerated", _fmt(last.get("guard_spike_total", 0))),
+                 ("poisoned verdicts",
+                  _fmt(last.get("guard_poisoned_total", 0))),
+                 ("rollbacks serviced",
+                  _fmt(last.get("guard_rollback_total", 0))),
+                 ("param digests published",
+                  _fmt(last.get("guard_digest_total", 0)))]
+    if last.get("guard_digest_mismatch_total") is not None:
+        rows += [("digest-vote mismatches",
+                  _fmt(last.get("guard_digest_mismatch_total"))),
+                 ("hosts quarantined",
+                  _fmt(last.get("guard_quarantine_total", 0)))]
+    return table("Guardrails", rows)
+
+
 _SANITIZE_LABELS = (
     ("sanitize_kv_double_free_total", "KV double-free trips"),
     ("sanitize_kv_use_after_free_total", "KV use-after-free trips"),
@@ -423,6 +452,12 @@ def summarize(records: list[dict]) -> str:
     if traced:
         out.append(_tracing_table(traced[-1]))
 
+    guarded = [r for r in records
+               if r.get("guard_checks_total") is not None
+               or r.get("guard_digest_mismatch_total") is not None]
+    if guarded:
+        out.append(_guardrails_table(guarded[-1]))
+
     sanitized = [r for r in records
                  if any(k.startswith("sanitize_") for k in r)]
     if sanitized:
@@ -538,6 +573,15 @@ def _selftest() -> int:
             "flight_dump_total": 1,
             "trace_clock_offset_s": 1.7537e9,
         })
+        # A --guardrails run's books (resilience/guardrails.py): the
+        # detector counters plus the pod supervisor's digest-vote columns
+        # must render their own table.
+        reg.emit("run_summary", {
+            "guard_checks_total": 16, "guard_spike_total": 1,
+            "guard_poisoned_total": 1, "guard_rollback_total": 1,
+            "guard_digest_total": 16,
+            "guard_digest_mismatch_total": 1, "guard_quarantine_total": 1,
+        })
         # A DMT_SANITIZE=1 run's tripwire books (analysis/sanitizer.py):
         # the drill's injections show up as counted trips, a healthy run
         # renders all-zero with verdict "clean".
@@ -574,6 +618,10 @@ def _selftest() -> int:
                        "MFU gap attribution: residual",
                        "spans recorded", "flight dumps",
                        "clock offset mono→wall",
+                       "steps checked", "spikes tolerated",
+                       "poisoned verdicts", "rollbacks serviced",
+                       "param digests published",
+                       "digest-vote mismatches", "hosts quarantined",
                        "KV double-free trips", "retrace trips (post-warmup)",
                        "KV refcount underflow trips", "KV CoW violation trips",
                        "donation canary trips", "sanitizer verdict"):
