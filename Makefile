@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
@@ -18,7 +18,7 @@ SHELL := /bin/bash
 # drill, the radix prefix-cache drill, the fleet-autoscaler surge drill,
 # and the numerics-guardrail drill without touching the ROADMAP command
 # itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -202,6 +202,22 @@ fleet-smoke:
 autoscale-smoke:
 	env JAX_PLATFORMS=cpu python tools/autoscale_drill.py --fault surge \
 		--root /tmp/dmt_autoscale_smoke
+
+# Load-simulator drill (docs/SIMULATION.md): three phases. scale — a
+# >=100k-request multi-tenant compressed day (diurnal + bursts + flash
+# crowd + an adversarial tenant) simulated against the REAL
+# router/scheduler/autoscaler objects under the fake clock in <60s on
+# CPU, books balanced (completed + shed == requests), byte-deterministic
+# twice. sweep — a seeded policy-parameter search scored on SLO-attained
+# completions per replica-second; the winner must round-trip through the
+# autotune TuningDB under its simpolicy|<digest> key. predictive — a
+# REAL-process fleet with the predictive autoscaler replays a
+# flash-crowd trace; the forecaster must fire the first scale-up BEFORE
+# the crowd's peak, with zero dropped requests and reconciled scale
+# books.
+sim-smoke:
+	env JAX_PLATFORMS=cpu python tools/sim_drill.py --phase all \
+		--root /tmp/dmt_sim_smoke
 
 # Distributed-tracing drill (docs/OBSERVABILITY.md "Distributed request
 # tracing"): a 2-replica disaggregated fleet replays a trace with the

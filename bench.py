@@ -1004,6 +1004,100 @@ def bench_serving_prefix(
     return result
 
 
+def bench_slo_curves(duration_s: float = 600.0, base_rps: float = 6.0
+                     ) -> dict:
+    """Predictive vs reactive autoscaling on a flash-crowd day through the
+    fake-clock fleet simulator (ISSUE 19) — pure host Python, no device.
+
+    Both arms replay the SAME seeded trace (diurnal cycle + one
+    ramp-onset flash crowd) through the REAL router/scheduler/autoscaler
+    objects; the only difference is ``AutoscalerConfig.predictive``. The
+    regime is continuously loaded (slow decodes, long outputs, a fleet
+    sized near saturation) — the one where a trend forecast has signal to
+    lead with; an idle fleet's 0-to-avalanche step gives the forecaster
+    nothing and the arms tie by construction.
+
+    Headline is ``predictive_slo_per_chip_x``: SLO-attained completions
+    per replica-second, predictive over reactive — the sweep's scoring
+    metric, so this number and ``sim/search.py`` winners are directly
+    comparable. Per-arm SLO attainment, sheds, scale-up stamps, and the
+    windowed SLO/utilization curves ride in the detail dict.
+    """
+    from deeplearning_mpi_tpu.serving.autoscaler import AutoscalerConfig
+    from deeplearning_mpi_tpu.sim import (
+        FlashCrowd,
+        FleetSimulator,
+        ServiceModel,
+        SimConfig,
+        TenantSpec,
+        TraceConfig,
+        generate_entries,
+        to_fleet_entries,
+        trace_digest,
+    )
+
+    cfg = TraceConfig(
+        duration_s=duration_s,
+        base_rps=base_rps,
+        diurnal_period_s=duration_s,
+        diurnal_amplitude=0.3,
+        burst_rate_per_s=0.0,
+        flash_crowds=(
+            FlashCrowd(at_s=duration_s * 0.6, amplitude=6.0, ramp_s=12.0,
+                       decay_s=8.0),
+        ),
+        tenants=(
+            TenantSpec("default", output_mean=32, deadline_s=10.0),
+        ),
+    )
+    entries = to_fleet_entries(generate_entries(cfg, seed=0))
+
+    def arm(predictive: bool) -> dict:
+        sim_cfg = SimConfig(
+            initial_replicas=3,
+            max_slots=4,
+            service=ServiceModel(tpot_s=0.05),
+            autoscale=AutoscalerConfig(
+                min_replicas=2, max_replicas=8,
+                up_load_per_replica=6.0, down_load_per_replica=1.0,
+                hysteresis_s=0.4, cooldown_s=2.0,
+                predictive=predictive, forecast_horizon_s=3.0,
+                forecast_tau_s=1.0, forecast_trend_tau_s=2.0,
+            ),
+            curve_window_s=30.0,
+        )
+        t0 = time.monotonic()
+        res = FleetSimulator(sim_cfg).run(entries)
+        return {
+            "slo_attainment": round(res.slo_attainment, 4),
+            "slo_per_chip": round(res.slo_per_chip, 4),
+            "completed": res.completed,
+            "shed": dict(res.shed),
+            "scale_ups": res.scale_ups,
+            "first_up_s": round(res.up_times[0], 2) if res.up_times
+            else None,
+            "replica_seconds": round(res.replica_seconds, 1),
+            "wall_s": round(time.monotonic() - t0, 2),
+            "curves": res.curves,
+        }
+
+    reactive = arm(False)
+    predictive = arm(True)
+    return {
+        "requests": len(entries),
+        "trace_digest": trace_digest(entries),
+        "predictive_slo_per_chip_x": (
+            round(predictive["slo_per_chip"] / reactive["slo_per_chip"], 4)
+            if reactive["slo_per_chip"] else None
+        ),
+        "predictive_slo_attainment_delta": round(
+            predictive["slo_attainment"] - reactive["slo_attainment"], 4
+        ),
+        "reactive": reactive,
+        "predictive": predictive,
+    }
+
+
 def _kill_group(proc) -> None:
     """SIGKILL a child's whole process group, then reap it. The child may
     spawn helpers (tunnel client) that inherit the pipes; killing only the
@@ -1173,6 +1267,8 @@ def _build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--skip_prefix", action="store_true",
                         help="skip the radix prefix-cache shared-preamble "
                         "workload")
+    parser.add_argument("--skip_slo", action="store_true",
+                        help="skip the simulator SLO-curves A/B workload")
     parser.add_argument("--spec_batch", type=int, default=32,
                         help="concurrent requests in the lm_spec_decode "
                         "engine arm (the >=5x target holds for 8-32)")
@@ -1242,6 +1338,8 @@ def _child_main(args) -> int:
         detail = bench_disagg()
     elif key == "serving_prefix":
         detail = bench_serving_prefix()
+    elif key == "serving_slo_curves":
+        detail = bench_slo_curves()
     elif key == "allreduce":
         detail = bench_allreduce()
     else:
@@ -1346,7 +1444,7 @@ def main() -> None:
     # (ROADMAP item 4: a dead tunnel should cost fidelity, not coverage).
     cpu_fallback = frozenset({
         "lm_serving_2k", "lm_spec_decode", "serving_fleet",
-        "serving_disagg", "serving_prefix",
+        "serving_disagg", "serving_prefix", "serving_slo_curves",
     })
 
     def run(key: str, *, metric: str, unit: str, value_key: str,
@@ -1508,6 +1606,15 @@ def main() -> None:
             # 2 engine arms (no_cache, prefix_cache), each paying a
             # (cached) warmup compile before its timed replay.
             budget_s=max(args.workload_timeout, 900.0),
+        )
+
+    if not args.skip_slo:
+        # Pure host Python (the fake-clock simulator never touches the
+        # device): measures policy quality, not FLOPs.
+        run(
+            "serving_slo_curves",
+            metric="serving_predictive_slo_per_chip_x", unit="x",
+            value_key="predictive_slo_per_chip_x",
         )
 
     run(

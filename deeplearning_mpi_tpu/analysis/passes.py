@@ -21,6 +21,10 @@ each rule and cataloged in ``docs/ANALYSIS.md``.
 |        |                      | computed after the teardown SIGKILL)          |
 | DMT007 | telemetry-schema     | metric names + label keys at call sites match |
 |        |                      | telemetry/schema.py (one canonical schema)    |
+| DMT008 | clock-injection      | clock-pure policy modules (autoscaler/router/ |
+|        |                      | scheduler/prefix cache/sim) never CALL        |
+|        |                      | time.*/datetime.now — clocks are injected, so |
+|        |                      | the fake-clock simulator can replay them      |
 
 Rules are deliberately *syntactic and local*: each flags a pattern that is
 wrong-by-default in this codebase, and the audited exceptions are recorded
@@ -545,6 +549,60 @@ def _check_telemetry_schema(src: SourceFile) -> list[Finding]:
 
 
 # --------------------------------------------------------------------------
+# DMT008 clock-injection
+# --------------------------------------------------------------------------
+#
+# The serving policy stack (autoscaler decide loop, router scoring/hedging,
+# scheduler admission, prefix cache) is clock-pure by contract: every method
+# takes ``now`` as an argument (or holds an injected ``clock`` callable),
+# and the fake-clock simulator (sim/) replays the SAME objects against
+# whole-day traces in seconds. One direct ``time.monotonic()`` call breaks
+# that replay silently — sim results would mix two clocks and every sweep
+# verdict would be garbage. Rule: in the configured policy modules (opt-in
+# elsewhere with ``# dmt-lint: scope=policy``), a *call* of a wall-clock
+# read is flagged. Passing ``time.monotonic`` as a default clock VALUE
+# (router's injectable ctor default) is fine — the reference is the
+# injection point, the call is the violation.
+
+_CLOCK_PURE_PATHS = (
+    "deeplearning_mpi_tpu/serving/autoscaler.py",
+    "deeplearning_mpi_tpu/serving/router.py",
+    "deeplearning_mpi_tpu/serving/scheduler.py",
+    "deeplearning_mpi_tpu/serving/prefix_cache.py",
+    "deeplearning_mpi_tpu/sim/",
+)
+
+_CLOCK_CALLS = re.compile(
+    r"^(time\.(time|perf_counter|monotonic|time_ns|perf_counter_ns|"
+    r"monotonic_ns|sleep)"
+    r"|datetime\.(datetime\.)?(now|utcnow|today))$"
+)
+
+
+def _check_clock_injection(src: SourceFile) -> list[Finding]:
+    in_scope = any(
+        src.rel == p or (p.endswith("/") and src.rel.startswith(p))
+        for p in _CLOCK_PURE_PATHS
+    )
+    if not in_scope and src.declared_scope() != "policy":
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func) or ""
+        if _CLOCK_CALLS.match(name):
+            findings.append(Finding(
+                "DMT008", src.rel, node.lineno,
+                f"`{name}()` in a clock-pure policy module: clocks are "
+                "injected (take `now` as an argument) so the fake-clock "
+                "simulator can replay this exact object — a direct wall-"
+                "clock read silently splits sim and production behavior",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------------
 
@@ -571,4 +629,8 @@ def all_rules() -> list[Rule]:
         Rule("DMT007", "telemetry-schema",
              "metric names/labels match telemetry/schema.py",
              _check_telemetry_schema),
+        Rule("DMT008", "clock-injection",
+             "clock-pure policy modules never call time.* (sim replay "
+             "contract)",
+             _check_clock_injection),
     ]

@@ -178,6 +178,19 @@ def build_parser() -> argparse.ArgumentParser:
                        "hard --min_replicas floor, and the overload "
                        "brownout ladder (docs/SERVING.md); implies fleet "
                        "mode even with --replicas 1")
+    fleet.add_argument("--autoscale_predictive", action="store_true",
+                       help="predictive scale-up: forecast the load signal "
+                       "(EWMA level + trend over the LoadSignal history) "
+                       "and arm the up-window one --forecast_horizon_s "
+                       "ahead, so replicas warm BEFORE a ramp lands "
+                       "(docs/SIMULATION.md); implies --autoscale")
+    fleet.add_argument("--forecast_horizon_s", type=float, default=3.0,
+                       help="how far ahead the predictive forecaster "
+                       "projects; should cover one spawn-to-ready warmup")
+    fleet.add_argument("--forecast_tau_s", type=float, default=1.0,
+                       help="EWMA time constant of the forecast load level")
+    fleet.add_argument("--forecast_trend_tau_s", type=float, default=1.0,
+                       help="EWMA time constant of the forecast load trend")
     fleet.add_argument("--min_replicas", type=int, default=1,
                        help="autoscaler floor: scale-down is vetoed at this "
                        "ready-replica count (a concurrent replica death "
@@ -458,7 +471,11 @@ def _run_fleet(args, eos_id) -> int:
         from deeplearning_mpi_tpu.serving import AutoscalerConfig
 
         autoscale = AutoscalerConfig(
-            min_replicas=args.min_replicas, max_replicas=args.max_replicas
+            min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+            predictive=args.autoscale_predictive,
+            forecast_horizon_s=args.forecast_horizon_s,
+            forecast_tau_s=args.forecast_tau_s,
+            forecast_trend_tau_s=args.forecast_trend_tau_s,
         )
     sup = FleetSupervisor(
         model_spec, engine_spec, args.replicas, fleet_dir,
@@ -573,6 +590,8 @@ def _run_fleet(args, eos_id) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.autoscale_predictive:
+        args.autoscale = True  # predictive is a mode OF the autoscaler
     eos_id = args.eos_id if args.eos_id >= 0 else None
     if eos_id is not None and eos_id > 255:
         print(f"--eos_id {eos_id} is outside the byte vocab (0-255)",

@@ -9,7 +9,10 @@ is ``deeplearning_mpi_tpu.cli.serve_lm``. Design doc: ``docs/SERVING.md``.
 from deeplearning_mpi_tpu.serving.autoscaler import (
     AutoscalerConfig,
     AutoscalerPolicy,
+    LoadForecaster,
     LoadSignal,
+    ReplicaView,
+    build_load_signal,
 )
 from deeplearning_mpi_tpu.serving.disagg import (
     DecodeEngine,
@@ -54,11 +57,13 @@ __all__ = [
     "FleetResult",
     "FleetSupervisor",
     "KVBuffers",
+    "LoadForecaster",
     "LoadSignal",
     "PagedForward",
     "PrefillEngine",
     "PagedKVPool",
     "RadixPrefixCache",
+    "ReplicaView",
     "Request",
     "RequestState",
     "Router",
@@ -66,6 +71,7 @@ __all__ = [
     "Scheduler",
     "ServingEngine",
     "SpeculativeDecoder",
+    "build_load_signal",
     "init_kv_buffers",
     "prefix_signature",
 ]

@@ -36,14 +36,34 @@ tenants at the admission door, (2) additionally disable speculative
 drafts, (3) additionally raise the deadline floor. It resets to 0 only
 after ``brownout_clear_s`` of calm — degrading is fast, un-degrading is
 deliberately slow (docs/SERVING.md).
+
+With ``AutoscalerConfig(predictive=True)`` the policy additionally runs a
+:class:`LoadForecaster` (EWMA level + trend, optional seasonal residual)
+over the LoadSignal history and arms the up-window on the *forecast* load
+one horizon ahead — replicas start warming before a ramp lands instead of
+after (ROADMAP item 3; parameters are picked by the ``sim/search.py``
+sweep, and ``docs/SIMULATION.md`` describes the workflow).
+
+This module is clock-pure by contract: every method takes ``now`` as an
+argument and nothing here may read ``time.*`` directly (dmt-lint DMT008
+``clock-injection``) — that purity is what lets ``sim/simulator.py`` run
+the very same policy object under a fake clock at million-request scale.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Mapping, Optional
+import math
+from typing import Iterable, Mapping, Optional
 
-__all__ = ["AutoscalerConfig", "AutoscalerPolicy", "LoadSignal"]
+__all__ = [
+    "AutoscalerConfig",
+    "AutoscalerPolicy",
+    "LoadForecaster",
+    "LoadSignal",
+    "ReplicaView",
+    "build_load_signal",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,6 +89,27 @@ class AutoscalerConfig:
     brownout_hold_s: float = 0.5
     #: sustained calm needed to clear the ladder back to stage 0.
     brownout_clear_s: float = 1.0
+    #: -- predictive scale-up (ROADMAP item 3; parameters are meant to be
+    #: picked by the sim sweep in ``sim/search.py``, not by hand) --
+    #: when True, the up-signal arms on max(current load, forecast load at
+    #: ``now + forecast_horizon_s``), so replicas start warming AHEAD of a
+    #: ramp instead of after it lands. Down-decisions additionally hold
+    #: while the forecast sits above the up threshold (don't retire
+    #: capacity into a predicted wave). Reactive behavior is bit-identical
+    #: with the default False.
+    predictive: bool = False
+    #: how far ahead the forecaster projects — should cover one
+    #: spawn-to-ready warmup so predicted capacity arrives in time.
+    forecast_horizon_s: float = 3.0
+    #: EWMA time constant for the smoothed load level (seconds — the
+    #: forecaster is cadence-independent, so fleet ticks at 20ms and sim
+    #: ticks at 100ms smooth identically in wall-clock terms).
+    forecast_tau_s: float = 1.0
+    #: EWMA time constant for the load trend (d level / dt).
+    forecast_trend_tau_s: float = 1.0
+    #: optional seasonal period (diurnal analog); 0 disables the
+    #: seasonal term entirely.
+    forecast_seasonal_period_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.min_replicas < 1:
@@ -85,6 +126,17 @@ class AutoscalerConfig:
                 "down_load_per_replica must sit strictly below "
                 f"up_load_per_replica, got {self.down_load_per_replica} >= "
                 f"{self.up_load_per_replica}"
+            )
+        if self.predictive and (
+            self.forecast_horizon_s <= 0
+            or self.forecast_tau_s <= 0
+            or self.forecast_trend_tau_s <= 0
+        ):
+            raise ValueError(
+                "predictive mode needs positive forecast_horizon_s/"
+                "forecast_tau_s/forecast_trend_tau_s, got "
+                f"{self.forecast_horizon_s}/{self.forecast_tau_s}/"
+                f"{self.forecast_trend_tau_s}"
             )
 
 
@@ -120,6 +172,137 @@ class LoadSignal:
         return (self.backlog + self.queue_depth) / max(self.ready, 1)
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplicaView:
+    """One replica's slice of the control tick's world state — the input
+    row :func:`build_load_signal` aggregates. The live fleet fills these
+    from heartbeats + the router's dispatch ledger; the simulator fills
+    them from its fake-clock replica models. Keeping the aggregation in
+    ONE place is what stops sim and production drifting on how load is
+    measured (a drift there would invalidate every sweep result)."""
+
+    idx: int
+    #: worker acked ready (serving capacity once not retiring).
+    ready: bool = False
+    #: process (or simulated replica) still running.
+    alive: bool = True
+    #: mid-drain for scale-down — excluded from capacity and queue sums.
+    retiring: bool = False
+    #: worker-reported queue depth (one heartbeat stale in the fleet).
+    queue_depth: int = 0
+    #: router dispatch-ledger outstanding on this replica — fresh THIS
+    #: tick, unlike the heartbeat.
+    outstanding: int = 0
+    #: per-replica TTFT p50 from the latest heartbeat (0 = unknown).
+    ttft_p50: float = 0.0
+
+
+def build_load_signal(
+    views: Iterable[ReplicaView],
+    *,
+    backlog: int,
+    slots_cap: int,
+    shed_total: int = 0,
+    tokens_in_flight: int = 0,
+) -> LoadSignal:
+    """Assemble one control tick's :class:`LoadSignal` from per-replica
+    views. Queue pressure per replica is ``max(worker-reported depth,
+    router outstanding minus slot capacity)``: heartbeats lag one
+    interval, but the router's dispatch ledger is fresh this tick —
+    without the floor, a just-dispatched burst reads as zero load until
+    the next beat and a fast engine can drain before the up-signal ever
+    persists. Shared by :class:`~.fleet.FleetSupervisor`'s control tick
+    and the fake-clock simulator (``sim/simulator.py``)."""
+    views = list(views)
+    return LoadSignal(
+        backlog=backlog,
+        queue_depth=sum(
+            max(v.queue_depth, v.outstanding - slots_cap)
+            for v in views
+            if v.ready and not v.retiring
+        ),
+        ready=sum(
+            1 for v in views if v.ready and not v.retiring and v.alive
+        ),
+        warming=sum(1 for v in views if not v.ready and v.alive),
+        total=len(views),
+        shed_total=shed_total,
+        ttft_p50=max([v.ttft_p50 for v in views] or [0.0]),
+        tokens_in_flight=tokens_in_flight,
+    )
+
+
+class LoadForecaster:
+    """Short-horizon load forecast over the LoadSignal history: an
+    irregular-interval EWMA level plus an EWMA'd trend (Holt's linear
+    method with time-aware gains), and an optional additive seasonal
+    residual keyed by phase within ``seasonal_period_s``. Pure state
+    machine — the caller injects ``now`` (dmt-lint DMT008), so the fleet
+    drives it on the wall clock and the simulator on a fake one with
+    identical arithmetic."""
+
+    #: phase resolution of the seasonal residual table.
+    SEASONAL_BUCKETS = 16
+
+    def __init__(
+        self,
+        *,
+        tau_s: float,
+        trend_tau_s: float,
+        seasonal_period_s: float = 0.0,
+    ) -> None:
+        self.tau_s = float(tau_s)
+        self.trend_tau_s = float(trend_tau_s)
+        self.seasonal_period_s = float(seasonal_period_s)
+        self._t: Optional[float] = None
+        self._level: Optional[float] = None
+        self._trend = 0.0
+        self._observed = 0
+        self._season: list[Optional[float]] = (
+            [None] * self.SEASONAL_BUCKETS
+            if self.seasonal_period_s > 0 else []
+        )
+
+    def _bucket(self, t: float) -> int:
+        phase = (t % self.seasonal_period_s) / self.seasonal_period_s
+        return min(int(phase * self.SEASONAL_BUCKETS),
+                   self.SEASONAL_BUCKETS - 1)
+
+    def observe(self, now: float, value: float) -> None:
+        """Fold one load measurement in. Gains scale with the elapsed
+        interval (``1 - exp(-dt/tau)``) so the smoothing time constant is
+        wall-clock seconds regardless of tick cadence."""
+        self._observed += 1
+        if self._t is None or self._level is None:
+            self._t, self._level = now, float(value)
+            return
+        dt = max(now - self._t, 1e-9)
+        a = 1.0 - math.exp(-dt / self.tau_s)
+        prev = self._level
+        self._level += a * (value - self._level)
+        b = 1.0 - math.exp(-dt / self.trend_tau_s)
+        self._trend += b * ((self._level - prev) / dt - self._trend)
+        if self._season:
+            i = self._bucket(now)
+            resid = value - self._level
+            cur = self._season[i]
+            self._season[i] = resid if cur is None else cur + a * (resid - cur)
+        self._t = now
+
+    def forecast(self, now: float, horizon_s: float) -> Optional[float]:
+        """Projected load at ``now + horizon_s`` (clamped at 0), or None
+        until at least two observations have landed (a single point has
+        no trend and would just echo the current load)."""
+        if self._level is None or self._observed < 2:
+            return None
+        out = self._level + self._trend * horizon_s
+        if self._season:
+            s = self._season[self._bucket(now + horizon_s)]
+            if s is not None:
+                out += s
+        return max(out, 0.0)
+
+
 class AutoscalerPolicy:
     """The decision core. The supervisor feeds it one :class:`LoadSignal`
     per control tick; it answers "scale now?" and "what brownout stage?".
@@ -137,6 +320,17 @@ class AutoscalerPolicy:
         self.stage = 0
         self._hot_since: Optional[float] = None
         self._calm_since: Optional[float] = None
+        #: predictive scale-up: forecast the load signal so capacity warms
+        #: AHEAD of a ramp (None keeps the reactive path bit-identical).
+        self._forecaster: Optional[LoadForecaster] = None
+        if config.predictive:
+            self._forecaster = LoadForecaster(
+                tau_s=config.forecast_tau_s,
+                trend_tau_s=config.forecast_trend_tau_s,
+                seasonal_period_s=config.forecast_seasonal_period_s,
+            )
+        #: last forecast computed by :meth:`decide` (for logs/drills).
+        self.last_forecast: Optional[float] = None
 
     # -- cooldown sources ----------------------------------------------------
     def note_scale_event(self, now: float) -> None:
@@ -163,13 +357,31 @@ class AutoscalerPolicy:
         was clamped — it re-arms the hysteresis window like any other."""
         cfg = self.config
         load = sig.load_per_replica
+        # Predictive mode: fold this tick's measurement into the
+        # forecaster and arm the UP window on max(current, forecast) —
+        # a rising ramp arms before the load itself crosses the
+        # threshold, buying one warmup of lead time. The forecast also
+        # blocks DOWN-arming while it sits above the up threshold
+        # (retiring capacity into a predicted wave is how you shed at
+        # the peak). With predictive off, both signals are just `load`
+        # and the policy is bit-identical to its reactive self.
+        fc: Optional[float] = None
+        if self._forecaster is not None:
+            self._forecaster.observe(now, load)
+            fc = self._forecaster.forecast(now, cfg.forecast_horizon_s)
+            self.last_forecast = fc
+        up_signal = load if fc is None else max(load, fc)
         # Arm/disarm the persistent-signal windows every tick, even during
         # cooldown — cooldown delays the decision, not the measurement.
-        if load > cfg.up_load_per_replica:
+        if up_signal > cfg.up_load_per_replica:
             self._up_since = now if self._up_since is None else self._up_since
         else:
             self._up_since = None
-        if load < cfg.down_load_per_replica and sig.backlog == 0:
+        if (
+            load < cfg.down_load_per_replica
+            and sig.backlog == 0
+            and not (fc is not None and fc > cfg.up_load_per_replica)
+        ):
             self._down_since = (
                 now if self._down_since is None else self._down_since
             )

@@ -676,7 +676,8 @@ class FleetSupervisor(ClusterSupervisor):
         if self.autoscale is not None:
             from deeplearning_mpi_tpu.serving.autoscaler import (
                 AutoscalerPolicy,
-                LoadSignal,
+                ReplicaView,
+                build_load_signal,
             )
 
             policy = AutoscalerPolicy(self.autoscale)
@@ -742,6 +743,10 @@ class FleetSupervisor(ClusterSupervisor):
         next_idx = self.num_replicas  # replica ids are never reused
         scale_events = spawned = retired = vetoed = 0
         scale_ups = 0  # ordinal for the scale_during_failure trigger
+        #: trace-clock stamps (now - t0) of each scale-up spawn — the
+        #: predictive drill asserts the first lands BEFORE the flash
+        #: crowd's peak arrival.
+        up_times: list[float] = []
         brownout_stage = 0
         brownout_stage_max = 0
         retiring: Optional[int] = None  # replica mid-drain, at most one
@@ -1174,58 +1179,45 @@ class FleetSupervisor(ClusterSupervisor):
                             self._send(vrep, {"op": "stop"})
                             retire_stop_sent = True
 
-                    # Assemble this tick's load signal. Queue pressure per
-                    # replica is max(worker-reported depth, router
-                    # outstanding minus slot capacity): heartbeats lag one
-                    # interval, but the router's dispatch ledger is fresh
-                    # THIS tick — without the floor, a just-dispatched
-                    # burst reads as zero load until the next beat and a
-                    # fast engine can drain before the up-signal ever
-                    # persists.
+                    # Assemble this tick's load signal through the shared
+                    # helper (autoscaler.build_load_signal) — the
+                    # simulator builds its signal through the SAME code,
+                    # so sim and production cannot drift on how load is
+                    # measured.
                     due = sum(
                         1 for e in pending if t0 + e["arrival"] <= now
                     )
                     slots_cap = int(self.engine_spec.get("max_slots", 1))
-                    sig = LoadSignal(
-                        backlog=due + len(redispatch_queue),
-                        queue_depth=sum(
-                            max(
-                                int(r.last_hb.get("queue_depth", 0))
-                                if r.last_hb is not None else 0,
-                                len(router.outstanding_on(r.idx))
-                                - slots_cap,
+                    sig = build_load_signal(
+                        (
+                            ReplicaView(
+                                idx=r.idx,
+                                ready=r.ready,
+                                alive=(
+                                    r.proc is not None
+                                    and r.proc.poll() is None
+                                ),
+                                retiring=r.idx == retiring,
+                                queue_depth=(
+                                    int(r.last_hb.get("queue_depth", 0))
+                                    if r.last_hb is not None else 0
+                                ),
+                                outstanding=len(
+                                    router.outstanding_on(r.idx)
+                                ),
+                                ttft_p50=(
+                                    float(r.last_hb.get("ttft_p50") or 0.0)
+                                    if r.last_hb is not None else 0.0
+                                ),
                             )
                             for r in replicas.values()
-                            if r.ready and r.idx != retiring
                         ),
-                        ready=sum(
-                            1
-                            for r in replicas.values()
-                            if r.ready
-                            and r.idx != retiring
-                            and r.proc is not None
-                            and r.proc.poll() is None
-                        ),
-                        warming=sum(
-                            1
-                            for r in replicas.values()
-                            if not r.ready
-                            and r.proc is not None
-                            and r.proc.poll() is None
-                        ),
-                        total=len(replicas),
+                        backlog=due + len(redispatch_queue),
+                        slots_cap=slots_cap,
                         shed_total=sum(
                             1
                             for rec in ledger.values()
                             if rec.shed_reason is not None
-                        ),
-                        ttft_p50=max(
-                            [
-                                float(r.last_hb.get("ttft_p50") or 0.0)
-                                for r in replicas.values()
-                                if r.last_hb is not None
-                            ]
-                            or [0.0]
                         ),
                         tokens_in_flight=sum(
                             len(rec.prompt) + rec.max_new
@@ -1299,10 +1291,16 @@ class FleetSupervisor(ClusterSupervisor):
                             self._spawn(newr)
                             spawned += 1
                             scale_ups += 1
+                            up_times.append(now - t0)
+                            forecast_note = (
+                                f", forecast {policy.last_forecast:.2f}"
+                                if policy.last_forecast is not None else ""
+                            )
                             self._log(
                                 f"autoscale: scale-up -> replica "
                                 f"{newr.idx} warming (load/replica "
-                                f"{sig.load_per_replica:.2f}, fleet "
+                                f"{sig.load_per_replica:.2f}"
+                                f"{forecast_note}, fleet "
                                 f"{len(replicas)})"
                             )
                             # scale_during_failure chaos: SIGKILL a live
@@ -1519,6 +1517,9 @@ class FleetSupervisor(ClusterSupervisor):
                 "vetoed": vetoed,
                 "brownout_stage_max": brownout_stage_max,
                 "replicas_final": len(replicas),
+                #: trace-clock seconds of each scale-up spawn (the
+                #: predictive drill checks these against the crowd peak).
+                "up_times": [round(t, 3) for t in up_times],
             }
             values.update({
                 "scale_events": scale_events,

@@ -59,6 +59,13 @@ class _Replica:
     #: still holds the prefix in its radix cache, so routing the next
     #: same-signature request there turns a cold prefill into a hit.
     prefix_sigs: dict[int, float] = dataclasses.field(default_factory=dict)
+    #: rids currently dispatched here (primary or hedge copy). An index
+    #: over ``Router._requests``, maintained on dispatch/hedge/complete/
+    #: death — scoring and the control tick read outstanding counts every
+    #: tick, and scanning the whole request ledger per read made both
+    #: O(requests-ever) (the fake-clock simulator replays 10^5..10^6
+    #: requests through this very object).
+    outstanding: set[int] = dataclasses.field(default_factory=set)
 
 
 @dataclasses.dataclass
@@ -139,6 +146,18 @@ class Router:
         coldest radix cache = cheapest to lose)."""
         return len(self._replicas[replica].prefix_sigs)
 
+    def has_prefix_affinity(self, replica: int, sig: Optional[int]) -> bool:
+        """True when ``sig`` is in ``replica``'s affinity ledger — the
+        replica has recently served this prefix, so its radix cache likely
+        still holds it. The fake-clock simulator reads this to apply the
+        service model's prefill discount off the SAME ledger the live
+        scorer uses (sim/production parity)."""
+        return (
+            sig is not None
+            and replica in self._replicas
+            and sig in self._replicas[replica].prefix_sigs
+        )
+
     # -- telemetry in --------------------------------------------------------
     def observe(self, replica: int, snapshot: dict) -> None:
         """Record a replica's latest heartbeat telemetry snapshot. Keys the
@@ -158,6 +177,7 @@ class Router:
         # stale affinity would steer same-prefix traffic at a replica that
         # can no longer hit.
         state.prefix_sigs.clear()
+        state.outstanding.clear()
         orphaned = []
         for t in self._requests.values():
             if t.done:
@@ -202,11 +222,10 @@ class Router:
 
     # -- selection -----------------------------------------------------------
     def outstanding_on(self, replica: int) -> list[int]:
-        return [
-            t.rid
-            for t in self._requests.values()
-            if not t.done and (t.primary == replica or t.hedge == replica)
-        ]
+        state = self._replicas.get(replica)
+        if state is None:
+            return []
+        return sorted(state.outstanding)
 
     def score(self, replica: int, *, prefix_sig: Optional[int] = None) -> float:
         """Load score — lower is better. Outstanding dispatches are the
@@ -294,6 +313,7 @@ class Router:
             dispatched_at=t,
             deadline=deadline,
         )
+        self._replicas[replica].outstanding.add(rid)
         if prefix_sig is not None:
             sigs = self._replicas[replica].prefix_sigs
             sigs[prefix_sig] = t
@@ -326,6 +346,7 @@ class Router:
                 continue
             t.hedge = target
             t.hedged_at = now
+            self._replicas[target].outstanding.add(t.rid)
             self._count_hedge("fired")
             fired.append((t.rid, target))
         return fired
@@ -349,11 +370,17 @@ class Router:
             self._registry.histogram(
                 labeled("serve_ttft_s", replica=str(replica))
             ).observe(ttft)
-        t = self._requests.get(rid)
+        # Won rids leave the ledger entirely (a late duplicate completion
+        # then sees no record — same "duplicate" verdict the done-flag
+        # used to produce); keeping every finished record made
+        # maybe_hedge/outstanding scans O(requests-ever), which the
+        # simulator's million-request replays cannot afford.
+        t = self._requests.pop(rid, None)
         if t is None or t.done:
             self._count_hedge("duplicate")
             return "duplicate", None
         t.done = True
+        self._drop_outstanding(t)
         loser: Optional[int] = None
         if t.hedge is not None:
             if replica == t.primary:
@@ -367,7 +394,14 @@ class Router:
     def forget(self, rid: int) -> None:
         """Drop a rid the fleet permanently shed (deadline, queue_full):
         nothing outstanding remains to hedge or re-dispatch."""
-        self._requests.pop(rid, None)
+        t = self._requests.pop(rid, None)
+        if t is not None:
+            self._drop_outstanding(t)
+
+    def _drop_outstanding(self, t: _Tracked) -> None:
+        for holder in (t.primary, t.hedge):
+            if holder is not None and holder in self._replicas:
+                self._replicas[holder].outstanding.discard(t.rid)
 
     # -- internals -----------------------------------------------------------
     def _count_hedge(self, outcome: str) -> None:

@@ -1,0 +1,55 @@
+"""Million-user load harness: seeded trace generation, a fake-clock
+fleet simulator over the *real* serving policy objects, and a
+deterministic policy-parameter sweep (ROADMAP item 3).
+
+Entry points: :func:`~deeplearning_mpi_tpu.sim.traces.generate_entries`
+(multi-tenant workload traces in the ``serve_lm`` JSONL replay schema),
+:class:`~deeplearning_mpi_tpu.sim.simulator.FleetSimulator` (whole-day
+traces in seconds, no engines spawned), and
+:func:`~deeplearning_mpi_tpu.sim.search.run_sweep` (SLO-per-chip scored
+parameter search writing winners to the autotune DB). Design doc:
+``docs/SIMULATION.md``; drilled by ``tools/sim_drill.py`` / ``make
+sim-smoke``.
+"""
+
+from deeplearning_mpi_tpu.sim.simulator import (
+    FleetSimulator,
+    ServiceModel,
+    SimConfig,
+    SimResult,
+)
+from deeplearning_mpi_tpu.sim.search import (
+    SweepResult,
+    apply_params,
+    default_grid,
+    run_sweep,
+)
+from deeplearning_mpi_tpu.sim.traces import (
+    FlashCrowd,
+    TenantSpec,
+    TraceConfig,
+    generate_entries,
+    tenant_policies,
+    to_fleet_entries,
+    trace_digest,
+    write_jsonl,
+)
+
+__all__ = [
+    "FlashCrowd",
+    "FleetSimulator",
+    "ServiceModel",
+    "SimConfig",
+    "SimResult",
+    "SweepResult",
+    "TenantSpec",
+    "TraceConfig",
+    "apply_params",
+    "default_grid",
+    "generate_entries",
+    "run_sweep",
+    "tenant_policies",
+    "to_fleet_entries",
+    "trace_digest",
+    "write_jsonl",
+]

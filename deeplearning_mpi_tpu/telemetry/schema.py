@@ -120,6 +120,15 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "span_dropped_total": ("counter", frozenset()),
     "span_recorded_total": ("counter", frozenset()),
     "trace_clock_offset_s": ("gauge", frozenset()),
+    # -- load simulator (PR 19, sim/) ---------------------------------------
+    "sim_brownout_max_stage": ("gauge", frozenset()),
+    "sim_completed_total": ("counter", frozenset()),
+    "sim_hedge_fired_total": ("counter", frozenset()),
+    "sim_replica_seconds": ("gauge", frozenset()),
+    "sim_requests_total": ("counter", frozenset()),
+    "sim_shed_total": ("counter", frozenset({"reason"})),
+    "sim_slo_attainment": ("gauge", frozenset()),
+    "sim_slo_ok_total": ("counter", frozenset()),
     # -- runtime sanitizer (analysis/sanitizer.py) --------------------------
     "sanitize_donation_canary_trips_total": ("counter", frozenset()),
     "sanitize_kv_cow_violation_total": ("counter", frozenset()),

@@ -1,0 +1,8 @@
+"""Seeded DMT008: direct wall-clock read in a clock-pure policy scope."""
+# dmt-lint: scope=policy
+import time
+
+
+def decide(load_per_replica, threshold):
+    now = time.monotonic()  # seeded: DMT008 — breaks fake-clock replay
+    return ("up", now) if load_per_replica > threshold else None

@@ -23,6 +23,9 @@ Reads only the stdlib: records are flat JSON objects ``{"ts", "kind", ...}``
   (``resilience/guardrails.py``; docs/RESILIENCE.md "Numerics guardrails"):
   steps checked, spikes tolerated, poisoned verdicts and the rollbacks that
   serviced them, and the pod supervisor's digest-vote/quarantine columns;
+- ``sim_*`` counters — a ``tools/sim_drill.py`` run's fake-clock simulator
+  books (``sim/simulator.py``; docs/SIMULATION.md): simulated delivery,
+  SLO attainment, the per-chip sweep score, and the winning parameters;
 - ``sanitize_*`` counters — a ``DMT_SANITIZE=1`` run's tripwire books
   (``analysis/sanitizer.py``; docs/ANALYSIS.md): KV-pool double-free /
   use-after-free poison trips, post-warmup retrace trips, and donation
@@ -298,6 +301,42 @@ def _guardrails_table(last: dict) -> str:
     return table("Guardrails", rows)
 
 
+def _simulation_table(last: dict) -> str:
+    """A fake-clock simulator run's books (``sim/simulator.py``;
+    docs/SIMULATION.md): any record carrying ``sim_requests_total`` (a
+    ``tools/sim_drill.py`` summary) renders here — delivery accounting,
+    SLO attainment and the per-chip score the parameter sweep optimizes,
+    scale/brownout activity, and — when a sweep ran — the winning
+    parameters against the baseline."""
+    rows = [("simulated requests", _fmt(last.get("sim_requests_total"))),
+            ("simulated completions", _fmt(last.get("sim_completed_total"))),
+            ("simulated sheds", _fmt(last.get("sim_shed_total"))),
+            ("SLO attainment", _fmt(last.get("sim_slo_attainment"))),
+            ("SLO-ok per replica-second",
+             _fmt(last.get("sim_slo_per_chip"))),
+            ("replica-seconds (chips)",
+             _fmt(last.get("sim_replica_seconds"))),
+            ("sim clock covered (s)", _fmt(last.get("sim_clock_seconds"))),
+            ("scale ups / downs / vetoed",
+             f"{_fmt(last.get('sim_scale_ups'))} / "
+             f"{_fmt(last.get('sim_scale_downs'))} / "
+             f"{_fmt(last.get('sim_scale_vetoed'))}"),
+            ("brownout stage (max reached)",
+             _fmt(last.get("sim_brownout_max_stage")))]
+    wall = last.get("sim_wall_seconds")
+    if wall is not None:
+        rows.append(("simulator wall clock (s)", _fmt(wall)))
+    if last.get("sim_sweep_trials") is not None:
+        rows += [("sweep trials", _fmt(last.get("sim_sweep_trials"))),
+                 ("sweep winner params",
+                  json.dumps(last.get("sim_sweep_winner", {}),
+                             sort_keys=True)),
+                 ("sweep winner vs baseline score",
+                  f"{_fmt(last.get('sim_sweep_winner_score'))} vs "
+                  f"{_fmt(last.get('sim_sweep_baseline_score'))}")]
+    return table("Simulation", rows)
+
+
 _SANITIZE_LABELS = (
     ("sanitize_kv_double_free_total", "KV double-free trips"),
     ("sanitize_kv_use_after_free_total", "KV use-after-free trips"),
@@ -458,6 +497,11 @@ def summarize(records: list[dict]) -> str:
     if guarded:
         out.append(_guardrails_table(guarded[-1]))
 
+    simulated = [r for r in records
+                 if r.get("sim_requests_total") is not None]
+    if simulated:
+        out.append(_simulation_table(simulated[-1]))
+
     sanitized = [r for r in records
                  if any(k.startswith("sanitize_") for k in r)]
     if sanitized:
@@ -582,6 +626,23 @@ def _selftest() -> int:
             "guard_digest_total": 16,
             "guard_digest_mismatch_total": 1, "guard_quarantine_total": 1,
         })
+        # A sim_drill run's summary (sim/simulator.py SimResult.summary()
+        # plus the sweep's SweepResult.summary()): delivery accounting,
+        # the per-chip score, and the winning sweep parameters must
+        # render their own table.
+        reg.emit("sim_summary", {
+            "sim_requests_total": 108000, "sim_completed_total": 107400,
+            "sim_slo_ok_total": 106900, "sim_shed_total": 600,
+            "sim_slo_attainment": 0.9898, "sim_slo_per_chip": 22.4,
+            "sim_replica_seconds": 4771.5, "sim_clock_seconds": 1800.4,
+            "sim_scale_ups": 14, "sim_scale_downs": 12,
+            "sim_scale_vetoed": 9, "sim_brownout_max_stage": 1,
+            "sim_wall_seconds": 11.2,
+            "sim_sweep_trials": 6,
+            "sim_sweep_winner": {"hysteresis_s": 0.2, "predictive": True},
+            "sim_sweep_winner_score": 24.1,
+            "sim_sweep_baseline_score": 22.4,
+        })
         # A DMT_SANITIZE=1 run's tripwire books (analysis/sanitizer.py):
         # the drill's injections show up as counted trips, a healthy run
         # renders all-zero with verdict "clean".
@@ -622,6 +683,11 @@ def _selftest() -> int:
                        "poisoned verdicts", "rollbacks serviced",
                        "param digests published",
                        "digest-vote mismatches", "hosts quarantined",
+                       "simulated requests", "SLO attainment",
+                       "SLO-ok per replica-second",
+                       "sweep winner params",
+                       "sweep winner vs baseline score",
+                       "simulator wall clock",
                        "KV double-free trips", "retrace trips (post-warmup)",
                        "KV refcount underflow trips", "KV CoW violation trips",
                        "donation canary trips", "sanitizer verdict"):
