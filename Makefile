@@ -4,7 +4,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke
+.PHONY: verify selftest check smoke lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke controlplane-smoke
 
 # Tier-1 tests — verbatim from ROADMAP.md ("Tier-1 verify"). The lint,
 # sanitize-smoke, serve-smoke, spec-smoke, chaos-smoke, tune-smoke,
@@ -18,7 +18,7 @@ SHELL := /bin/bash
 # drill, the radix prefix-cache drill, the fleet-autoscaler surge drill,
 # and the numerics-guardrail drill without touching the ROADMAP command
 # itself.
-verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke
+verify: lint sanitize-smoke serve-smoke spec-smoke chaos-smoke tune-smoke pod-smoke overlap-smoke fleet-smoke disagg-smoke prefix-smoke autoscale-smoke trace-smoke guard-smoke sim-smoke controlplane-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Static analysis gate (docs/ANALYSIS.md): dmt-lint enforces the repo's
@@ -202,6 +202,20 @@ fleet-smoke:
 autoscale-smoke:
 	env JAX_PLATFORMS=cpu python tools/autoscale_drill.py --fault surge \
 		--root /tmp/dmt_autoscale_smoke
+
+# Control-plane crash drill (docs/RESILIENCE.md "Control-plane crash
+# safety", docs/TPU_POD_RUNBOOK.md §12): the fleet SUPERVISOR is
+# SIGKILLed mid-surge (load_spike live, a scale-up warming), its
+# orphaned replicas keep decoding headless, one orphan is killed, and a
+# restarted supervisor must replay the write-ahead journal, re-adopt
+# every live replica without respawning it (serve_compile_total flat —
+# zero retraces), respawn the corpse, re-dispatch its orphaned requests
+# with their original arrival/deadline, and drain with zero drops —
+# every stream bit-identical to offline greedy and the chaos + scale
+# books reconciling across both incarnations in fleet_metrics.jsonl.
+controlplane-smoke:
+	env JAX_PLATFORMS=cpu python tools/controlplane_drill.py \
+		--root /tmp/dmt_controlplane_smoke
 
 # Load-simulator drill (docs/SIMULATION.md): three phases. scale — a
 # >=100k-request multi-tenant compressed day (diurnal + bursts + flash

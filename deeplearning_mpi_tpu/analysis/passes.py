@@ -374,10 +374,15 @@ def _check_atomic_io(src: SourceFile) -> list[Finding]:
 # The fleet IPC contract (PR 8): a JSONL stream is recoverable after a
 # mid-write SIGKILL only because it has exactly ONE writer appending
 # newline-terminated records — readers consume terminated lines and a
-# second writer would interleave torn records. telemetry's JsonlSink is the
-# sanctioned writer class; raw write-mode opens of ``*.jsonl`` anywhere
-# else must be explicitly audited (the fleet's per-attempt inbox/outbox
-# opens are — see tools/lint_suppressions.txt).
+# second writer would interleave torn records. telemetry's JsonlSink and
+# the control plane's SupervisorJournal (resilience/cluster.py — the
+# write-ahead journal; incarnation fencing guarantees one live writer) are
+# the sanctioned writer classes; raw write-mode opens of ``*.jsonl``
+# anywhere else must be explicitly audited (the fleet's per-attempt
+# inbox/outbox opens are — see tools/lint_suppressions.txt).
+
+_JSONL_WRITER_CLASSES = {"JsonlSink", "SupervisorJournal"}
+
 
 def _check_jsonl_writer(src: SourceFile) -> list[Finding]:
     findings: list[Finding] = []
@@ -403,13 +408,14 @@ def _check_jsonl_writer(src: SourceFile) -> list[Finding]:
         if not _has_jsonl_literal(node):
             continue
         cls = src.enclosing_class(node)
-        if cls is not None and cls.name == "JsonlSink":
-            continue  # the sanctioned single-writer sink
+        if cls is not None and cls.name in _JSONL_WRITER_CLASSES:
+            continue  # a sanctioned single-writer class
         findings.append(Finding(
             "DMT005", src.rel, node.lineno,
-            "raw write-mode open of a .jsonl stream outside JsonlSink: the "
-            "single-writer IPC contract requires one audited writer per "
-            "stream (suppress with the writer-ownership justification)",
+            "raw write-mode open of a .jsonl stream outside the sanctioned "
+            "writer classes (JsonlSink, SupervisorJournal): the single-"
+            "writer IPC contract requires one audited writer per stream "
+            "(suppress with the writer-ownership justification)",
         ))
     return findings
 

@@ -599,7 +599,12 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     # Fail loud on chaos kinds this workload has no injection hook for:
     # a kind that can never fire would silently pass every drill while
-    # keeping the reconciliation invariant unfalsifiable.
+    # keeping the reconciliation invariant unfalsifiable. CONTROLPLANE_KINDS
+    # (supervisor_kill/supervisor_hang) are deliberately absent from every
+    # set below: this CLI process IS the supervisor and nothing restarts
+    # it, so planning its own death could never close the books. Only
+    # harnesses with a restart loop around the supervisor may plan them
+    # (tools/controlplane_drill.py).
     import os as _os
 
     chaos_spec = args.chaos or _os.environ.get("DMT_CHAOS") or ""

@@ -25,6 +25,7 @@ rollback_total`` is the chaos harness's own acceptance check
 
 from deeplearning_mpi_tpu.resilience.faults import (  # noqa: F401
     AUTOSCALE_KINDS,
+    CONTROLPLANE_KINDS,
     DISAGG_KINDS,
     FLEET_KINDS,
     GUARD_KINDS,
@@ -75,6 +76,7 @@ from deeplearning_mpi_tpu.resilience.watchdog import ResilientLoader  # noqa: F4
 
 __all__ = [
     "AUTOSCALE_KINDS",
+    "CONTROLPLANE_KINDS",
     "ChaosInjector",
     "CheckpointCorruption",
     "DISAGG_KINDS",

@@ -31,6 +31,13 @@ What lives here:
   semantics; :class:`~deeplearning_mpi_tpu.serving.fleet.FleetSupervisor`
   keeps the mailbox/router semantics; both are pinned bit-identical by
   ``make pod-smoke`` / ``make fleet-smoke``.
+- :class:`SupervisorJournal` / :func:`replay_journal` /
+  :func:`next_incarnation` — the control-plane crash-safety layer
+  (docs/RESILIENCE.md "Control-plane crash safety"): an append-only
+  write-ahead JSONL journal of every supervisor-owned state transition,
+  stamped with a monotonic **incarnation id** so a restarted supervisor
+  can tell its own records from a dead predecessor's, replay the fleet
+  state, and re-adopt orphaned workers instead of killing them.
 """
 
 from __future__ import annotations
@@ -45,15 +52,27 @@ from pathlib import Path
 from typing import Any, Callable, Iterable, Mapping, MutableMapping, Optional
 
 from deeplearning_mpi_tpu.resilience.faults import ChaosInjector, FaultPlan
+from deeplearning_mpi_tpu.resilience.integrity import atomic_write_json
 from deeplearning_mpi_tpu.telemetry.registry import JsonlSink, MetricsRegistry
 
 __all__ = [
     "ENV_HEARTBEAT_DIR",
     "ENV_HEARTBEAT_INTERVAL",
+    "ENV_INCARNATION",
+    "INCARNATION_FILE",
+    "JOURNAL_FILE",
+    "SUP_INCARNATION",
+    "SUP_READOPTED",
+    "SUP_REPLAY_S",
+    "SUP_RESPAWNED",
     "ClusterSupervisor",
     "LivenessTracker",
+    "SupervisorJournal",
     "kill_and_reap",
+    "next_incarnation",
+    "pid_alive",
     "reap",
+    "replay_journal",
     "scrub_rendezvous_env",
     "sigkill_group",
     "tail_jsonl",
@@ -69,6 +88,121 @@ ENV_HEARTBEAT_INTERVAL = "DMT_HEARTBEAT_INTERVAL_S"
 #: env vars of the jax distributed-rendezvous contract
 #: (``runtime/bootstrap.py``) — scrubbed from lone-process children.
 RENDEZVOUS_VARS = ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID")
+
+#: supervisor incarnation id handed to spawned workers — workers echo it in
+#: every heartbeat so :class:`LivenessTracker` can reject records written
+#: under a dead control plane (stale-incarnation hygiene).
+ENV_INCARNATION = "DMT_SUPERVISOR_INCARNATION"
+#: persisted monotonic incarnation counter (``atomic_write_json``).
+INCARNATION_FILE = "incarnation.json"
+#: the write-ahead journal stream name under the supervisor's run dir.
+JOURNAL_FILE = "journal.jsonl"
+
+#: control-plane crash-safety metric names (registered in
+#: ``telemetry/schema.py``), shared by every supervisor flavour.
+SUP_INCARNATION = "supervisor_incarnation"
+SUP_READOPTED = "supervisor_readopted_total"
+SUP_RESPAWNED = "supervisor_respawned_total"
+SUP_REPLAY_S = "supervisor_journal_replay_s"
+
+
+def pid_alive(pid: int) -> bool:
+    """True iff ``pid`` exists and is not a zombie awaiting reap. Signal-0
+    probing alone is not enough for orphan re-adoption: a SIGKILLed child
+    of a dead supervisor is reparented and reaped, but a zombie of a
+    still-dying tree would pass ``kill(pid, 0)`` while being unable to
+    serve — so the /proc state is checked when available."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            # field 3 (after the parenthesized comm, which may hold spaces)
+            state = f.read().rpartition(")")[2].split()[0]
+        return state != "Z"
+    except (OSError, IndexError):
+        return True
+
+
+def next_incarnation(root_dir: Path | str) -> int:
+    """Read-bump-persist the monotonic supervisor incarnation counter for
+    ``root_dir``. The counter survives supervisor crashes (it is written
+    with :func:`atomic_write_json`, so a mid-bump kill leaves either the
+    old or the new value, never a torn file) and only ever moves forward:
+    every supervisor start — first boot or post-crash restart — owns a
+    strictly larger id than every predecessor."""
+    root = Path(root_dir)
+    root.mkdir(parents=True, exist_ok=True)
+    path = root / INCARNATION_FILE
+    prev = 0
+    try:
+        prev = int(json.loads(path.read_text()).get("incarnation", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        prev = 0
+    inc = prev + 1
+    atomic_write_json(path, {"incarnation": inc, "pid": os.getpid()})
+    return inc
+
+
+class SupervisorJournal:
+    """Append-only write-ahead journal of supervisor-owned state
+    transitions (replica spawn/ready/retire, request dispatch/completion,
+    scale events, brownout stage, chaos fire/recovery).
+
+    Single-writer by construction: exactly one live incarnation holds the
+    append handle (``next_incarnation`` fences restarts — a new supervisor
+    bumps the counter before opening the stream, and every record carries
+    its writer's incarnation so replay can tell the corpses apart). Each
+    record is one newline-terminated JSON line, flushed before the action
+    it describes is taken (write-ahead), so a reader following the
+    :func:`tail_jsonl` discipline sees either a complete record or — after
+    a mid-write SIGKILL — no record at all; a torn final line is never
+    parsed. That lost-final-record case is safe by design: a journaled
+    action that never happened is re-discovered by the orphan probe, and
+    an unjournaled action never happened at all.
+    """
+
+    def __init__(self, root_dir: Path | str, *, incarnation: int,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        root = Path(root_dir)
+        root.mkdir(parents=True, exist_ok=True)
+        self.path = root / JOURNAL_FILE
+        self.incarnation = incarnation
+        self._clock = clock
+        # Sanctioned single-writer append handle (dmt-lint DMT005 names
+        # this class next to JsonlSink): one live incarnation, one stream.
+        self._f = (root / "journal.jsonl").open("a", encoding="utf-8")
+
+    def record(self, ev: str, **fields: Any) -> None:
+        """Append one journal record. ``ev`` is the transition kind; extra
+        fields are the transition payload. Flushed immediately — the
+        journal is write-ahead, so the record must be durable against a
+        supervisor SIGKILL *before* the action it describes runs."""
+        rec = {"inc": self.incarnation, "t": self._clock(), "ev": ev}
+        rec.update(fields)
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+def replay_journal(path: Path | str) -> list[dict]:
+    """All complete records of a journal stream, oldest first. Reuses the
+    :func:`tail_jsonl` newline-termination discipline, so a final line
+    torn by a mid-write supervisor kill is silently dropped rather than
+    raising — the write-ahead contract makes that record's action
+    un-taken by definition."""
+    records, _ = tail_jsonl(Path(path), 0)
+    return records
 
 
 def tail_jsonl(path: Path, offset: int) -> tuple[list[dict], int]:
@@ -145,6 +279,15 @@ class LivenessTracker:
       ``straggler_factor`` × the median observed inter-progress interval
       across ranks (and is still under the deadline) — slow, not dead.
     - healthy otherwise.
+
+    When ``incarnation`` is set, heartbeat payloads stamped with a
+    *different* supervisor incarnation are ignored: a heartbeat file left
+    behind by a worker of a dead control plane can have a recent mtime and
+    a nonzero ``progress_seq``, and without the fence a restarted
+    supervisor would read it as live progress and let a dead rank hide
+    behind its own corpse's last words. Workers echo ``ENV_INCARNATION``
+    (updated by the re-adoption handshake), so an adopted worker's
+    heartbeats become acceptable the moment it acks the new owner.
     """
 
     def __init__(
@@ -155,10 +298,12 @@ class LivenessTracker:
         grace_s: float,
         straggler_factor: float = 4.0,
         clock: Callable[[], float] = time.monotonic,
+        incarnation: int | None = None,
     ) -> None:
         self.deadline_s = deadline_s
         self.grace_s = grace_s
         self.straggler_factor = straggler_factor
+        self.incarnation = incarnation
         self._clock = clock
         self._start = clock()
         self._ranks = list(ranks)
@@ -172,6 +317,10 @@ class LivenessTracker:
         """Feed one heartbeat read (``None`` = file missing/unreadable)."""
         if payload is None:
             return
+        if self.incarnation is not None:
+            inc = payload.get("incarnation")
+            if inc is not None and inc != self.incarnation:
+                return  # stale-incarnation hygiene: a corpse's heartbeat
         now = self._clock()
         if isinstance(payload.get("step"), (int, float)):
             self._last_step[rank] = float(payload["step"])
@@ -283,6 +432,9 @@ class ClusterSupervisor:
         self.extra_env = dict(env or {})
         self._own_registry = registry is None
         self.registry = registry or MetricsRegistry()
+        #: set by :meth:`_open_journal`; ``None`` until a run starts.
+        self.incarnation: int | None = None
+        self.journal: SupervisorJournal | None = None
 
     def _log(self, msg: str) -> None:
         print(f"{self.log_name}: {msg}", flush=True)
@@ -298,6 +450,43 @@ class ClusterSupervisor:
             )
         return None
 
+    @staticmethod
+    def _kill_orphan(pid: int) -> None:
+        """SIGKILL a journaled orphan by pid — there is no Popen handle,
+        the process belonged to a dead incarnation. Group first (workers
+        are session leaders, pgid == pid), then the pid alone; init reaps
+        whatever dies, not us."""
+        if pid <= 0:
+            return
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def _open_journal(self) -> tuple[SupervisorJournal, list[dict]]:
+        """Bump this run's incarnation, replay whatever a dead predecessor
+        journaled (complete records only — a torn final line is dropped by
+        the ``tail_jsonl`` discipline), and open the write-ahead journal
+        for appending. Returns ``(journal, prior_records)``; the subclass
+        decides what to do with the corpse's history (the fleet re-adopts
+        orphans from it, the pod resumes attempt numbering)."""
+        self.dir.mkdir(parents=True, exist_ok=True)
+        prior = replay_journal(self.dir / JOURNAL_FILE)
+        self.incarnation = next_incarnation(self.dir)
+        self.journal = SupervisorJournal(
+            self.dir, incarnation=self.incarnation
+        )
+        self.journal.record(
+            "supervisor_start", pid=os.getpid(),
+            prior_records=len(prior),
+            prior_incarnations=sorted({r.get("inc") for r in prior
+                                       if r.get("inc") is not None}),
+        )
+        return self.journal, prior
+
     def new_tracker(
         self,
         ranks: Iterable[int],
@@ -305,12 +494,15 @@ class ClusterSupervisor:
         grace_s: float | None = None,
         straggler_factor: float = 4.0,
     ) -> LivenessTracker:
-        """A :class:`LivenessTracker` on this supervisor's cadence knobs."""
+        """A :class:`LivenessTracker` on this supervisor's cadence knobs.
+        Trackers inherit this run's incarnation so heartbeats written
+        under a dead control plane are rejected, not read as progress."""
         return LivenessTracker(
             ranks,
             deadline_s=self.heartbeat_deadline_s,
             grace_s=self.spawn_grace_s if grace_s is None else grace_s,
             straggler_factor=straggler_factor,
+            incarnation=self.incarnation,
         )
 
     def _close_registry(self) -> None:

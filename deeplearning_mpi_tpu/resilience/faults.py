@@ -101,6 +101,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import re
+import signal
 import time
 from typing import Any, Optional
 
@@ -108,6 +109,7 @@ from deeplearning_mpi_tpu.telemetry.registry import labeled
 
 __all__ = [
     "AUTOSCALE_KINDS",
+    "CONTROLPLANE_KINDS",
     "ChaosInjector",
     "DISAGG_KINDS",
     "ENV_RANK",
@@ -149,6 +151,8 @@ FAULT_UNITS = {
     "handoff_stall": "step",
     "load_spike": "step",
     "scale_during_failure": "step",
+    "supervisor_kill": "step",
+    "supervisor_hang": "step",
     "loss_spike": "step",
     "grad_spike": "step",
     "nan_grads": "step",
@@ -195,6 +199,18 @@ DISAGG_KINDS = SERVE_KINDS | frozenset({"handoff_stall"})
 #: synthetic request burst; ``scale_during_failure`` SIGKILLs a live replica
 #: mid-scale-up. Only valid with the autoscaler enabled.
 AUTOSCALE_KINDS = frozenset({"load_spike", "scale_during_failure"})
+
+#: control-plane kinds — detonated against the SUPERVISOR process itself
+#: (``ChaosInjector.check_supervisor_fault``, called from the supervisor's
+#: own poll loop), never shipped to workers. ``supervisor_kill`` SIGKILLs
+#: the supervisor's own pid mid-loop — indistinguishable from an operator's
+#: ``kill -9`` — leaving live orphan replicas for the next incarnation to
+#: re-adopt; ``supervisor_hang`` wedges the poll loop while workers keep
+#: running. Only valid for workloads that journal their state
+#: (docs/RESILIENCE.md "Control-plane crash safety"): ``serve_lm`` has no
+#: supervisor restart inside one process, so its ``--chaos`` validation
+#: rejects these kinds and the control-plane drill owns them instead.
+CONTROLPLANE_KINDS = frozenset({"supervisor_kill", "supervisor_hang"})
 
 #: exit code of a rank_kill'd worker — distinguishable from collateral
 #: crashes (a peer's collective erroring out) in the supervisor's logs.
@@ -539,6 +555,45 @@ class ChaosInjector:
         ):
             return self.stall_s
         return 0.0
+
+    def check_supervisor_fault(
+        self, *, step: int, on_fire: Any = None
+    ) -> None:
+        """Control-plane hook, called from the SUPERVISOR's own poll loop
+        with its tick counter (docs/RESILIENCE.md "Control-plane crash
+        safety"). ``supervisor_kill`` SIGKILLs the supervisor's own pid —
+        indistinguishable from an operator's ``kill -9``, so every Popen
+        handle, the router ledger, and the in-memory books die with it
+        while the worker processes (children in their own sessions) live
+        on as orphans. ``supervisor_hang`` wedges the loop forever with
+        workers still running. ``on_fire(kind)`` runs before detonation:
+        the write-ahead journal must record the fire, because the dying
+        incarnation's registry is lost and the journal is how the next
+        incarnation reconciles the chaos books.
+
+        Trigger semantics are ``step >= at`` (like ``load_spike``), not the
+        exact-match of :meth:`should_fire`: the supervisor's completed-count
+        can jump by several per poll tick and must not step over its own
+        detonation."""
+        for spec in self.plan.specs:
+            if spec.kind not in ("supervisor_kill", "supervisor_hang"):
+                continue
+            if spec.fired or step < spec.at:
+                continue
+            kind = spec.kind
+            self.should_fire(kind, spec.at)  # counts the fire
+            if on_fire is not None:
+                on_fire(kind)
+            _dump_flight(f"chaos-{kind}-step{step}")
+            print(
+                f"chaos: injected {kind}@step:{step} — supervisor "
+                f"{'SIGKILLed (orphaning live workers)' if kind == 'supervisor_kill' else 'poll loop wedged'}",
+                flush=True,
+            )
+            if kind == "supervisor_kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            while True:
+                time.sleep(60.0)
 
     def maybe_poison(self, batch: Any, task: str, *, step: int) -> Any:
         """Trainer hook: return a NaN-poisoned copy of ``batch`` when a

@@ -72,9 +72,16 @@ from typing import Any, Mapping, Optional, Sequence
 from deeplearning_mpi_tpu.resilience.cluster import (
     ENV_HEARTBEAT_DIR,
     ENV_HEARTBEAT_INTERVAL,
+    ENV_INCARNATION,
+    JOURNAL_FILE,
+    SUP_INCARNATION,
+    SUP_REPLAY_S,
+    SUP_RESPAWNED,
     ClusterSupervisor,
     LivenessTracker,
+    pid_alive,
     reap,
+    replay_journal,
     scrub_rendezvous_env,
     sigkill_group,
 )
@@ -168,6 +175,7 @@ class PodSupervisor(ClusterSupervisor):
         max_pod_restarts: int = 2,
         straggler_factor: float = 4.0,
         ckpt_dir: str | Path | None = None,
+        resume: bool = False,
         registry: MetricsRegistry | None = None,
         env: Mapping[str, str] | None = None,
     ) -> None:
@@ -193,6 +201,15 @@ class PodSupervisor(ClusterSupervisor):
         # not the (possibly corrupt) workers — must prune them before the
         # survivors resume. None disables the prune.
         self.ckpt_dir = Path(ckpt_dir) if ckpt_dir is not None else None
+        # Control-plane crash safety (docs/RESILIENCE.md): resume=True
+        # replays a dead predecessor's journal — attempt numbering,
+        # restart/chaos books, and pending recoveries carry over, and the
+        # corpse's orphan ranks are SIGKILLed (a training world is NEVER
+        # half-adopted: with its supervisor dead mid-collective the only
+        # safe recovery is teardown + checkpoint restore, which is what
+        # the respawn's --resume path already does). resume=False scrubs
+        # the journal and starts incarnation bookkeeping fresh.
+        self.resume = resume
 
     def _chaos_target(self, spec: str, world: int) -> Optional[int]:
         """Rank a planned pod-level fault detonates on, or None.
@@ -222,6 +239,9 @@ class PodSupervisor(ClusterSupervisor):
         base.update(self.extra_env)
         base[ENV_HEARTBEAT_DIR] = str(hb_dir)
         base[ENV_HEARTBEAT_INTERVAL] = str(self.heartbeat_interval_s)
+        # Workers echo this incarnation in every heartbeat so a restarted
+        # supervisor's tracker rejects a dead incarnation's beat files.
+        base[ENV_INCARNATION] = str(self.incarnation or 0)
         if spec:
             base["DMT_CHAOS"] = spec
         else:
@@ -254,6 +274,11 @@ class PodSupervisor(ClusterSupervisor):
             f"attempt {attempt}: spawned world of {world} "
             f"(pids {[p.pid for p in procs.values()]}, chaos={spec or 'none'})"
         )
+        if self.journal is not None:
+            self.journal.record(
+                "spawn", attempt=attempt, world=world,
+                pids=[p.pid for p in procs.values()], chaos=spec,
+            )
         return procs, handles, hb_dir
 
     def _blame_corrupt(
@@ -327,11 +352,84 @@ class PodSupervisor(ClusterSupervisor):
         for proc in procs.values():
             reap(proc)
 
+    # -- crash recovery (docs/RESILIENCE.md "Control-plane crash safety") ----
+    def _scrub_dead_pod(self) -> None:
+        """``resume=False`` hygiene: a journal in the pod dir means a
+        previous supervisor died here — SIGKILL every rank it journaled
+        (still mid-collective, unrecoverable without the books we are
+        about to discard) and drop the journal so this run starts clean."""
+        path = self.dir / JOURNAL_FILE
+        if not path.exists():
+            return
+        for r in replay_journal(path):
+            if r.get("ev") == "spawn":
+                for pid in r.get("pids", ()):
+                    self._kill_orphan(int(pid))
+        try:
+            path.unlink()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _replay_pod_state(prior: list[dict]) -> dict[str, Any]:
+        """Fold a dead predecessor's journal into resumable state. Pure —
+        no clocks, no probes — so the fake-clock tests can drive it.
+
+        Unlike the fleet there is NO re-adoption path: a training world
+        whose supervisor died cannot be trusted mid-collective (any rank
+        may be blocked in an all-reduce whose peers are gone), so every
+        journaled pid is an orphan to SIGKILL and the resumed attempt
+        restores from the checkpoint like any other re-form.
+        """
+        pids: set[int] = set()
+        world_sizes: list[int] = []
+        next_attempt = 0
+        rank_failures = 0
+        failures_by_kind: dict[str, int] = {}
+        fires: list[dict] = []  # planned faults the corpse observed
+        recoveries: list[str] = []
+        for r in prior:
+            ev = r.get("ev")
+            if ev == "spawn":
+                pids.update(int(p) for p in r.get("pids", ()))
+                world_sizes.append(int(r["world"]))
+                next_attempt = max(next_attempt, int(r["attempt"]) + 1)
+            elif ev == "rank_failure":
+                rank_failures += 1
+                kind = str(r["kind"])
+                failures_by_kind[kind] = failures_by_kind.get(kind, 0) + 1
+                if r.get("at") is not None:
+                    fires.append({
+                        "kind": kind, "unit": r.get("unit"),
+                        "at": int(r["at"]), "t": float(r["t"]),
+                    })
+            elif ev == "chaos_recovery":
+                recoveries.append(str(r["kind"]))
+        restarts = sum(1 for r in prior if r.get("ev") == "reform")
+        return {
+            "pids": sorted(pids),
+            "world_sizes": world_sizes,
+            "next_attempt": next_attempt,
+            "restarts": restarts,
+            "rank_failures": rank_failures,
+            "failures_by_kind": failures_by_kind,
+            "fires": fires,
+            "recoveries": recoveries,
+        }
+
     # -- the supervision loop ------------------------------------------------
     def run(self) -> PodResult:
+        replay_wall0 = time.monotonic()
+        if not self.resume:
+            self._scrub_dead_pod()
         injector = self._open_books("pod_metrics.jsonl")
+        journal, prior = self._open_journal()
+        recovered = (
+            self._replay_pod_state(prior) if (self.resume and prior) else None
+        )
+        self.registry.gauge(SUP_INCARNATION).set(float(self.incarnation))
         for name in (POD_RANK_FAILURES, POD_RESTARTS, POD_STRAGGLERS,
-                     POD_DIGEST_MISMATCHES, POD_QUARANTINES):
+                     POD_DIGEST_MISMATCHES, POD_QUARANTINES, SUP_RESPAWNED):
             self.registry.counter(name)
         # SDC machinery. Host identity survives rank re-numbering: attempt
         # 0's rank i is host i, and after a re-form the new rank j is the
@@ -360,6 +458,67 @@ class PodSupervisor(ClusterSupervisor):
         # (kind, detection time) pairs awaiting the re-formed world's first
         # progress — that observation closes the chaos recovery.
         pending_recoveries: list[tuple[str, float]] = []
+        attempt0 = 0
+        if recovered is not None:
+            # The corpse's world is unadoptable mid-collective: SIGKILL
+            # every journaled rank still alive (each counts as a forced
+            # respawn), then resume the books — attempt numbering,
+            # restart/failure counters, and chaos accounting all span
+            # incarnations. The resumed world restores from the latest
+            # checkpoint exactly like any other re-form, and it re-forms
+            # at the full admissible host set: the quarantine ledger, not
+            # the corpse's transient shrink, is the source of host health.
+            scrubbed = 0
+            for pid in recovered["pids"]:
+                if pid_alive(pid):
+                    self._kill_orphan(pid)
+                    scrubbed += 1
+                    self.registry.counter(SUP_RESPAWNED).inc()
+            attempt0 = recovered["next_attempt"]
+            restarts = recovered["restarts"]
+            rank_failures = recovered["rank_failures"]
+            world_sizes = list(recovered["world_sizes"])
+            if restarts:
+                self.registry.counter(POD_RESTARTS).inc(restarts)
+            for kind, n in sorted(recovered["failures_by_kind"].items()):
+                self.registry.counter(POD_RANK_FAILURES).inc(n)
+                self.registry.counter(
+                    labeled(POD_RANK_FAILURES, kind=kind)
+                ).inc(n)
+            if injector is not None:
+                # Re-mark journaled fires; recoveries the corpse already
+                # closed replay at zero incremental latency. Fires still
+                # open when it died close when the resumed world first
+                # progresses — with a latency that spans the crash (the
+                # journal stamp and this process's clock are both
+                # system-wide CLOCK_MONOTONIC).
+                open_recoveries = list(recovered["recoveries"])
+                for f in recovered["fires"]:
+                    injector.fire_observed(f["kind"])
+                    if f["kind"] in open_recoveries:
+                        open_recoveries.remove(f["kind"])
+                        injector.record_recovery(f["kind"], latency_s=0.0)
+                    else:
+                        pending_recoveries.append((f["kind"], f["t"]))
+                fired = [
+                    f"{s.kind}@{s.unit}:{s.at}"
+                    for s in injector.plan.specs
+                    if s.kind in ("rank_kill", "rank_hang", "bitflip")
+                    and s.fired
+                ]
+                spec = strip_entries(spec, fired)
+            replay_s = time.monotonic() - replay_wall0
+            self.registry.gauge(SUP_REPLAY_S).set(replay_s)
+            journal.record(
+                "recovered", scrubbed=scrubbed, restarts=restarts,
+                rank_failures=rank_failures, replay_s=replay_s,
+            )
+            self._log(
+                f"incarnation {self.incarnation}: journal replay took "
+                f"{replay_s:.2f}s — scrubbed {scrubbed} orphan rank(s), "
+                f"resuming at attempt {attempt0} (restarts {restarts}, "
+                f"rank failures {rank_failures})"
+            )
         ok = False
         try:
             if world < self.min_world_size:
@@ -367,7 +526,7 @@ class PodSupervisor(ClusterSupervisor):
                     f"{world} admissible host(s) after quarantine — below "
                     f"min_world_size={self.min_world_size}"
                 )
-            attempt = 0
+            attempt = attempt0
             while True:
                 world_sizes.append(world)
                 procs, handles, hb_dir = self._spawn(attempt, world, spec)
@@ -400,6 +559,7 @@ class PodSupervisor(ClusterSupervisor):
                                 injector.record_recovery(
                                     kind, latency_s=now - detected
                                 )
+                                journal.record("chaos_recovery", kind=kind)
                                 self._log(
                                     f"recovery: {kind} closed — re-formed "
                                     f"world progressing "
@@ -537,6 +697,11 @@ class PodSupervisor(ClusterSupervisor):
                             f"{divergence.step}"
                         )
                     hit = injector.fire_observed(kind) if injector else None
+                    journal.record(
+                        "rank_failure", rank=rank, kind=kind, why=why,
+                        unit=hit.unit if hit is not None else None,
+                        at=hit.at if hit is not None else None,
+                    )
                     if hit is not None:
                         pending_recoveries.append((kind, detected))
                         self._log(
@@ -558,6 +723,11 @@ class PodSupervisor(ClusterSupervisor):
                     )
                     hit = injector.fire_observed("bitflip") if injector else None
                     if hit is not None:
+                        journal.record(
+                            "rank_failure", rank=-1, kind="bitflip",
+                            why="digest mismatch, unattributable",
+                            unit=hit.unit, at=hit.at,
+                        )
                         pending_recoveries.append(("bitflip", detected))
                 for rank in corrupt:
                     host = hosts[rank]
@@ -617,6 +787,10 @@ class PodSupervisor(ClusterSupervisor):
                     spec = strip_entries(spec, fired)
                 restarts += 1
                 attempt += 1
+                journal.record(
+                    "reform", old_world=world, new_world=new_world,
+                    restarts=restarts,
+                )
                 self.registry.counter(POD_RESTARTS).inc()
                 self.registry.gauge(POD_WORLD_SIZE).set(new_world)
                 self._log(
@@ -630,6 +804,9 @@ class PodSupervisor(ClusterSupervisor):
             self._result(False, world_sizes, restarts, rank_failures, injector)
             raise
         finally:
+            journal.record("supervisor_stop", pid=os.getpid())
+            journal.close()
+            self.journal = None
             self._close_registry()
 
     def _result(

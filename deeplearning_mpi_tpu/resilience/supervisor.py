@@ -201,6 +201,20 @@ class Heartbeat:
             "interval_s": self.interval_s,
             "progress_seq": self._progress_seq,
             "progress_age_s": now - self._progress_mono,
+            # Stale-incarnation hygiene: echo the spawning supervisor's
+            # incarnation (cluster.py::ENV_INCARNATION — the literal is
+            # repeated here because cluster.py imports this module) so a
+            # restarted supervisor's LivenessTracker can reject beats
+            # written under a dead control plane. Workers that track a
+            # live incarnation (the fleet's adopt handshake) override it
+            # via ``progress``.
+            **(
+                {"incarnation": int(inc)}
+                if (inc := os.environ.get(
+                    "DMT_SUPERVISOR_INCARNATION"
+                )) is not None and inc.isdigit()
+                else {}
+            ),
             **self._progress,
         }
         tmp = self.path.with_suffix(".tmp")

@@ -57,16 +57,24 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
-from collections import deque
+from collections import Counter, deque
 from pathlib import Path
 from typing import Any, Mapping, Optional
 
 from deeplearning_mpi_tpu.resilience.cluster import (
+    JOURNAL_FILE,
+    SUP_INCARNATION,
+    SUP_READOPTED,
+    SUP_REPLAY_S,
+    SUP_RESPAWNED,
     ClusterSupervisor,
     kill_and_reap,
+    pid_alive,
+    replay_journal,
     scrub_rendezvous_env,
     tail_jsonl,
 )
@@ -76,6 +84,10 @@ __all__ = ["FleetFailure", "FleetResult", "FleetSupervisor", "worker_main"]
 FLEET_RESTARTS = "fleet_replica_restarts_total"
 FLEET_FAILURES = "fleet_replica_failures_total"
 FLEET_REDISPATCH = "fleet_redispatch_total"
+# Control-plane crash safety (docs/RESILIENCE.md): the incarnation gauge
+# and recovery books a restarted supervisor reports after replaying the
+# write-ahead journal and probing the dead incarnation's orphans. The
+# names live in resilience/cluster.py (shared with PodSupervisor).
 
 # The JSONL-tail reader moved into the unified supervision core
 # (resilience/cluster.py); the historical name stays importable here.
@@ -165,6 +177,11 @@ def worker_main(argv: list[str] | None = None) -> int:
         return p
 
     version = int(spec.get("version", 0))
+    # Which supervisor incarnation owns this worker. Rides every heartbeat
+    # (LivenessTracker rejects records from dead incarnations) and is
+    # updated in place by the `adopt` handshake when a restarted
+    # supervisor claims this orphan.
+    incarnation = int(spec.get("incarnation", 0))
     params = init_params(int(spec["seed"]))
     registry = MetricsRegistry()
     chaos = ChaosInjector.from_spec(None, registry=registry)  # $DMT_CHAOS
@@ -229,7 +246,7 @@ def worker_main(argv: list[str] | None = None) -> int:
     emit({
         "op": "ready", "replica": args.replica, "pid": os.getpid(),
         "version": version, "compile_total": compile_counter.value,
-        "mono_offset": mono_offset,
+        "mono_offset": mono_offset, "incarnation": incarnation,
     })
 
     inbox = rdir / "inbox.jsonl"
@@ -252,6 +269,10 @@ def worker_main(argv: list[str] | None = None) -> int:
                     rid = int(m["rid"])
                     if rid in cancelled:
                         continue  # the cancel raced ahead of this copy
+                    if rid in live:
+                        # Duplicate copy of work already decoding here (a
+                        # re-dispatch raced the adopt ack) — idempotent.
+                        continue
                     req = engine.submit(
                         np.asarray(m["prompt"], np.int32), int(m["max_new"]),
                         deadline=m.get("deadline"), arrival=m.get("arrival"),
@@ -269,6 +290,24 @@ def worker_main(argv: list[str] | None = None) -> int:
                     req = live.pop(rid, None)
                     if req is not None:
                         engine.cancel(req)
+                elif op == "adopt":
+                    # Orphan re-adoption handshake: a restarted supervisor
+                    # (new incarnation) claims this still-running worker.
+                    # NOTHING is reset — the warmed engine keeps its KV
+                    # pools and compiled programs (the ack's compile
+                    # counter proves zero retraces) and in-flight requests
+                    # keep decoding; the ack lists their rids so the new
+                    # incarnation rebuilds its router books instead of
+                    # re-dispatching work this worker already holds.
+                    incarnation = int(m["incarnation"])
+                    emit({
+                        "op": "adopted", "replica": args.replica,
+                        "pid": os.getpid(), "incarnation": incarnation,
+                        "version": version,
+                        "compile_total": compile_counter.value,
+                        "mono_offset": mono_offset,
+                        "rids": sorted(live),
+                    })
                 elif op == "swap":
                     # Same-shape/dtype params are an argument to the warmed
                     # programs, not a capture — assignment swaps weights
@@ -341,6 +380,11 @@ def worker_main(argv: list[str] | None = None) -> int:
                 "ttft_p50": ttft_hist.percentile(0.5) or 0.0,
                 "version": version,
                 "mono_offset": mono_offset,
+                # Stale-incarnation hygiene: which supervisor this beat
+                # answers to. A restarted supervisor's LivenessTracker
+                # rejects beats stamped by a dead incarnation, so a
+                # pre-crash heartbeat file can never mask a dead worker.
+                "incarnation": incarnation,
             }
     except BaseException:
         # Unclean exit: leave the black box. (A chaos replica_kill never
@@ -365,6 +409,44 @@ def worker_main(argv: list[str] | None = None) -> int:
 # supervisor
 # ---------------------------------------------------------------------------
 
+class _AdoptedProc:
+    """Popen-shaped handle for a re-adopted orphan.
+
+    An adopted worker is NOT this supervisor's child — it was forked by a
+    dead incarnation and reparented to init — so there is no waitable
+    handle and no exit status to observe. Liveness is pid probing
+    (:func:`~..resilience.cluster.pid_alive`), teardown is a best-effort
+    group SIGKILL, and "reaping" is waiting for the pid to vanish (init
+    does the actual reap). Implements exactly the ``poll``/``wait``/
+    ``kill`` surface ``kill_and_reap`` and the supervision loop use.
+    """
+
+    def __init__(self, pid: int) -> None:
+        self.pid = pid
+        self._rc: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self._rc is None and not pid_alive(self.pid):
+            # The true status died with the old incarnation; report the
+            # conventional SIGKILL code so failure handling reads sanely.
+            self._rc = -9
+        return self._rc
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("adopted-orphan", timeout)
+            time.sleep(0.05)
+        return self._rc  # type: ignore[return-value]
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 @dataclasses.dataclass
 class _Replica:
     """Supervisor-side state for one replica slot."""
@@ -384,6 +466,9 @@ class _Replica:
     compile_at_ready: Optional[float] = None
     compile_flat: bool = True
     stopped: Optional[dict] = None
+    #: True when this slot's process was inherited from a dead incarnation
+    #: via the re-adoption handshake rather than spawned by this one.
+    adopted: bool = False
     #: last heartbeat payload observed — the autoscaler's load signal
     #: (queue_depth et al.) reads it without re-parsing the file.
     last_hb: Optional[dict] = None
@@ -439,6 +524,11 @@ class FleetResult:
     shed_by_tenant: dict[str, dict[str, int]] = dataclasses.field(
         default_factory=dict
     )
+    #: control-plane crash safety: this run's incarnation id and what the
+    #: journal-replay recovery did (all zero for a first-boot run).
+    incarnation: int = 0
+    readopted: int = 0
+    respawned: int = 0
 
 
 class FleetSupervisor(ClusterSupervisor):
@@ -486,9 +576,12 @@ class FleetSupervisor(ClusterSupervisor):
         tenants: dict[str, dict[str, Any]] | None = None,
         autoscale: Any = None,
         trace_dir: str | Path | None = None,
+        resume: bool = False,
+        adopt_grace_s: float = 6.0,
     ) -> None:
         from deeplearning_mpi_tpu.resilience.faults import (
             AUTOSCALE_KINDS,
+            CONTROLPLANE_KINDS,
             FLEET_KINDS,
             validate_plan_kinds,
         )
@@ -535,18 +628,29 @@ class FleetSupervisor(ClusterSupervisor):
                 f"[{autoscale.min_replicas}, {autoscale.max_replicas}]"
             )
         if self.chaos_spec.strip():
-            supported = FLEET_KINDS
+            # CONTROLPLANE_KINDS are valid on any supervised fleet: the
+            # supervisor detonates ITSELF and a `resume=True` restart on
+            # the same fleet_dir is the recovery path. (serve_lm still
+            # rejects them — its CLI run has no restart harness.)
+            supported = FLEET_KINDS | CONTROLPLANE_KINDS
             workload = "serving fleet"
             if autoscale is not None:
-                # The supervisor-detonated drill kinds are only meaningful
-                # with the control loop running.
-                supported = FLEET_KINDS | AUTOSCALE_KINDS
+                # The autoscaler drill kinds are only meaningful with the
+                # control loop running.
+                supported = supported | AUTOSCALE_KINDS
                 workload = "autoscaled serving fleet"
             validate_plan_kinds(self.chaos_spec, supported, workload=workload)
         self.hedge_ms = hedge_ms
         self.exclusion_s = exclusion_s
         self.max_replica_restarts = max_replica_restarts
         self.timeout_s = timeout_s
+        #: crash recovery: with ``resume=True``, :meth:`run` replays the
+        #: dead incarnation's write-ahead journal, probes its journaled
+        #: pids, re-adopts the live orphans, and re-dispatches the rest.
+        #: Default False treats a dirty fleet_dir as stale state: any
+        #: journaled orphans are SIGKILLed and the journal retired.
+        self.resume = bool(resume)
+        self.adopt_grace_s = float(adopt_grace_s)
         #: distributed tracing: when set, the supervisor and every worker
         #: each write a SpanRecorder JSONL into this dir (workers get the
         #: path via spec.json) and ``tools/trace_report.py`` merges them.
@@ -599,6 +703,7 @@ class FleetSupervisor(ClusterSupervisor):
             "tp": self.tp,
             "tenants": self.tenants,
             "trace_dir": str(self.trace_dir) if self.trace_dir else None,
+            "incarnation": int(self.incarnation or 0),
         })
         (rdir / "inbox.jsonl").touch()
         env = dict(os.environ)
@@ -630,6 +735,18 @@ class FleetSupervisor(ClusterSupervisor):
         rep.compile_at_ready = None
         rep.inbox = (rdir / "inbox.jsonl").open("a")
         rep.tracker = self.new_tracker([0])
+        rep.adopted = False
+        rep.stopped = None
+        if self.journal is not None:
+            # Journaled right after the fork so a successor can find (and
+            # probe or kill) this pid. The one-Popen-call window where a
+            # crash leaks an unjournaled child is closed by the heartbeat
+            # file: the worker stamps its own pid there too.
+            self.journal.record(
+                "spawn", idx=rep.idx, attempt=rep.attempt,
+                pid=rep.proc.pid, seed=rep.seed, version=rep.version,
+                dir=rdir.name, chaos=rep.chaos_spec,
+            )
         self._log(
             f"replica {rep.idx} attempt {rep.attempt}: spawned pid "
             f"{rep.proc.pid} (version {rep.version}, "
@@ -651,6 +768,204 @@ class FleetSupervisor(ClusterSupervisor):
             rep.inbox.close()
             rep.inbox = None
 
+    # -- crash recovery (docs/RESILIENCE.md "Control-plane crash safety") ----
+    # (`_kill_orphan` lives on ClusterSupervisor — shared with the pod.)
+
+    def _scrub_dead_fleet(self) -> None:
+        """Fresh-start hygiene (``resume=False``) on a dirty fleet dir: a
+        dead incarnation's journal may name live orphans that would fight
+        this run's workers for the per-replica IPC files — SIGKILL them
+        and retire the journal before opening a new one. (Recovery is an
+        explicit opt-in; the default must never silently inherit another
+        run's ledger.)"""
+        path = self.dir / JOURNAL_FILE
+        if not path.exists():
+            return
+        for r in replay_journal(path):
+            if r.get("ev") in ("spawn", "adopt") and r.get("pid"):
+                self._kill_orphan(int(r["pid"]))
+        try:
+            path.unlink()
+        except OSError:
+            pass
+
+    def _try_adopt(
+        self, rep: _Replica, pid: int
+    ) -> tuple[Optional[dict], list[dict]]:
+        """Probe one journaled orphan and try to re-adopt it alive.
+
+        Three independent proofs of life: (1) the pid exists and is not a
+        zombie; (2) its heartbeat ``progress_seq`` advances during the
+        probe window (the heartbeat daemon beats through a wedge, so a
+        fresh file with a frozen seq is a hung worker — kill, don't
+        adopt); (3) it answers the incarnation handshake — an ``adopt``
+        op appended to its inbox, acked by ``adopted`` (stamped with OUR
+        incarnation) on its outbox, carrying the rids it still holds.
+
+        Returns ``(ack, history)`` on success, where ``history`` is every
+        outbox record that landed before the ack — completions that
+        finished while the fleet ran unsupervised are in there and count,
+        sparing a re-decode. Returns ``(None, [])`` when the orphan is
+        dead, wedged, or deaf; the caller respawns the slot.
+        """
+        from deeplearning_mpi_tpu.resilience.supervisor import Heartbeat
+
+        if rep.dir is None or not pid_alive(pid):
+            return None, []
+        hb0 = Heartbeat.read(rep.dir / "heartbeat.json")
+        seq0 = hb0.get("progress_seq") if hb0 else None
+        rep.inbox = (rep.dir / "inbox.jsonl").open("a")
+        self._send(rep, {"op": "adopt", "incarnation": self.incarnation})
+        history: list[dict] = []
+        seq_advanced = False
+        deadline = time.monotonic() + self.adopt_grace_s
+        while time.monotonic() < deadline:
+            hb = Heartbeat.read(rep.dir / "heartbeat.json")
+            if (
+                hb is not None and seq0 is not None
+                and hb.get("progress_seq", 0) > seq0
+            ):
+                seq_advanced = True
+            msgs, rep.outbox_offset = tail_jsonl(
+                rep.dir / "outbox.jsonl", rep.outbox_offset
+            )
+            for m in msgs:
+                if (
+                    m.get("op") == "adopted"
+                    and int(m.get("incarnation", -1)) == self.incarnation
+                ):
+                    return m, history
+                history.append(m)
+            if not pid_alive(pid):
+                break
+            time.sleep(self.poll_interval_s)
+        self._log(
+            f"replica {rep.idx}: orphan pid {pid} not adoptable "
+            f"(alive={pid_alive(pid)}, progress_advanced={seq_advanced}, "
+            "no handshake ack) — respawning"
+        )
+        if rep.inbox is not None:
+            rep.inbox.close()
+            rep.inbox = None
+        rep.outbox_offset = 0
+        return None, []
+
+    @staticmethod
+    def _replay_fleet_state(prior: list[dict]) -> dict:
+        """Fold a dead predecessor's journal into the bookkeeping a
+        restarted supervisor starts from: live replica slots (to probe),
+        the request ledger (resolved + orphaned), scale/brownout/chaos
+        books, and the trace clock. Pure function of the records — no
+        clock, no IO — so the fake-clock unit tests drive it directly.
+        """
+        slots: dict[int, dict] = {}
+        ledger: dict[int, dict] = {}
+        fires: list[dict] = []
+        recovery_kinds: list[str] = []
+        scale_records: list[tuple[str, str]] = []
+        brownout_records: list[int] = []
+        failures: dict[str, int] = {}
+        t0: Optional[float] = None
+        restarts = 0
+        redispatched = 0
+        brownout_stage = 0
+        brownout_stage_max = 0
+        max_idx = -1
+        swap_done_version = 0
+        retire_begun: list[int] = []
+        retired_done: list[int] = []
+        for r in prior:
+            ev = r.get("ev")
+            if ev == "clock_start":
+                t0 = float(r["t0"])
+            elif ev == "spawn":
+                idx = int(r["idx"])
+                max_idx = max(max_idx, idx)
+                slots[idx] = {
+                    "attempt": int(r["attempt"]), "pid": int(r["pid"]),
+                    "seed": int(r["seed"]), "version": int(r["version"]),
+                    "dir": r["dir"], "compile_ready": None,
+                }
+            elif ev == "adopt":
+                slot = slots.get(int(r["idx"]))
+                if slot is not None:
+                    slot["pid"] = int(r["pid"])
+                    slot["compile_ready"] = r.get("compile_total")
+            elif ev == "ready":
+                slot = slots.get(int(r["idx"]))
+                if slot is not None and slot["attempt"] == int(r["attempt"]):
+                    slot["compile_ready"] = r.get("compile_total")
+            elif ev == "retire_begin":
+                retire_begun.append(int(r["idx"]))
+            elif ev == "retired":
+                slots.pop(int(r["idx"]), None)
+                retired_done.append(int(r["idx"]))
+            elif ev == "failure":
+                restarts += 1
+                kind = str(r.get("kind", "replica_kill"))
+                failures[kind] = failures.get(kind, 0) + 1
+            elif ev == "admit":
+                ledger[int(r["rid"])] = dict(r)
+            elif ev == "redispatch":
+                redispatched += 1
+                jr = ledger.get(int(r["rid"]))
+                if jr is not None:
+                    jr["redispatched"] = True
+            elif ev == "done":
+                jr = ledger.get(int(r["rid"]))
+                if jr is not None and jr.get("tokens") is None:
+                    jr.update(
+                        tokens=r["tokens"], version=r.get("version"),
+                        ttft=r.get("ttft"), phase=r.get("phase"),
+                    )
+            elif ev == "shed":
+                jr = ledger.get(int(r["rid"]))
+                if jr is not None and jr.get("tokens") is None:
+                    jr["shed"] = r.get("reason")
+            elif ev == "swapped":
+                slot = slots.get(int(r["idx"]))
+                if slot is not None:
+                    slot["version"] = int(r["version"])
+            elif ev == "scale":
+                scale_records.append((str(r["direction"]), str(r["outcome"])))
+            elif ev == "brownout":
+                stage = int(r["stage"])
+                brownout_records.append(stage)
+                brownout_stage = stage
+                brownout_stage_max = max(brownout_stage_max, stage)
+            elif ev == "chaos_fire":
+                fires.append(r)
+            elif ev == "chaos_recovery":
+                recovery_kinds.append(str(r["kind"]))
+            elif ev == "swap_done":
+                swap_done_version = int(r["version"])
+        # A retire that began but never completed resumes in the new
+        # incarnation — its slot is still live (maybe adoptably so), and
+        # the scale books only balance once the drain finishes.
+        unfinished = [
+            i for i in retire_begun
+            if i not in retired_done and i in slots
+        ]
+        return {
+            "slots": slots,
+            "ledger": ledger,
+            "next_rid": (max(ledger) + 1) if ledger else 0,
+            "next_idx": max_idx + 1,
+            "t0": t0,
+            "restarts": restarts,
+            "failures": failures,
+            "redispatched": redispatched,
+            "fires": fires,
+            "recovery_kinds": recovery_kinds,
+            "scale_records": scale_records,
+            "retired_count": len(retired_done),
+            "brownout_records": brownout_records,
+            "brownout_stage": brownout_stage,
+            "brownout_stage_max": brownout_stage_max,
+            "swap_done_version": swap_done_version,
+            "retiring": unfinished[0] if unfinished else None,
+        }
+
     # -- the supervision loop ------------------------------------------------
     def run(
         self,
@@ -670,8 +985,19 @@ class FleetSupervisor(ClusterSupervisor):
         from deeplearning_mpi_tpu.telemetry.registry import labeled
 
         injector = self._open_books("fleet_metrics.jsonl")
-        for name in (FLEET_RESTARTS, FLEET_FAILURES, FLEET_REDISPATCH):
+        for name in (FLEET_RESTARTS, FLEET_FAILURES, FLEET_REDISPATCH,
+                     SUP_READOPTED, SUP_RESPAWNED):
             self.registry.counter(name)
+        # -- write-ahead journal + crash recovery ---------------------------
+        replay_wall0 = time.monotonic()
+        if not self.resume:
+            self._scrub_dead_fleet()
+        journal, prior = self._open_journal()
+        recovered = (
+            self._replay_fleet_state(prior)
+            if (self.resume and prior) else None
+        )
+        self.registry.gauge(SUP_INCARNATION).set(float(self.incarnation))
         policy = None
         if self.autoscale is not None:
             from deeplearning_mpi_tpu.serving.autoscaler import (
@@ -684,24 +1010,92 @@ class FleetSupervisor(ClusterSupervisor):
             # Explicit zeros so a scale-free autoscaled run still reports.
             self.registry.counter("fleet_scale_total")
             self.registry.counter("fleet_brownout_total")
+        slot_ids = (
+            sorted(recovered["slots"]) if recovered is not None
+            else list(range(self.num_replicas))
+        )
         router = Router(
-            range(self.num_replicas),
+            slot_ids,
             hedge_ms=self.hedge_ms,
             exclusion_s=self.exclusion_s,
             registry=self.registry,
             roles=(
-                {r: "disagg" for r in range(self.num_replicas)}
+                {r: "disagg" for r in slot_ids}
                 if self.disagg else None
             ),
         )
         per_chaos = self._replica_chaos()
-        replicas = {
-            k: _Replica(idx=k, seed=self.seed, chaos_spec=per_chaos.get(k, ""))
-            for k in range(self.num_replicas)
-        }
-        for rep in replicas.values():
-            router.exclude(rep.idx)  # ineligible until its ready lands
-            self._spawn(rep)
+        adopted_n = respawned_n = 0
+        #: idx -> (adopt ack, pre-ack outbox history) for re-adopted slots;
+        #: folded into the ledger once it is rebuilt below.
+        adopt_histories: dict[int, tuple[dict, list[dict]]] = {}
+        if recovered is None:
+            replicas = {
+                k: _Replica(idx=k, seed=self.seed,
+                            chaos_spec=per_chaos.get(k, ""))
+                for k in slot_ids
+            }
+            for rep in replicas.values():
+                router.exclude(rep.idx)  # ineligible until its ready lands
+                self._spawn(rep)
+        else:
+            # Orphan re-adoption: probe every slot the corpse journaled.
+            # Live + progressing + handshake-acked ⇒ inherit the process
+            # (warmed engine, KV pools, in-flight decodes — zero retraces);
+            # anything else ⇒ SIGKILL the pid and respawn the slot.
+            replicas = {}
+            for idx in slot_ids:
+                slot = recovered["slots"][idx]
+                rep = _Replica(
+                    idx=idx, seed=int(slot["seed"]),
+                    version=int(slot.get("version", 0)),
+                    # The corpse's worker-side chaos died (or detonated)
+                    # with it; a recovered fleet does not re-arm it.
+                    chaos_spec="",
+                    attempt=int(slot["attempt"]),
+                )
+                rep.dir = self.fleet_dir / slot["dir"]
+                replicas[idx] = rep
+                router.exclude(idx)
+                ack, history = self._try_adopt(rep, int(slot["pid"]))
+                if ack is not None:
+                    rep.proc = _AdoptedProc(int(slot["pid"]))
+                    rep.adopted = True
+                    rep.ready = True
+                    rep.version = int(ack.get("version", rep.version))
+                    rep.compile_at_ready = float(ack["compile_total"])
+                    if (
+                        slot.get("compile_ready") is not None
+                        and rep.compile_at_ready
+                        != float(slot["compile_ready"])
+                    ):
+                        # The orphan compiled something while unsupervised
+                        # — adoption must not launder a retrace.
+                        rep.compile_flat = False
+                    rep.tracker = self.new_tracker([0])
+                    router.mark_alive(idx, time.monotonic())
+                    router.include(idx)
+                    journal.record(
+                        "adopt", idx=idx, attempt=rep.attempt,
+                        pid=int(ack["pid"]),
+                        compile_total=rep.compile_at_ready,
+                        rids=[int(x) for x in ack.get("rids", [])],
+                    )
+                    adopt_histories[idx] = (ack, history)
+                    adopted_n += 1
+                    self.registry.counter(SUP_READOPTED).inc()
+                    self._log(
+                        f"replica {idx}: RE-ADOPTED live orphan pid "
+                        f"{ack['pid']} (attempt {rep.attempt}, "
+                        f"{len(ack.get('rids', []))} in flight, "
+                        f"compile_total {rep.compile_at_ready})"
+                    )
+                else:
+                    self._kill_orphan(int(slot["pid"]))
+                    rep.attempt += 1
+                    self._spawn(rep)
+                    respawned_n += 1
+                    self.registry.counter(SUP_RESPAWNED).inc()
 
         start = time.monotonic()
         # The trace clock starts at the fleet's first ready-ack, not at
@@ -757,6 +1151,7 @@ class FleetSupervisor(ClusterSupervisor):
                 injector.record_recovery(
                     pr["kind"], latency_s=now - pr["detected"]
                 )
+            journal.record("chaos_recovery", kind=pr["kind"])
             pending_recoveries.remove(pr)
             self._log(
                 f"recovery: {pr['kind']} on replica {pr['replica']} closed "
@@ -788,6 +1183,7 @@ class FleetSupervisor(ClusterSupervisor):
                 f"re-dispatching {len(orphans)} in-flight request(s)"
             )
             if hit is not None:
+                journal.record("chaos_fire", kind=kind, replica=rep.idx)
                 pending_recoveries.append({
                     "kind": kind, "replica": rep.idx, "detected": now,
                     "rids": set(orphans),
@@ -799,6 +1195,7 @@ class FleetSupervisor(ClusterSupervisor):
                 redispatch_queue.append(rid)
                 redispatched += 1
                 self.registry.counter(FLEET_REDISPATCH).inc()
+                journal.record("redispatch", rid=rid)
             # Hedge losers that lived on the dead replica are already
             # forgotten by mark_dead; their primaries carry on elsewhere.
             for rec in ledger.values():
@@ -810,6 +1207,8 @@ class FleetSupervisor(ClusterSupervisor):
                 )
             restarts += 1
             self.registry.counter(FLEET_RESTARTS).inc()
+            journal.record("failure", idx=rep.idx, kind=kind,
+                           chaos=hit is not None)
             if injector is not None:
                 from deeplearning_mpi_tpu.resilience.faults import (
                     strip_entries,
@@ -841,6 +1240,10 @@ class FleetSupervisor(ClusterSupervisor):
 
         def dispatch(rid: int, target: int, now: float) -> None:
             rec = ledger[rid]
+            # Write-ahead: the journal record lands before the wire op, so
+            # a crash can journal a dispatch the worker never saw (the
+            # probe re-discovers it) but never ship one it didn't journal.
+            journal.record("dispatch", rid=rid, target=target)
             self._send(replicas[target], {
                 "op": "req", "rid": rid, "prompt": rec.prompt,
                 "max_new": rec.max_new, "arrival": rec.arrival_abs,
@@ -869,6 +1272,10 @@ class FleetSupervisor(ClusterSupervisor):
             if op == "ready":
                 rep.ready = True
                 rep.compile_at_ready = float(m["compile_total"])
+                journal.record(
+                    "ready", idx=rep.idx, attempt=rep.attempt,
+                    compile_total=rep.compile_at_ready,
+                )
                 router.mark_alive(rep.idx, now)
                 router.include(rep.idx)
                 for pr in list(pending_recoveries):
@@ -887,6 +1294,12 @@ class FleetSupervisor(ClusterSupervisor):
                 rec.ttft = m.get("ttft")
                 rec.holders.discard(rep.idx)
                 completed += 1
+                # Tokens ride the journal so a successor's result (and the
+                # offline-greedy parity check) spans both incarnations.
+                journal.record(
+                    "done", rid=rid, tokens=rec.tokens,
+                    version=rec.version, ttft=rec.ttft, phase=phase,
+                )
                 if self.tracer is not None and m.get("t_finished") is not None:
                     # The stream leg: worker finish → supervisor receipt.
                     # Both stamps are system-wide CLOCK_MONOTONIC, so the
@@ -923,6 +1336,7 @@ class FleetSupervisor(ClusterSupervisor):
                 if rec.tokens is None and not rec.holders:
                     rec.shed_reason = reason
                     router.forget(rid)
+                    journal.record("shed", rid=rid, reason=reason)
                 for pr in list(pending_recoveries):
                     if pr["rids"] and rid in pr["rids"] and rec.resolved:
                         pr["rids"].discard(rid)
@@ -938,6 +1352,9 @@ class FleetSupervisor(ClusterSupervisor):
                     f"{'planned' if hit is not None else 'unplanned'})"
                 )
                 if hit is not None:
+                    journal.record(
+                        "chaos_fire", kind=m["kind"], replica=rep.idx
+                    )
                     pending_recoveries.append({
                         "kind": m["kind"], "replica": rep.idx,
                         "detected": now, "rids": set(),
@@ -945,6 +1362,7 @@ class FleetSupervisor(ClusterSupervisor):
                 phase = "during"
             elif op == "swapped":
                 rep.version = int(m["version"])
+                journal.record("swapped", idx=rep.idx, version=rep.version)
                 if float(m["compile_total"]) != rep.compile_at_ready:
                     rep.compile_flat = False
                     swap["compile_flat"] = False
@@ -968,6 +1386,244 @@ class FleetSupervisor(ClusterSupervisor):
                 ):
                     rep.compile_flat = False
 
+        # -- fold the dead incarnation's books into this run's state --------
+        if recovered is not None:
+            t0 = recovered["t0"]
+            next_rid = recovered["next_rid"]
+            next_idx = max(next_idx, recovered["next_idx"])
+            restarts = recovered["restarts"]
+            redispatched = recovered["redispatched"]
+            failures.update(recovered["failures"])
+            brownout_stage = recovered["brownout_stage"]
+            brownout_stage_max = recovered["brownout_stage_max"]
+            scale_events = len(recovered["scale_records"])
+            spawned = sum(
+                1 for d, o in recovered["scale_records"]
+                if d == "up" and o == "ok"
+            )
+            vetoed = sum(
+                1 for _, o in recovered["scale_records"] if o != "ok"
+            )
+            retired = recovered["retired_count"]
+            scale_ups = spawned
+            if recovered["swap_done_version"]:
+                target_version = recovered["swap_done_version"]
+                swap["performed"] = swap["requested"]
+            # Seed this incarnation's counters with the corpse's books so
+            # fleet_summary reconciles ACROSS incarnations, not per-process.
+            if restarts:
+                self.registry.counter(FLEET_RESTARTS).inc(restarts)
+            for kind, n in recovered["failures"].items():
+                self.registry.counter(FLEET_FAILURES).inc(n)
+                self.registry.counter(
+                    labeled(FLEET_FAILURES, kind=kind)
+                ).inc(n)
+            if redispatched:
+                self.registry.counter(FLEET_REDISPATCH).inc(redispatched)
+            for direction, outcome in recovered["scale_records"]:
+                self.registry.counter("fleet_scale_total").inc()
+                self.registry.counter(labeled(
+                    "fleet_scale_total",
+                    direction=direction, outcome=outcome,
+                )).inc()
+            for stage in recovered["brownout_records"]:
+                self.registry.counter("fleet_brownout_total").inc()
+                self.registry.counter(labeled(
+                    "fleet_brownout_total", stage=str(stage)
+                )).inc()
+            # Ledger: resolved entries carry over (their tokens are part of
+            # this run's result and parity bar); unresolved ones become
+            # re-adopted in-flight work or re-dispatch orphans below.
+            for rid, jr in sorted(recovered["ledger"].items()):
+                rec = _Req(
+                    rid=rid,
+                    prompt=[int(t) for t in jr["prompt"]],
+                    max_new=int(jr["max_new"]),
+                    arrival_abs=float(jr["arrival_abs"]),
+                    deadline_abs=jr.get("deadline_abs"),
+                    tenant=str(jr.get("tenant", "default")),
+                )
+                rec.redispatched = bool(jr.get("redispatched"))
+                if jr.get("tokens") is not None:
+                    rec.tokens = [int(t) for t in jr["tokens"]]
+                    rec.version = jr.get("version")
+                    rec.ttft = jr.get("ttft")
+                    completed += 1
+                    if rec.ttft is not None:
+                        ttft_by_phase[jr.get("phase") or "before"].append(
+                            float(rec.ttft)
+                        )
+                elif jr.get("shed") is not None:
+                    rec.shed_reason = str(jr["shed"])
+                ledger[rid] = rec
+            now0 = time.monotonic()
+            for idx, (ack, history) in adopt_histories.items():
+                # Completions that landed while the fleet ran unsupervised
+                # (after the crash, before this restart) still count — the
+                # work happened; only the supervisor that asked for it died.
+                for m in history:
+                    mop = m.get("op")
+                    if mop == "done":
+                        rec = ledger.get(int(m["rid"]))
+                        if rec is None or rec.resolved:
+                            continue
+                        rec.tokens = [int(t) for t in m["tokens"]]
+                        rec.version = int(m["version"])
+                        rec.ttft = m.get("ttft")
+                        completed += 1
+                        if rec.ttft is not None:
+                            ttft_by_phase["during"].append(float(rec.ttft))
+                        journal.record(
+                            "done", rid=rec.rid, tokens=rec.tokens,
+                            version=rec.version, ttft=rec.ttft,
+                            phase="during",
+                        )
+                    elif mop == "shed":
+                        rec = ledger.get(int(m["rid"]))
+                        if (
+                            rec is None or rec.resolved
+                            or m["reason"] == "cancelled"
+                        ):
+                            continue
+                        rec.shed_reason = str(m["reason"])
+                        journal.record(
+                            "shed", rid=rec.rid, reason=rec.shed_reason
+                        )
+                    elif mop == "swapped":
+                        replicas[idx].version = int(m["version"])
+                # Rids the adopted worker still holds: rebuild the router's
+                # outstanding books in place — no re-dispatch, no re-decode.
+                for rid in ack.get("rids", []):
+                    rec = ledger.get(int(rid))
+                    if rec is None or rec.resolved:
+                        continue
+                    rec.holders.add(idx)
+                    router.dispatch(
+                        rec.rid, idx, now0,
+                        deadline=rec.deadline_abs, prefix_sig=req_sig(rec),
+                    )
+            # Orphaned in-flight work (admitted, unresolved, held by no
+            # adopted replica) re-dispatches from the prompt with its
+            # ORIGINAL arrival/deadline — the PR 8 failover bar.
+            for rid, rec in sorted(ledger.items()):
+                if rec.resolved or rec.holders:
+                    continue
+                rec.redispatched = True
+                redispatch_queue.append(rid)
+                redispatched += 1
+                self.registry.counter(FLEET_REDISPATCH).inc()
+                journal.record("redispatch", rid=rid)
+            # Trace entries the corpse already admitted must not be
+            # admitted twice: multiset-match on (arrival, prompt, max_new,
+            # tenant) — exact floats, JSON round-trips losslessly.
+            admitted: Counter = Counter(
+                (jr.get("arrival_rel"), tuple(jr["prompt"]),
+                 int(jr["max_new"]), str(jr.get("tenant", "default")))
+                for jr in recovered["ledger"].values()
+                if not jr.get("spike")
+            )
+            kept = []
+            for e in pending:
+                key = (
+                    float(e["arrival"]),
+                    tuple(int(t) for t in e["prompt"]),
+                    int(e["max_new"]), str(e.get("tenant", "default")),
+                )
+                if admitted.get(key, 0) > 0:
+                    admitted[key] -= 1
+                    continue
+                kept.append(e)
+            # A load_spike burst is synthetic: its un-admitted tail exists
+            # only in the journal and must be re-injected for the spike
+            # recovery to ever close.
+            spike_admits: Counter = Counter(
+                (jr.get("arrival_rel"), tuple(jr["prompt"]))
+                for jr in recovered["ledger"].values() if jr.get("spike")
+            )
+            spike_backlog: list[dict] = []
+            for fire in recovered["fires"]:
+                for e in fire.get("burst") or []:
+                    key = (
+                        float(e["arrival"]),
+                        tuple(int(t) for t in e["prompt"]),
+                    )
+                    if spike_admits.get(key, 0) > 0:
+                        spike_admits[key] -= 1
+                        continue
+                    spike_backlog.append(e)
+            pending = deque(sorted(
+                kept + spike_backlog, key=lambda e: e["arrival"]
+            ))
+            # Chaos books replay: re-mark every journaled fire, pair the
+            # journaled recoveries, and take ownership of what the corpse
+            # left open. The supervisor kinds close HERE — re-adoption is
+            # their recovery, with latency spanning the crash itself
+            # (CLOCK_MONOTONIC is system-wide, so the corpse's fire stamp
+            # is directly comparable).
+            if injector is not None:
+                recov_left: Counter = Counter(recovered["recovery_kinds"])
+                for fire in recovered["fires"]:
+                    kind = str(fire["kind"])
+                    injector.fire_observed(kind)
+                    if recov_left.get(kind, 0) > 0:
+                        recov_left[kind] -= 1
+                        injector.record_recovery(kind, latency_s=0.0)
+                        continue
+                    if kind in ("supervisor_kill", "supervisor_hang"):
+                        injector.record_recovery(
+                            kind,
+                            latency_s=time.monotonic() - float(fire["t"]),
+                        )
+                        journal.record("chaos_recovery", kind=kind)
+                    elif kind == "load_spike":
+                        open_rids = {
+                            rid for rid, jr in recovered["ledger"].items()
+                            if jr.get("spike") and not ledger[rid].resolved
+                        }
+                        if not open_rids and not spike_backlog:
+                            injector.record_recovery(kind, latency_s=0.0)
+                            journal.record("chaos_recovery", kind=kind)
+                        else:
+                            pending_recoveries.append({
+                                "kind": kind, "replica": -1,
+                                "detected": now0,
+                                "rids": set(open_rids),
+                                "awaiting": len(spike_backlog),
+                            })
+                    else:
+                        pending_recoveries.append({
+                            "kind": kind,
+                            "replica": int(fire.get("replica", -1)),
+                            "detected": now0, "rids": set(),
+                        })
+            phase = (
+                "during" if pending_recoveries
+                else ("after" if recovered["fires"] else "before")
+            )
+            # An unfinished scale-down resumes its drain here.
+            if recovered["retiring"] is not None:
+                retiring = recovered["retiring"]
+                retire_stop_sent = False
+                router.mark_retired(retiring)
+            # Adopted workers kept their brownout stage; respawned ones
+            # booted at 0 — re-broadcast so the ladder is uniform again.
+            if brownout_stage > 0:
+                for r in replicas.values():
+                    self._send(r, {"op": "brownout", "stage": brownout_stage})
+            replay_s = time.monotonic() - replay_wall0
+            self.registry.gauge(SUP_REPLAY_S).set(replay_s)
+            journal.record(
+                "recovered", readopted=adopted_n, respawned=respawned_n,
+                redispatched=len(redispatch_queue), replay_s=replay_s,
+            )
+            self._log(
+                f"incarnation {self.incarnation}: journal replay + orphan "
+                f"probe took {replay_s:.2f}s — re-adopted {adopted_n}, "
+                f"respawned {respawned_n}, re-dispatching "
+                f"{len(redispatch_queue)} orphaned request(s), "
+                f"{completed} completion(s) carried over"
+            )
+
         try:
             while True:
                 now = time.monotonic()
@@ -975,6 +1631,7 @@ class FleetSupervisor(ClusterSupervisor):
                     r.ready for r in replicas.values()
                 ):
                     t0 = now
+                    journal.record("clock_start", t0=t0)
                 if now - start > self.timeout_s:
                     raise FleetFailure(
                         f"run exceeded timeout_s={self.timeout_s}"
@@ -995,6 +1652,20 @@ class FleetSupervisor(ClusterSupervisor):
                     )
                     for m in msgs:
                         handle_msg(rep, m)
+
+                # 2.5 supervisor-level chaos: the control plane detonates
+                # ITSELF (SIGKILL mid-surge / wedge forever), orphaning
+                # every live worker. The fire is journaled write-ahead —
+                # the dying incarnation's registry is lost, and the journal
+                # is how the next incarnation inherits the fire into its
+                # books (and closes it by re-adopting the fleet).
+                if injector is not None:
+                    injector.check_supervisor_fault(
+                        step=completed,
+                        on_fire=lambda kind: journal.record(
+                            "chaos_fire", kind=kind, replica=-1
+                        ),
+                    )
 
                 # 3. dead replicas (exit observed).
                 for rep in replicas.values():
@@ -1038,6 +1709,9 @@ class FleetSupervisor(ClusterSupervisor):
                     hedged_primary.setdefault(
                         rid,
                         next(iter(rec.holders)) if rec.holders else -1,
+                    )
+                    journal.record(
+                        "dispatch", rid=rid, target=target, hedge=True
                     )
                     self._send(replicas[target], {
                         "op": "req", "rid": rid, "prompt": rec.prompt,
@@ -1094,6 +1768,7 @@ class FleetSupervisor(ClusterSupervisor):
                     swap["performed"] = True
                     swap["drain_s"] = now - swap_t0
                     swap["completions_during"] = completed - swap_mark
+                    journal.record("swap_done", version=target_version)
                     self._log(
                         f"swap: fleet at version {target_version} in "
                         f"{swap['drain_s']:.2f}s "
@@ -1138,6 +1813,14 @@ class FleetSupervisor(ClusterSupervisor):
                                     }
                                     for i in range(8)
                                 ]
+                                # The burst is synthetic — it exists only
+                                # in memory, so the journal must carry the
+                                # entries themselves or a successor could
+                                # never finish absorbing the spike.
+                                journal.record(
+                                    "chaos_fire", kind="load_spike",
+                                    replica=-1, burst=burst,
+                                )
                                 pending = deque(sorted(
                                     list(pending) + burst,
                                     key=lambda e: e["arrival"],
@@ -1157,6 +1840,7 @@ class FleetSupervisor(ClusterSupervisor):
                     if retiring is not None:
                         vrep = replicas[retiring]
                         if vrep.stopped is not None:
+                            journal.record("retired", idx=retiring)
                             self._kill(vrep)
                             del replicas[retiring]
                             router.remove_replica(retiring)
@@ -1258,6 +1942,10 @@ class FleetSupervisor(ClusterSupervisor):
                             direction=direction,
                             outcome="ok" if outcome == "ok" else "vetoed",
                         )).inc()
+                        journal.record(
+                            "scale", direction=direction,
+                            outcome="ok" if outcome == "ok" else "vetoed",
+                        )
                         if outcome != "ok":
                             vetoed += 1
                             self._log(
@@ -1337,6 +2025,7 @@ class FleetSupervisor(ClusterSupervisor):
                             policy.note_scale_event(now)
                             retiring = victim
                             retire_stop_sent = False
+                            journal.record("retire_begin", idx=victim)
                             router.mark_retired(victim)
                             self._log(
                                 f"autoscale: scale-down — retiring "
@@ -1357,6 +2046,7 @@ class FleetSupervisor(ClusterSupervisor):
                         self.registry.counter(labeled(
                             "fleet_brownout_total", stage=str(stage)
                         )).inc()
+                        journal.record("brownout", stage=stage)
                         self._log(
                             f"brownout: stage {brownout_stage} -> {stage} "
                             f"(load/replica {sig.load_per_replica:.2f})"
@@ -1403,6 +2093,19 @@ class FleetSupervisor(ClusterSupervisor):
                             if deadline > 0 else None
                         ),
                         tenant=str(e.get("tenant", "default")),
+                    )
+                    # Admission is journaled with both clocks: the absolute
+                    # stamps let a successor re-dispatch with the ORIGINAL
+                    # arrival/deadline, the relative one lets it match this
+                    # entry against its own copy of the trace.
+                    journal.record(
+                        "admit", rid=rid, prompt=ledger[rid].prompt,
+                        max_new=ledger[rid].max_new,
+                        arrival_rel=float(e["arrival"]),
+                        arrival_abs=ledger[rid].arrival_abs,
+                        deadline_abs=ledger[rid].deadline_abs,
+                        tenant=ledger[rid].tenant,
+                        spike=bool(e.get("spike")),
                     )
                     if e.get("spike"):
                         # Tie the admitted spike request back to its open
@@ -1462,6 +2165,9 @@ class FleetSupervisor(ClusterSupervisor):
         finally:
             for rep in replicas.values():
                 self._kill(rep)
+            journal.record("supervisor_stop", pid=os.getpid())
+            journal.close()
+            self.journal = None
 
         # -- accounting out ---------------------------------------------------
         def pct(vals: list[float], q: float) -> Optional[float]:
@@ -1508,6 +2214,11 @@ class FleetSupervisor(ClusterSupervisor):
             "swap_completions_during": swap["completions_during"],
             "compile_flat": compile_flat,
         }
+        # snapshot() already carries supervisor_incarnation and the
+        # readopted/respawned counters; these flat copies make the
+        # cross-incarnation reconciliation greppable in fleet_summary.
+        values["supervisor_readopted"] = adopted_n
+        values["supervisor_respawned"] = respawned_n
         scale_summary: dict[str, Any] = {}
         if self.autoscale is not None:
             scale_summary = {
@@ -1564,6 +2275,9 @@ class FleetSupervisor(ClusterSupervisor):
             snapshot=self.registry.snapshot(),
             scale=scale_summary,
             shed_by_tenant=shed_by_tenant,
+            incarnation=int(self.incarnation or 0),
+            readopted=adopted_n,
+            respawned=respawned_n,
         )
         if self.tracer is not None:
             self.tracer.close()

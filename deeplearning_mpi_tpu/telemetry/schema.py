@@ -95,6 +95,11 @@ METRICS: dict[str, tuple[str, frozenset[str]]] = {
     "fleet_brownout_total": ("counter", frozenset({"stage"})),
     "fleet_replicas": ("gauge", frozenset()),
     "fleet_scale_total": ("counter", frozenset({"direction", "outcome"})),
+    # -- control-plane crash safety (PR 20, resilience/cluster.py) ----------
+    "supervisor_incarnation": ("gauge", frozenset()),
+    "supervisor_journal_replay_s": ("gauge", frozenset()),
+    "supervisor_readopted_total": ("counter", frozenset()),
+    "supervisor_respawned_total": ("counter", frozenset()),
     # -- chaos / resilience (PR 3/5) ----------------------------------------
     "fault_injected_total": ("counter", frozenset({"kind"})),
     "recovery_latency_s": ("histogram", frozenset()),

@@ -1,0 +1,9 @@
+"""Seeded DMT005: a rogue second writer appending to the supervisor's
+write-ahead journal stream. The journal is single-writer by construction
+(``resilience/cluster.py::SupervisorJournal`` — incarnation-fenced, one
+live append handle); any other ``open(.. "journal.jsonl" ..)`` is a
+torn-line hazard the replay discipline cannot defend against."""
+
+
+def shadow_journal(run_dir):
+    return open(run_dir / "journal.jsonl", "a")  # seeded: DMT005 — second journal writer

@@ -1,0 +1,473 @@
+"""Control-plane crash safety: journal, incarnations, orphan re-adoption.
+
+Unit-level coverage for ``resilience/cluster.py`` (write-ahead journal,
+incarnation fencing, pid liveness, stale-incarnation hygiene) and the
+pure journal-replay folds of ``serving/fleet.py`` /
+``resilience/pod.py`` — all fake-clock or scripted-subprocess, no JAX
+workers. The live end-to-end bar (supervisor SIGKILLed mid-surge,
+restarted supervisor re-adopts + drains with parity) is
+``tools/controlplane_drill.py`` via ``tests/test_multiprocess.py`` and
+``make controlplane-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deeplearning_mpi_tpu.resilience.cluster import (
+    JOURNAL_FILE,
+    LivenessTracker,
+    SupervisorJournal,
+    next_incarnation,
+    pid_alive,
+    replay_journal,
+)
+
+
+# ---------------------------------------------------------------------------
+# journal + incarnation
+
+
+class TestSupervisorJournal:
+    def test_records_round_trip_with_incarnation_stamp(self, tmp_path):
+        ticks = iter(range(100))
+        j = SupervisorJournal(
+            tmp_path, incarnation=3, clock=lambda: float(next(ticks))
+        )
+        j.record("spawn", idx=0, pid=123)
+        j.record("admit", rid=7, prompt=[1, 2, 3])
+        j.close()
+        recs = replay_journal(tmp_path / JOURNAL_FILE)
+        assert [r["ev"] for r in recs] == ["spawn", "admit"]
+        assert all(r["inc"] == 3 for r in recs)
+        assert recs[0]["t"] == 0.0 and recs[1]["t"] == 1.0
+        assert recs[1]["prompt"] == [1, 2, 3]
+
+    def test_torn_final_line_is_dropped(self, tmp_path):
+        """A supervisor SIGKILLed mid-write leaves a line with no trailing
+        newline; replay must drop exactly that line, keep the rest."""
+        j = SupervisorJournal(tmp_path, incarnation=1)
+        j.record("spawn", idx=0)
+        j.record("done", rid=4)
+        j.close()
+        path = tmp_path / JOURNAL_FILE
+        with path.open("a") as f:
+            f.write('{"inc": 1, "t": 9.0, "ev": "done", "rid": 5')  # torn
+        recs = replay_journal(path)
+        assert [r["ev"] for r in recs] == ["spawn", "done"]
+        assert recs[-1]["rid"] == 4
+
+    def test_replay_of_missing_journal_is_empty(self, tmp_path):
+        assert replay_journal(tmp_path / JOURNAL_FILE) == []
+
+    def test_incarnation_is_monotonic_and_persisted(self, tmp_path):
+        assert next_incarnation(tmp_path) == 1
+        assert next_incarnation(tmp_path) == 2
+        assert next_incarnation(tmp_path) == 3
+
+    def test_two_incarnations_share_one_journal(self, tmp_path):
+        """Restart appends — replay sees both writers, fenced by inc."""
+        j1 = SupervisorJournal(tmp_path, incarnation=1)
+        j1.record("spawn", idx=0)
+        j1.close()
+        j2 = SupervisorJournal(tmp_path, incarnation=2)
+        j2.record("adopt", idx=0)
+        j2.close()
+        recs = replay_journal(tmp_path / JOURNAL_FILE)
+        assert [(r["inc"], r["ev"]) for r in recs] == [
+            (1, "spawn"), (2, "adopt")
+        ]
+
+
+class TestPidAlive:
+    def test_own_pid_is_alive(self):
+        assert pid_alive(os.getpid())
+
+    def test_bogus_pid_is_dead(self):
+        assert not pid_alive(2 ** 22 + 12345)
+
+    def test_zombie_is_not_alive(self):
+        """An exited-but-unreaped child must read as dead: os.kill(pid, 0)
+        still succeeds on a zombie, so the /proc state check is what keeps
+        the supervisor from adopting a corpse."""
+        proc = subprocess.Popen([sys.executable, "-c", "pass"])
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            stat = f"/proc/{proc.pid}/stat"
+            try:
+                with open(stat) as f:
+                    if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                        break
+            except OSError:
+                break
+            time.sleep(0.02)
+        try:
+            assert not pid_alive(proc.pid)
+        finally:
+            proc.wait()
+
+
+# ---------------------------------------------------------------------------
+# stale-incarnation hygiene
+
+
+class TestStaleIncarnationHygiene:
+    def _tracker(self, clock, incarnation=2):
+        return LivenessTracker(
+            [0], deadline_s=5.0, grace_s=5.0,
+            clock=clock, incarnation=incarnation,
+        )
+
+    def test_dead_incarnation_heartbeats_are_ignored(self):
+        now = [0.0]
+        t = self._tracker(lambda: now[0])
+        for seq in (1, 2, 3):
+            now[0] += 1.0
+            t.observe(0, {"progress_seq": seq, "incarnation": 1})
+        assert not t.any_progress()
+
+    def test_matching_incarnation_heartbeats_count(self):
+        now = [0.0]
+        t = self._tracker(lambda: now[0])
+        t.observe(0, {"progress_seq": 0, "incarnation": 2})
+        now[0] += 1.0
+        t.observe(0, {"progress_seq": 1, "incarnation": 2})
+        assert t.any_progress()
+
+    def test_unstamped_heartbeats_still_count(self):
+        """Workers predating the incarnation contract (or whose env lacks
+        the stamp) must not be read as dead — only an explicit mismatch
+        is rejected."""
+        now = [0.0]
+        t = self._tracker(lambda: now[0])
+        t.observe(0, {"progress_seq": 0})
+        now[0] += 1.0
+        t.observe(0, {"progress_seq": 1})
+        assert t.any_progress()
+
+
+# ---------------------------------------------------------------------------
+# fleet journal replay (pure fold — no processes, no clock)
+
+
+def _fleet_cls():
+    from deeplearning_mpi_tpu.serving.fleet import FleetSupervisor
+
+    return FleetSupervisor
+
+
+def _rec(ev, **kw):
+    return {"inc": 1, "t": float(kw.pop("t", 0.0)), "ev": ev, **kw}
+
+
+def _admit(rid, **kw):
+    base = dict(
+        rid=rid, prompt=[1, 2], max_new=4, arrival_rel=0.0,
+        arrival_abs=100.0 + rid, deadline_abs=None, tenant="default",
+        spike=False,
+    )
+    base.update(kw)
+    return _rec("admit", **base)
+
+
+class TestFleetJournalReplay:
+    def test_resolved_and_orphaned_requests_split(self):
+        prior = [
+            _rec("clock_start", t0=100.0),
+            _rec("spawn", idx=0, attempt=0, pid=111, seed=0, version=0,
+                 dir="replica0", chaos=""),
+            _rec("ready", idx=0, attempt=0, compile_total=5.0),
+            _admit(0),
+            _rec("dispatch", rid=0, target=0),
+            _rec("done", rid=0, tokens=[9, 8], version=0, ttft=0.1,
+                 phase="before"),
+            _admit(1),
+            _rec("dispatch", rid=1, target=0),
+        ]
+        state = _fleet_cls()._replay_fleet_state(prior)
+        assert state["t0"] == 100.0
+        assert state["slots"][0]["pid"] == 111
+        assert state["slots"][0]["compile_ready"] == 5.0
+        assert state["ledger"][0]["tokens"] == [9, 8]
+        assert state["ledger"][1].get("tokens") is None
+        assert state["next_rid"] == 2
+
+    def test_cross_incarnation_books_reconcile(self):
+        """Scale, brownout, chaos, and failure books fold across BOTH
+        incarnations' records — the reconciliation the drill asserts on
+        the live fleet_summary."""
+        prior = [
+            _rec("spawn", idx=0, attempt=0, pid=11, seed=0, version=0,
+                 dir="replica0", chaos=""),
+            _rec("chaos_fire", kind="replica_kill", replica=0),
+            _rec("redispatch", rid=3),
+            _rec("failure", idx=0, kind="replica_kill", chaos=""),
+            _rec("chaos_recovery", kind="replica_kill"),
+            _rec("scale", direction="up", outcome="ok"),
+            _rec("spawn", idx=2, attempt=0, pid=33, seed=0, version=0,
+                 dir="replica2", chaos=""),
+            _rec("scale", direction="down", outcome="vetoed"),
+            _rec("brownout", stage=1),
+            _rec("brownout", stage=0),
+        ]
+        # Second incarnation's records append to the same stream.
+        prior += [
+            dict(r, inc=2) for r in (
+                _rec("chaos_fire", kind="supervisor_kill", replica=-1),
+                _rec("scale", direction="up", outcome="ok"),
+            )
+        ]
+        state = _fleet_cls()._replay_fleet_state(prior)
+        assert state["restarts"] == 1
+        assert state["failures"] == {"replica_kill": 1}
+        assert state["redispatched"] == 1
+        assert [f["kind"] for f in state["fires"]] == [
+            "replica_kill", "supervisor_kill"
+        ]
+        assert state["recovery_kinds"] == ["replica_kill"]
+        assert state["scale_records"] == [
+            ("up", "ok"), ("down", "vetoed"), ("up", "ok")
+        ]
+        assert state["brownout_stage"] == 0
+        assert state["brownout_stage_max"] == 1
+        assert sorted(state["slots"]) == [0, 2]
+
+    def test_spike_burst_rides_the_journal(self):
+        burst = [
+            {"arrival": 1.0, "prompt": [5, 6], "max_new": 4, "spike": True}
+        ]
+        prior = [
+            _rec("clock_start", t0=100.0),
+            _rec("chaos_fire", kind="load_spike", replica=-1, burst=burst),
+            _admit(0, spike=True),
+        ]
+        state = _fleet_cls()._replay_fleet_state(prior)
+        assert state["fires"][0]["burst"] == burst
+        assert state["ledger"][0]["spike"] is True
+
+    def test_retire_in_flight_resumes(self):
+        prior = [
+            _rec("spawn", idx=0, attempt=0, pid=11, seed=0, version=0,
+                 dir="replica0", chaos=""),
+            _rec("spawn", idx=1, attempt=0, pid=22, seed=1, version=0,
+                 dir="replica1", chaos=""),
+            _rec("retire_begin", idx=1),
+        ]
+        state = _fleet_cls()._replay_fleet_state(prior)
+        assert state["retiring"] == 1
+        # ...and a completed retire clears it and drops the slot.
+        state2 = _fleet_cls()._replay_fleet_state(
+            prior + [_rec("retired", idx=1)]
+        )
+        assert state2["retiring"] is None
+        assert sorted(state2["slots"]) == [0]
+
+
+# ---------------------------------------------------------------------------
+# orphan probe: live-pid adopt vs dead-pid respawn
+
+_FAKE_WORKER = r"""
+import json, os, sys, time
+d = sys.argv[1]
+seq = 0
+inbox = open(os.path.join(d, "inbox.jsonl"))
+out = open(os.path.join(d, "outbox.jsonl"), "a")
+out.write(json.dumps({"op": "done", "rid": 4, "tokens": [7],
+                      "version": 0}) + "\n")
+out.flush()
+while True:
+    seq += 1
+    tmp = os.path.join(d, "hb.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "progress_seq": seq}, f)
+    os.replace(tmp, os.path.join(d, "heartbeat.json"))
+    line = inbox.readline()
+    if line:
+        m = json.loads(line)
+        if m.get("op") == "adopt":
+            out.write(json.dumps({
+                "op": "adopted", "replica": 0, "pid": os.getpid(),
+                "incarnation": m["incarnation"], "version": 0,
+                "compile_total": 5.0, "mono_offset": 0.0,
+                "rids": [9],
+            }) + "\n")
+            out.flush()
+    time.sleep(0.03)
+"""
+
+
+def _mini_supervisor(tmp_path):
+    """A FleetSupervisor configured but never run — just enough state to
+    drive ``_try_adopt`` directly."""
+    sup = _fleet_cls()(
+        {"vocab_size": 16}, {"max_slots": 1}, 1, tmp_path / "fleet",
+        seed=0, adopt_grace_s=8.0,
+    )
+    sup.poll_interval_s = 0.05
+    sup.incarnation = 7
+    return sup
+
+
+class TestOrphanProbe:
+    def test_live_pid_acks_the_handshake(self, tmp_path):
+        from deeplearning_mpi_tpu.serving.fleet import _Replica
+
+        d = tmp_path / "replica0"
+        d.mkdir(parents=True)
+        (d / "inbox.jsonl").touch()
+        proc = subprocess.Popen([sys.executable, "-c", _FAKE_WORKER, str(d)])
+        try:
+            sup = _mini_supervisor(tmp_path)
+            rep = _Replica(idx=0, seed=0)
+            rep.dir = d
+            ack, history = sup._try_adopt(rep, proc.pid)
+            assert ack is not None, "live orphan was not adopted"
+            assert ack["incarnation"] == 7
+            assert ack["rids"] == [9]
+            # The completion that landed while unsupervised is in the
+            # pre-ack history — counted, never re-decoded.
+            assert any(
+                m.get("op") == "done" and m.get("rid") == 4 for m in history
+            )
+            rep.inbox.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_dead_pid_is_not_adopted(self, tmp_path):
+        from deeplearning_mpi_tpu.serving.fleet import _Replica
+
+        d = tmp_path / "replica0"
+        d.mkdir(parents=True)
+        proc = subprocess.Popen([sys.executable, "-c", "pass"])
+        proc.wait()
+        sup = _mini_supervisor(tmp_path)
+        rep = _Replica(idx=0, seed=0)
+        rep.dir = d
+        ack, history = sup._try_adopt(rep, proc.pid)
+        assert ack is None and history == []
+
+    def test_adopted_proc_handle_tracks_liveness(self):
+        from deeplearning_mpi_tpu.serving.fleet import _AdoptedProc
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            start_new_session=True,
+        )
+        handle = _AdoptedProc(proc.pid)
+        try:
+            assert handle.poll() is None
+        finally:
+            handle.kill()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            proc.poll()  # reap so the pid leaves Z state
+            if handle.poll() is not None:
+                break
+            time.sleep(0.02)
+        assert handle.poll() == -signal.SIGKILL
+
+
+# ---------------------------------------------------------------------------
+# pod journal replay
+
+
+class TestPodJournalReplay:
+    def _pod_cls(self):
+        from deeplearning_mpi_tpu.resilience.pod import PodSupervisor
+
+        return PodSupervisor
+
+    def test_attempt_and_books_resume(self):
+        prior = [
+            _rec("spawn", attempt=0, world=4, pids=[11, 12, 13, 14],
+                 chaos="rank_kill@step:3"),
+            _rec("rank_failure", rank=3, kind="rank_kill", why="exit -9",
+                 unit="step", at=3, t=5.0),
+            _rec("chaos_recovery", kind="rank_kill"),
+            _rec("reform", old_world=4, new_world=3, restarts=1),
+            _rec("spawn", attempt=1, world=3, pids=[21, 22, 23], chaos=""),
+        ]
+        state = self._pod_cls()._replay_pod_state(prior)
+        assert state["next_attempt"] == 2
+        assert state["restarts"] == 1
+        assert state["rank_failures"] == 1
+        assert state["failures_by_kind"] == {"rank_kill": 1}
+        assert state["world_sizes"] == [4, 3]
+        assert state["pids"] == [11, 12, 13, 14, 21, 22, 23]
+        assert [f["kind"] for f in state["fires"]] == ["rank_kill"]
+        assert state["recoveries"] == ["rank_kill"]
+
+    def test_open_fire_carries_its_journal_timestamp(self):
+        """A fire the corpse never closed must surface with the journal's
+        CLOCK_MONOTONIC stamp so the successor's recovery latency spans
+        the crash."""
+        prior = [
+            _rec("spawn", attempt=0, world=2, pids=[11, 12], chaos=""),
+            _rec("rank_failure", rank=1, kind="rank_hang",
+                 why="stalled", unit="step", at=2, t=42.5),
+        ]
+        state = self._pod_cls()._replay_pod_state(prior)
+        assert state["fires"] == [
+            {"kind": "rank_hang", "unit": "step", "at": 2, "t": 42.5}
+        ]
+        assert state["recoveries"] == []
+
+    def test_unplanned_failures_count_but_do_not_fire(self):
+        prior = [
+            _rec("spawn", attempt=0, world=2, pids=[11, 12], chaos=""),
+            _rec("rank_failure", rank=0, kind="rank_kill", why="exit 1",
+                 unit=None, at=None),
+        ]
+        state = self._pod_cls()._replay_pod_state(prior)
+        assert state["rank_failures"] == 1
+        assert state["fires"] == []
+
+
+# ---------------------------------------------------------------------------
+# chaos-kind hygiene: supervisor kinds need a restart harness
+
+
+class TestSupervisorKindValidation:
+    def test_supervisor_kinds_are_registered(self):
+        from deeplearning_mpi_tpu.resilience import CONTROLPLANE_KINDS
+
+        assert CONTROLPLANE_KINDS == {"supervisor_kill", "supervisor_hang"}
+
+    def test_serve_lm_workloads_reject_supervisor_kinds(self):
+        """``cli/serve_lm.py`` validates against FLEET/SERVE/DISAGG kind
+        sets, none of which include the supervisor kinds: the CLI process
+        IS the supervisor and nothing would restart it. Only harnesses
+        with a restart loop (the drill) may plan them."""
+        from deeplearning_mpi_tpu.resilience import (
+            AUTOSCALE_KINDS,
+            CONTROLPLANE_KINDS,
+            DISAGG_KINDS,
+            FLEET_KINDS,
+            SERVE_KINDS,
+            validate_plan_kinds,
+        )
+
+        for kinds in (SERVE_KINDS, FLEET_KINDS, DISAGG_KINDS,
+                      FLEET_KINDS | AUTOSCALE_KINDS):
+            assert not (CONTROLPLANE_KINDS & kinds)
+            with pytest.raises(ValueError, match="supervisor_kill"):
+                validate_plan_kinds(
+                    "supervisor_kill@step:1", kinds, workload="serving"
+                )
+
+    def test_fleet_supervisor_accepts_supervisor_kinds(self):
+        """The FleetSupervisor itself supports them — it owns the journal
+        that makes a successor's recovery possible."""
+        sup = _fleet_cls()(
+            {"vocab_size": 16}, {"max_slots": 1}, 1, "/tmp/dmt_cp_unused",
+            seed=0, chaos="supervisor_kill@step:5",
+        )
+        assert sup.chaos_spec == "supervisor_kill@step:5"

@@ -46,6 +46,7 @@ from deeplearning_mpi_tpu.resilience import (
 )
 from deeplearning_mpi_tpu.resilience.faults import (
     AUTOSCALE_KINDS,
+    CONTROLPLANE_KINDS,
     DISAGG_KINDS,
     FAULT_INJECTED,
     FAULT_UNITS,
@@ -416,11 +417,13 @@ class TestFaultKindAudit:
         "replica_slow": "check_replica_fault",
         "load_spike": "fire_observed",
         "scale_during_failure": "fire_observed",
+        "supervisor_kill": "check_supervisor_fault",
+        "supervisor_hang": "check_supervisor_fault",
     }
 
     ALL_SETS = (
         TRAIN_KINDS, POD_KINDS, GUARD_KINDS, FLEET_KINDS,
-        SERVE_KINDS, DISAGG_KINDS, AUTOSCALE_KINDS,
+        SERVE_KINDS, DISAGG_KINDS, AUTOSCALE_KINDS, CONTROLPLANE_KINDS,
     )
 
     def test_every_kind_set_is_grammar_parseable(self):
@@ -430,7 +433,8 @@ class TestFaultKindAudit:
     def test_workload_sets_cover_the_grammar_exactly(self):
         # No orphan kind that parses but no workload would ever validate —
         # such a kind could never fire and its books could never balance.
-        covered = TRAIN_KINDS | FLEET_KINDS | DISAGG_KINDS | AUTOSCALE_KINDS
+        covered = (TRAIN_KINDS | FLEET_KINDS | DISAGG_KINDS
+                   | AUTOSCALE_KINDS | CONTROLPLANE_KINDS)
         assert covered == set(FAULT_UNITS)
 
     def test_validate_accepts_each_kind_in_its_workload(self):
@@ -440,6 +444,7 @@ class TestFaultKindAudit:
             (SERVE_KINDS, "serving"),
             (DISAGG_KINDS, "serving-disagg"),
             (AUTOSCALE_KINDS, "autoscaler"),
+            (CONTROLPLANE_KINDS, "controlplane"),
         ):
             spec = ",".join(f"{k}@{FAULT_UNITS[k]}:1" for k in sorted(kinds))
             validate_plan_kinds(spec, kinds, workload=workload)  # no raise

@@ -474,3 +474,41 @@ def test_traced_training_attributes_step_phases(tmp_path):
     out = _trace_drill_module().run_train_trace(tmp_path / "drill")
     assert out["steps"] == 4
     assert out["phase_sum_s"] == pytest.approx(out["duration_s"], rel=1e-6)
+
+
+# -- control-plane crash drill ------------------------------------------------
+
+def _controlplane_drill_module():
+    """Import tools/controlplane_drill.py by path (script, not a package)."""
+    import importlib.util
+
+    drill = REPO / "tools" / "controlplane_drill.py"
+    spec = importlib.util.spec_from_file_location("controlplane_drill", drill)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+@pytest.mark.multiprocess
+def test_supervisor_sigkill_readopt_with_parity(tmp_path):
+    """The control-plane crash drill (``tools/controlplane_drill.py``,
+    also ``make controlplane-smoke``): the incarnation-1 FleetSupervisor
+    SIGKILLs ITSELF mid-surge via its own chaos plan (load_spike
+    absorbed, a scale-up replica still warming), the harness kills one
+    orphaned worker to prove the probe discriminates, and the restarted
+    incarnation-2 supervisor replays the write-ahead journal, re-adopts
+    the live replicas without respawning them (serve_compile_total flat
+    — zero retraces), respawns the corpse, re-dispatches the victim's
+    in-flight requests at their original arrival/deadline, and drains
+    with zero drops, every stream bit-identical to offline greedy, chaos
+    + scale books reconciling across both incarnations."""
+    out = _controlplane_drill_module().run_drill(tmp_path / "drill")
+    assert out["incarnation"] >= 2
+    assert out["readopted"] >= 1
+    assert out["respawned"] >= 1
+    assert out["redispatched"] >= 1
+    assert out["dropped"] == 0
+    assert out["compile_flat"] is True
+    assert out["chaos_balanced"] is True
+    assert out["parity_checked"] == out["completed"] > 0

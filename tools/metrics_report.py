@@ -184,6 +184,31 @@ def _autoscaler_table(last: dict) -> str:
     return table("Autoscaler", rows)
 
 
+def _controlplane_table(last: dict) -> str:
+    """Control-plane crash-safety books from a ``fleet_summary`` /
+    ``pod_summary`` record (``resilience/cluster.py``): which supervisor
+    incarnation wrote the record, what its journal replay recovered
+    (re-adopted live orphans vs SIGKILL+respawn), and how long the
+    replay+probe took. Present only after a supervisor restart — a
+    first-boot run reports incarnation 1 with empty recovery books."""
+    if last.get("supervisor_incarnation") is None:
+        return ""
+    rows = [("supervisor incarnation", _fmt(last.get("supervisor_incarnation"))),
+            ("replicas re-adopted alive (zero retraces)",
+             _fmt(last.get("supervisor_readopted_total",
+                           last.get("supervisor_readopted")))),
+            ("replicas respawned (orphan dead or unresponsive)",
+             _fmt(last.get("supervisor_respawned_total",
+                           last.get("supervisor_respawned"))))]
+    v = last.get("supervisor_journal_replay_s")
+    if v is not None:
+        rows.append(("journal replay + orphan probe", f"{float(v):.3f} s"))
+    if last.get("redispatched_total") is not None:
+        rows.append(("orphaned requests re-dispatched",
+                     _fmt(last.get("redispatched_total"))))
+    return table("Control plane", rows)
+
+
 def _serving_table(last: dict) -> str:
     """A serve_lm run's end-of-run snapshot (``serve_summary``): delivery
     and latency numbers, plus — for a disaggregated run — the per-role
@@ -487,6 +512,11 @@ def summarize(records: list[dict]) -> str:
         if autoscaler:
             out.append(autoscaler)
 
+    supervised = [r for r in records
+                  if r.get("supervisor_incarnation") is not None]
+    if supervised:
+        out.append(_controlplane_table(supervised[-1]))
+
     traced = [r for r in records if r.get("span_recorded_total") is not None]
     if traced:
         out.append(_tracing_table(traced[-1]))
@@ -598,6 +628,13 @@ def _selftest() -> int:
             "swap_completions_during": 9, "compile_flat": True,
             "fault_injected_total": 2, "recovery_total": 2,
             "rollback_total": 0, "chaos_balanced": True,
+            # Control-plane crash-safety books (resilience/cluster.py):
+            # a restarted supervisor's incarnation and what its journal
+            # replay recovered must render their own table.
+            "supervisor_incarnation": 2,
+            "supervisor_readopted_total": 1,
+            "supervisor_respawned_total": 1,
+            "supervisor_journal_replay_s": 0.042,
             # Autoscaler accounting (fleet run with autoscale=): the scale
             # books, the per-direction decision counters, and the brownout
             # ladder must render their own table.
@@ -662,6 +699,11 @@ def _selftest() -> int:
                        "hedges fired", "replica restarts",
                        "failover recovery p50", "swap downtime",
                        "chaos books", "scale books",
+                       "supervisor incarnation",
+                       "replicas re-adopted alive (zero retraces)",
+                       "replicas respawned (orphan dead or unresponsive)",
+                       "journal replay + orphan probe",
+                       "orphaned requests re-dispatched",
                        "scale up decisions (ok)",
                        "scale down decisions (vetoed)",
                        "brownout stage (max reached)",
